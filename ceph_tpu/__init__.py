@@ -1,0 +1,37 @@
+"""ceph_tpu — a TPU-native distributed object-storage framework.
+
+A from-scratch re-design of Ceph's capability surface (reference:
+yanggogo/ceph, Ceph v19 "Squid" dev tree) whose performance-critical
+data-plane math — GF(2^8) Reed-Solomon / Cauchy erasure coding over
+object-stripe batches, and batched CRUSH straw2 placement over whole
+OSDMaps — executes on TPU via JAX (jit/vmap/shard_map/pallas).
+
+Package layout (mirrors the reference's layer map, SURVEY.md §1, but
+TPU-first):
+
+- ``ceph_tpu.ops``      — field math + kernels: GF(2^8), bit-matrices,
+                          RS/Cauchy matrix constructions, CRUSH hash,
+                          crc32c.  (reference: jerasure/gf-complete,
+                          src/crush/hash.c, src/common/crc32c*)
+- ``ceph_tpu.crush``    — CRUSH map model, scalar twin interpreter and
+                          the batched JAX placement engine.
+                          (reference: src/crush/)
+- ``ceph_tpu.osdmap``   — OSDMap, pools, pg→up/acting pipeline, batched
+                          whole-cluster remap.  (reference: src/osd/OSDMap.*)
+- ``ceph_tpu.ec``       — erasure-code plugin framework + plugins.
+                          (reference: src/erasure-code/)
+- ``ceph_tpu.models``   — the code-family "models": RS-Vandermonde,
+                          Cauchy, CLAY, SHEC, LRC constructions as pure
+                          math over GF(2^8).
+- ``ceph_tpu.parallel`` — device mesh / sharding helpers; multi-chip
+                          encode farms and remap sharding.
+- ``ceph_tpu.msg``      — framed async transport (msgr2 analogue).
+- ``ceph_tpu.store``    — object store (MemStore analogue + WAL).
+- ``ceph_tpu.osd``      — OSD daemon: PG state, EC backend I/O paths.
+- ``ceph_tpu.mon``      — cluster map authority / control plane.
+- ``ceph_tpu.client``   — librados-analogue client library.
+- ``ceph_tpu.cli``      — admin tools (crushtool/osdmaptool analogues).
+- ``ceph_tpu.utils``    — config options, logging, profiles.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""Field math and TPU kernels: GF(2^8), bit-matrices, hashes, checksums."""

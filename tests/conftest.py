@@ -1,0 +1,17 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+Multi-chip hardware is not available in CI; sharding/collective tests run
+against ``--xla_force_host_platform_device_count=8`` as the driver's
+``dryrun_multichip`` does.  Set CEPH_TPU_TEST_REAL_DEVICE=1 to let tests
+see the real accelerator instead.
+"""
+
+import os
+
+if not os.environ.get("CEPH_TPU_TEST_REAL_DEVICE"):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()

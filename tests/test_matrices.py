@@ -1,0 +1,92 @@
+"""MDS / systematic-code properties of every generator construction."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.models import matrices as mx
+from ceph_tpu.ops import gf256 as gf
+
+
+CONSTRUCTIONS = {
+    "isa_vandermonde": mx.isa_rs_vandermonde_matrix,
+    "isa_cauchy": mx.isa_cauchy_matrix,
+    "jerasure_vandermonde": mx.jerasure_rs_vandermonde_matrix,
+    "cauchy_orig": mx.cauchy_original_matrix,
+    "cauchy_good": mx.cauchy_good_matrix,
+}
+
+# isa vandermonde is known non-MDS for larger (k, m); the reference plugin
+# restricts it to m<=2 (ErasureCodeIsa.cc:206).
+MDS_CASES = {
+    "isa_vandermonde": [(4, 2), (8, 2), (10, 2)],
+    "isa_cauchy": [(4, 2), (8, 3), (6, 4), (10, 4)],
+    "jerasure_vandermonde": [(4, 2), (8, 3), (6, 4), (10, 4)],
+    "cauchy_orig": [(4, 2), (8, 3), (6, 4), (10, 4)],
+    "cauchy_good": [(4, 2), (8, 3), (6, 4), (10, 4)],
+}
+
+
+def is_mds(C: np.ndarray) -> bool:
+    """[I; C] is MDS iff every square submatrix of C is nonsingular
+    (equivalently any k rows of [I;C] are invertible)."""
+    m, k = C.shape
+    full = np.concatenate([np.eye(k, dtype=np.uint8), C], axis=0)
+    for rows in itertools.combinations(range(k + m), k):
+        sub = full[list(rows)]
+        try:
+            gf.gf_mat_inv(sub)
+        except np.linalg.LinAlgError:
+            return False
+    return True
+
+
+@pytest.mark.parametrize("name", sorted(CONSTRUCTIONS))
+def test_mds(name):
+    for k, m in MDS_CASES[name]:
+        C = CONSTRUCTIONS[name](k, m)
+        assert C.shape == (m, k)
+        assert is_mds(C), (name, k, m)
+
+
+def test_first_rows_structure():
+    # ISA vandermonde and jerasure vandermonde: first coding row all ones.
+    assert np.all(mx.isa_rs_vandermonde_matrix(6, 3)[0] == 1)
+    assert np.all(mx.jerasure_rs_vandermonde_matrix(6, 3)[0] == 1)
+    # jerasure vandermonde: first coding column all ones.
+    assert np.all(mx.jerasure_rs_vandermonde_matrix(6, 3)[:, 0] == 1)
+    # cauchy_good: row 0 all ones.
+    assert np.all(mx.cauchy_good_matrix(6, 3)[0] == 1)
+    # isa second coding row is powers of 2
+    row = mx.isa_rs_vandermonde_matrix(8, 3)[1]
+    assert np.array_equal(row, [gf.gf_pow(2, j) for j in range(8)])
+
+
+def test_isa_cauchy_entries():
+    C = mx.isa_cauchy_matrix(4, 2)
+    for i in range(2):
+        for j in range(4):
+            assert C[i, j] == gf.gf_inv(np.uint8((4 + i) ^ j))
+
+
+@pytest.mark.parametrize("name", ["isa_cauchy", "jerasure_vandermonde", "cauchy_good"])
+def test_decode_matrix_roundtrip(name):
+    rng = np.random.default_rng(7)
+    k, m = 8, 3
+    C = CONSTRUCTIONS[name](k, m)
+    D = rng.integers(0, 256, (k, 64), dtype=np.uint8)
+    P = gf.gf_matmul(C, D)
+    chunks = np.concatenate([D, P], axis=0)  # (k+m, n)
+    for erasures in ([0], [3, 9], [0, 5, 10], [1, 2, 4]):
+        dec = mx.decode_matrix_for(C, erasures)
+        survivors = [i for i in range(k + m) if i not in set(erasures)][:k]
+        rec = gf.gf_matmul(dec, chunks[survivors])
+        assert np.array_equal(rec, chunks[erasures]), (name, erasures)
+
+
+def test_decode_insufficient_survivors():
+    C = mx.isa_cauchy_matrix(4, 2)
+    with pytest.raises(ValueError):
+        # erasing 3 of 6 chunks with only k=4,m=2 → survivors < k
+        mx.decode_matrix_for(C, [0, 1, 2])
