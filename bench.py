@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""North-star benchmark: RS(k=8, m=3) erasure encode GB/s on one chip.
+
+Clone of the reference harness semantics (ceph_erasure_code_benchmark,
+reference src/test/erasure-code/ceph_erasure_code_benchmark.cc:155-193:
+encode a buffer in a timed loop, report bytes/second;
+qa/workunits/erasure-code/bench.sh:170 computes GiB/s).  Here the encode
+runs the fused pallas TPU kernel on stripe batches resident in HBM, with
+a device-side dependency chain between iterations so host/tunnel async
+dispatch cannot fake timings.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": GB/s, "unit": "GB/s", "vs_baseline": value/40}
+(vs_baseline: BASELINE.json's driver target is >=40 GB/s/chip.)
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ceph_tpu.models import isa_cauchy_matrix
+    from ceph_tpu.ops import rs_kernels as rk
+
+    k, m = 8, 3
+    codec = rk.BitmatrixCodec(isa_cauchy_matrix(k, m))
+    on_tpu = jax.default_backend() not in ("cpu",)
+    # 512 MiB of data on TPU; small on CPU (CI smoke).
+    S = 64 * 2**20 if on_tpu else 2**16
+    tile = 131072 if on_tpu else 4096
+
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.integers(0, 256, (k, S), dtype=np.uint8))
+    jax.block_until_ready(data)
+
+    def encode(d):
+        if on_tpu:
+            return rk.gf_bitmatmul_pallas(codec.encode_bits, d, tile_s=tile)
+        return rk.gf_bitmatmul(codec.encode_bits, d)
+
+    N = 20 if on_tpu else 2
+
+    @jax.jit
+    def chain(d):
+        def body(i, d):
+            p = encode(d)
+            # fold one parity row back into the data: forces each
+            # iteration to depend on the previous one
+            return d.at[0:1, :].set(d[0:1, :] ^ p[0:1, :])
+        return lax.fori_loop(0, N, body, d)
+
+    out = chain(data)
+    jax.block_until_ready(out)  # warm + compile
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = chain(data)
+        jax.block_until_ready(out)
+        _ = np.asarray(out[0, :8])  # host round-trip barrier
+        best = min(best, (time.perf_counter() - t0) / N)
+
+    gbs = (k * S) / best / 1e9
+    print(json.dumps({
+        "metric": "RS(8,3) erasure encode throughput, 1 chip",
+        "value": round(gbs, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(gbs / 40.0, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,18 @@
+"""Device-mesh parallelism for the storage data plane.
+
+Maps Ceph's parallelism strategies (SURVEY.md §2.9) onto a
+``jax.sharding.Mesh``:
+
+- stripe-batch data parallelism (many objects/stripes at once) —
+  the analogue of Ceph's per-PG sharded op queues and
+  ``ParallelPGMapper`` thread fan-out;
+- chunk sharding with psum-combined partial GF sums — the analogue of
+  EC shard fan-out (``MOSDECSubOpWrite`` to k+m OSDs, reference
+  src/osd/ECBackend.cc:943) when shard owners are co-located on one
+  pod slice: the XOR combine rides ICI collectives instead of TCP.
+"""
+
+from ceph_tpu.parallel.encode_farm import (  # noqa: F401
+    batch_encode_dp,
+    sharded_encode_tp,
+)

@@ -2,16 +2,36 @@
 
 Multi-chip hardware is not available in CI; sharding/collective tests run
 against ``--xla_force_host_platform_device_count=8`` as the driver's
-``dryrun_multichip`` does.  Set CEPH_TPU_TEST_REAL_DEVICE=1 to let tests
-see the real accelerator instead.
+``dryrun_multichip`` does.  Set CEPH_TPU_TEST_REAL_DEVICE=1 to target the
+real accelerator instead.
+
+The environment ships an ``.axon_site`` sitecustomize that imports jax
+and registers the TPU-tunnel PJRT plugin in every python process; when
+the tunnel is busy or down, *initializing* that backend hangs the
+process.  jax is therefore already imported when this conftest runs, but
+no backend is initialized yet — so we drop the tunnel-backed factories
+from the registry and pin the platform to cpu before any test touches
+jax.  (Env vars alone can't do this: sitecustomize runs first.)
 """
 
 import os
 
 if not os.environ.get("CEPH_TPU_TEST_REAL_DEVICE"):
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
     flags = os.environ.get("XLA_FLAGS", "")
     if "host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8"
         ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        assert not _xb._backends, (
+            "a JAX backend was initialized before conftest; CPU pinning "
+            "is no longer possible in-process"
+        )
+        _xb._backend_factories.pop("axon", None)
+        jax.config.update("jax_platforms", "cpu")
+    except ImportError:
+        pass
