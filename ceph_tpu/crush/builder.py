@@ -1,0 +1,148 @@
+"""CRUSH map construction — the CrushWrapper/builder analogue.
+
+Covers the mutation surface the control plane needs (reference:
+src/crush/builder.c, src/crush/CrushWrapper.cc): bucket creation
+(straw2/uniform/list; tree with its heap-array weights), hierarchy
+assembly, device reweighting, and the two standard rule shapes —
+replicated chooseleaf-firstn (CrushWrapper::add_simple_rule) and the
+erasure indep rule created for EC profiles
+(ErasureCode::create_rule -> add_simple_rule(..., "indep", ...),
+reference src/erasure-code/ErasureCode.cc:70-102).
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.crush.types import (
+    Bucket,
+    BucketAlg,
+    CrushMap,
+    Rule,
+    RuleOp,
+    RuleStep,
+)
+
+
+def make_bucket(
+    map_: CrushMap,
+    alg: BucketAlg,
+    type_: int,
+    items: list[int],
+    weights: list[int],
+    bucket_id: int | None = None,
+) -> Bucket:
+    """Create and add a bucket; derives the per-alg auxiliary arrays
+    (list prefix sums, tree heap weights)."""
+    if bucket_id is None:
+        bucket_id = min(map_.buckets.keys(), default=0) - 1
+    assert bucket_id < 0 and bucket_id not in map_.buckets
+    b = Bucket(id=bucket_id, type=type_, alg=alg,
+               items=list(items), item_weights=list(weights))
+    if alg == BucketAlg.LIST:
+        total = 0
+        b.sum_weights = []
+        for w in weights:
+            total += w
+            b.sum_weights.append(total)
+    elif alg == BucketAlg.TREE:
+        b.node_weights = _tree_node_weights(items, weights)
+    elif alg == BucketAlg.UNIFORM:
+        # uniform buckets carry one weight for all items
+        if weights:
+            b.item_weights = [weights[0]] * len(items)
+    map_.buckets[bucket_id] = b
+    for it in items:
+        if it >= 0:
+            map_.max_devices = max(map_.max_devices, it + 1)
+    return b
+
+
+def _tree_node_weights(items: list[int], weights: list[int]) -> list[int]:
+    """Binary-heap node weights for tree buckets (builder.c
+    crush_make_tree_bucket layout: leaves at odd indices)."""
+    n = len(items)
+    depth = max(1, (n - 1).bit_length() + 1) if n > 1 else 1
+    num_nodes = 1 << depth
+    node_weights = [0] * num_nodes
+    for j, w in enumerate(weights):
+        node_weights[(j << 1) + 1] = w
+
+    # interior sums level by level (a node with h trailing zero bits has
+    # height h; children sit +/- 2^(h-1))
+    for h in range(1, depth + 1):
+        for node in range(1 << h, num_nodes, 1 << (h + 1)):
+            left = node - (1 << (h - 1))
+            right = node + (1 << (h - 1))
+            node_weights[node] = node_weights[left] + (
+                node_weights[right] if right < num_nodes else 0
+            )
+    return node_weights
+
+
+def build_hierarchy(
+    map_: CrushMap,
+    osds_per_host: int,
+    n_hosts: int,
+    osd_weight: int = 0x10000,
+    alg: BucketAlg = BucketAlg.STRAW2,
+    host_type: int = 1,
+    root_type: int = 10,
+) -> Bucket:
+    """Standard root -> host -> osd tree; returns the root bucket."""
+    host_ids = []
+    host_weights = []
+    for h in range(n_hosts):
+        osds = list(range(h * osds_per_host, (h + 1) * osds_per_host))
+        hb = make_bucket(map_, alg, host_type, osds, [osd_weight] * osds_per_host)
+        host_ids.append(hb.id)
+        host_weights.append(hb.weight)
+    root = make_bucket(map_, alg, root_type, host_ids, host_weights)
+    return root
+
+
+def add_simple_rule(
+    map_: CrushMap,
+    root_id: int,
+    failure_domain_type: int,
+    rule_type: int = 1,
+    mode: str = "firstn",
+    rule_id: int | None = None,
+) -> int:
+    """CrushWrapper::add_simple_rule: take root; chooseleaf <mode> 0
+    <failure-domain>; emit.  ``mode='indep'`` with rule_type=3 is the
+    shape EC profiles create (ErasureCode.cc:76-100)."""
+    if rule_id is None:
+        rule_id = max(map_.rules.keys(), default=-1) + 1
+    steps = []
+    if mode == "indep":
+        steps.append(RuleStep(RuleOp.SET_CHOOSELEAF_TRIES, 5, 0))
+    steps.append(RuleStep(RuleOp.TAKE, root_id, 0))
+    op = RuleOp.CHOOSELEAF_FIRSTN if mode == "firstn" else RuleOp.CHOOSELEAF_INDEP
+    if failure_domain_type == 0:
+        op = RuleOp.CHOOSE_FIRSTN if mode == "firstn" else RuleOp.CHOOSE_INDEP
+    steps.append(RuleStep(op, 0, failure_domain_type))
+    steps.append(RuleStep(RuleOp.EMIT, 0, 0))
+    map_.rules[rule_id] = Rule(rule_type=rule_type, steps=steps)
+    return rule_id
+
+
+def add_osd_multi_per_domain_rule(
+    map_: CrushMap,
+    root_id: int,
+    failure_domain_type: int,
+    num_per_domain: int,
+    rule_type: int = 3,
+    rule_id: int | None = None,
+) -> int:
+    """CrushWrapper::add_indep_multi_osd_per_failure_domain_rule — the
+    LRC-style two-level indep rule: choose indep n/d domains, then
+    chooseleaf indep d osds in each."""
+    if rule_id is None:
+        rule_id = max(map_.rules.keys(), default=-1) + 1
+    map_.rules[rule_id] = Rule(rule_type=rule_type, steps=[
+        RuleStep(RuleOp.SET_CHOOSELEAF_TRIES, 5, 0),
+        RuleStep(RuleOp.TAKE, root_id, 0),
+        RuleStep(RuleOp.CHOOSE_INDEP, 0, failure_domain_type),
+        RuleStep(RuleOp.CHOOSELEAF_INDEP, num_per_domain, 0),
+        RuleStep(RuleOp.EMIT, 0, 0),
+    ])
+    return rule_id

@@ -1,0 +1,137 @@
+"""CRUSH map data model.
+
+Behavioral twin of the reference map model (src/crush/crush.h: struct
+crush_map / crush_bucket_* / crush_rule), re-expressed as plain Python
+dataclasses (host control plane) that compile to dense arrays for the
+batched TPU engine (ceph_tpu/crush/jaxmapper.py).
+
+Weights are 16.16 fixed point (0x10000 == 1.0) exactly as in the
+reference; bucket ids are negative, devices non-negative.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from dataclasses import dataclass, field
+
+
+class BucketAlg(enum.IntEnum):
+    # values match crush.h CRUSH_BUCKET_*
+    UNIFORM = 1
+    LIST = 2
+    TREE = 3
+    STRAW = 4
+    STRAW2 = 5
+
+
+class RuleOp(enum.IntEnum):
+    # values match crush.h CRUSH_RULE_* step opcodes
+    NOOP = 0
+    TAKE = 1
+    CHOOSE_FIRSTN = 2
+    CHOOSE_INDEP = 3
+    EMIT = 4
+    CHOOSELEAF_FIRSTN = 6
+    CHOOSELEAF_INDEP = 7
+    SET_CHOOSE_TRIES = 8
+    SET_CHOOSELEAF_TRIES = 9
+    SET_CHOOSE_LOCAL_TRIES = 10
+    SET_CHOOSE_LOCAL_FALLBACK_TRIES = 11
+    SET_CHOOSELEAF_VARY_R = 12
+    SET_CHOOSELEAF_STABLE = 13
+
+
+CRUSH_ITEM_UNDEF = 0x7FFFFFFE  # mid-choose reservation (crush.h)
+CRUSH_ITEM_NONE = 0x7FFFFFFF   # permanent hole, EC positional
+CRUSH_HASH_RJENKINS1 = 0
+
+
+@dataclass
+class Bucket:
+    """One interior node.  ``weight``/``item_weights`` are 16.16 fixed."""
+
+    id: int                      # negative
+    type: int                    # user-defined type id (host/rack/root...)
+    alg: BucketAlg = BucketAlg.STRAW2
+    hash: int = CRUSH_HASH_RJENKINS1
+    items: list[int] = field(default_factory=list)
+    item_weights: list[int] = field(default_factory=list)
+    # legacy-alg extras:
+    sum_weights: list[int] = field(default_factory=list)   # LIST prefix sums
+    node_weights: list[int] = field(default_factory=list)  # TREE heap array
+    straws: list[int] = field(default_factory=list)        # STRAW scaled draws
+
+    @property
+    def size(self) -> int:
+        return len(self.items)
+
+    @property
+    def weight(self) -> int:
+        return sum(self.item_weights)
+
+
+@dataclass
+class RuleStep:
+    op: RuleOp
+    arg1: int = 0
+    arg2: int = 0
+
+
+@dataclass
+class Rule:
+    rule_type: int               # pg_pool type: 1 replicated / 3 erasure
+    steps: list[RuleStep] = field(default_factory=list)
+
+
+@dataclass
+class Tunables:
+    """Defaults == the reference's "jewel" optimal profile, the modern
+    default (src/crush/crush.c set_optimal_crush_map / CrushWrapper
+    set_tunables_jewel)."""
+
+    choose_local_tries: int = 0
+    choose_local_fallback_tries: int = 0
+    choose_total_tries: int = 50
+    chooseleaf_descend_once: int = 1
+    chooseleaf_vary_r: int = 1
+    chooseleaf_stable: int = 1
+
+
+@dataclass
+class ChooseArg:
+    """Per-bucket weight_set/ids overrides (pg-upmap balancer machinery,
+    src/crush/crush.h struct crush_choose_arg)."""
+
+    bucket_id: int
+    weight_set: list[list[int]] | None = None  # [position][item] 16.16
+    ids: list[int] | None = None
+
+
+@dataclass
+class CrushMap:
+    buckets: dict[int, Bucket] = field(default_factory=dict)  # by id (negative)
+    rules: dict[int, Rule] = field(default_factory=dict)
+    types: dict[int, str] = field(default_factory=lambda: {0: "osd", 1: "host", 10: "root"})
+    max_devices: int = 0
+    tunables: Tunables = field(default_factory=Tunables)
+    choose_args: dict[int, ChooseArg] = field(default_factory=dict)
+
+    def bucket(self, bid: int) -> Bucket:
+        return self.buckets[bid]
+
+    def copy(self) -> "CrushMap":
+        return dataclasses.replace(
+            self,
+            buckets={k: dataclasses.replace(
+                v,
+                items=list(v.items), item_weights=list(v.item_weights),
+                sum_weights=list(v.sum_weights),
+                node_weights=list(v.node_weights), straws=list(v.straws),
+            ) for k, v in self.buckets.items()},
+            rules={k: Rule(v.rule_type, [dataclasses.replace(s) for s in v.steps])
+                   for k, v in self.rules.items()},
+            types=dict(self.types),
+            tunables=dataclasses.replace(self.tunables),
+            choose_args=dict(self.choose_args),
+        )

@@ -1,0 +1,3 @@
+/* stub for standalone oracle build */
+#define HAVE_LINUX_TYPES_H 1
+#define HAVE_STDINT_H 1

@@ -1,0 +1,14 @@
+#!/bin/sh
+# Build the CRUSH golden-vector oracle against the read-only reference
+# tree and regenerate tests/golden/crush_vectors.json.
+set -e
+cd "$(dirname "$0")"
+REF=/root/reference/src
+BUILD=./build
+mkdir -p "$BUILD" ../../tests/golden
+gcc -O1 -o "$BUILD/crush_oracle" crush_oracle.c \
+    "$REF/crush/crush.c" "$REF/crush/mapper.c" "$REF/crush/builder.c" \
+    "$REF/crush/hash.c" \
+    -I. -I"$REF" -I"$REF/crush" -I"$REF/include" -lm
+"$BUILD/crush_oracle" > ../../tests/golden/crush_vectors.json
+echo "wrote tests/golden/crush_vectors.json"

@@ -1,0 +1,158 @@
+/* Golden-vector generator: runs the reference's in-tree pure-C CRUSH
+ * (compiled read-only from /root/reference/src/crush/) over a family of
+ * maps and dumps placements as JSON.  The vectors (tests/golden/*.json)
+ * pin ceph_tpu's re-implementation to bit-identical placement; this
+ * file links against the reference, it copies nothing into the
+ * framework.  Build: tools/golden/build_oracle.sh
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "crush/crush.h"
+#include "builder.h"
+#include "mapper.h"
+#include "hash.h"
+
+static void set_jewel_tunables(struct crush_map *m) {
+    m->choose_local_tries = 0;
+    m->choose_local_fallback_tries = 0;
+    m->choose_total_tries = 50;
+    m->chooseleaf_descend_once = 1;
+    m->chooseleaf_vary_r = 1;
+    m->chooseleaf_stable = 1;
+}
+
+/* root -> n_hosts hosts -> osds_per_host osds, all weight 1.0 */
+static struct crush_map *make_map(int alg, int n_hosts, int osds_per_host,
+                                  int *root_out) {
+    struct crush_map *m = crush_create();
+    set_jewel_tunables(m);
+    int *host_ids = malloc(sizeof(int) * n_hosts);
+    int *host_w = malloc(sizeof(int) * n_hosts);
+    for (int h = 0; h < n_hosts; h++) {
+        int items[64], weights[64];
+        for (int i = 0; i < osds_per_host; i++) {
+            items[i] = h * osds_per_host + i;
+            weights[i] = 0x10000;
+        }
+        struct crush_bucket *hb = crush_make_bucket(
+            m, alg, CRUSH_HASH_RJENKINS1, 1 /*host*/, osds_per_host,
+            items, weights);
+        crush_add_bucket(m, 0, hb, &host_ids[h]);
+        host_w[h] = hb->weight;
+    }
+    struct crush_bucket *root = crush_make_bucket(
+        m, alg, CRUSH_HASH_RJENKINS1, 10 /*root*/, n_hosts, host_ids, host_w);
+    int root_id;
+    crush_add_bucket(m, 0, root, &root_id);
+    crush_finalize(m);
+    *root_out = root_id;
+    return m;
+}
+
+static int add_rule(struct crush_map *m, int root, int op_leaf, int domain,
+                    int set_leaf_tries) {
+    int nsteps = set_leaf_tries ? 4 : 3;
+    struct crush_rule *r = crush_make_rule(nsteps, 1);
+    int p = 0;
+    if (set_leaf_tries)
+        crush_rule_set_step(r, p++, CRUSH_RULE_SET_CHOOSELEAF_TRIES, 5, 0);
+    crush_rule_set_step(r, p++, CRUSH_RULE_TAKE, root, 0);
+    crush_rule_set_step(r, p++, op_leaf, 0, domain);
+    crush_rule_set_step(r, p++, CRUSH_RULE_EMIT, 0, 0);
+    return crush_add_rule(m, r, -1);
+}
+
+static void run(struct crush_map *m, int ruleno, int n_x, int result_max,
+                const __u32 *weight, int weight_max, const char *label,
+                int first) {
+    void *cw = malloc(crush_work_size(m, result_max));
+    int *result = malloc(sizeof(int) * result_max);
+    if (!first) printf(",\n");
+    printf("  \"%s\": [", label);
+    for (int x = 0; x < n_x; x++) {
+        crush_init_workspace(m, cw);
+        int len = crush_do_rule(m, ruleno, x, result, result_max,
+                                weight, weight_max, cw, NULL);
+        printf("%s[", x ? "," : "");
+        for (int i = 0; i < len; i++)
+            printf("%s%d", i ? "," : "", result[i]);
+        printf("]");
+    }
+    printf("]");
+    free(cw); free(result);
+}
+
+int main(void) {
+    printf("{\n");
+    int first = 1;
+    /* scenario family: alg x (firstn|indep) x (host|osd domain) */
+    struct { int alg; const char *name; } algs[] = {
+        {CRUSH_BUCKET_STRAW2, "straw2"},
+        {CRUSH_BUCKET_UNIFORM, "uniform"},
+        {CRUSH_BUCKET_LIST, "list"},
+        {CRUSH_BUCKET_TREE, "tree"},
+    };
+    for (unsigned a = 0; a < sizeof(algs)/sizeof(algs[0]); a++) {
+        int root;
+        struct crush_map *m = make_map(algs[a].alg, 5, 4, &root);
+        __u32 weight[20];
+        for (int i = 0; i < 20; i++) weight[i] = 0x10000;
+        char label[128];
+
+        int r1 = add_rule(m, root, CRUSH_RULE_CHOOSELEAF_FIRSTN, 1, 0);
+        snprintf(label, sizeof label, "%s_chooseleaf_firstn_host", algs[a].name);
+        run(m, r1, 64, 3, weight, 20, label, first); first = 0;
+
+        int r2 = add_rule(m, root, CRUSH_RULE_CHOOSELEAF_INDEP, 1, 1);
+        snprintf(label, sizeof label, "%s_chooseleaf_indep_host", algs[a].name);
+        run(m, r2, 64, 4, weight, 20, label, 0);
+
+        int r3 = add_rule(m, root, CRUSH_RULE_CHOOSE_INDEP, 0, 1);
+        snprintf(label, sizeof label, "%s_choose_indep_osd", algs[a].name);
+        run(m, r3, 64, 6, weight, 20, label, 0);
+
+        /* degraded: some osds reweighted/out */
+        weight[3] = 0; weight[7] = 0x8000; weight[12] = 0x4000;
+        snprintf(label, sizeof label, "%s_indep_osd_degraded", algs[a].name);
+        run(m, r3, 64, 6, weight, 20, label, 0);
+        snprintf(label, sizeof label, "%s_firstn_host_degraded", algs[a].name);
+        run(m, r1, 64, 3, weight, 20, label, 0);
+
+        /* two-level rule: choose 3 hosts, 2 osds in each (wsize>1 at the
+         * second choose step -- exercises the offset output windows) */
+        {
+            struct crush_rule *r = crush_make_rule(5, 3);
+            crush_rule_set_step(r, 0, CRUSH_RULE_SET_CHOOSELEAF_TRIES, 5, 0);
+            crush_rule_set_step(r, 1, CRUSH_RULE_TAKE, root, 0);
+            crush_rule_set_step(r, 2, CRUSH_RULE_CHOOSE_INDEP, 3, 1);
+            crush_rule_set_step(r, 3, CRUSH_RULE_CHOOSELEAF_INDEP, 2, 0);
+            crush_rule_set_step(r, 4, CRUSH_RULE_EMIT, 0, 0);
+            int r4 = crush_add_rule(m, r, -1);
+            for (int i = 0; i < 20; i++) weight[i] = 0x10000;
+            snprintf(label, sizeof label, "%s_two_level", algs[a].name);
+            run(m, r4, 64, 6, weight, 20, label, 0);
+            weight[3] = 0; weight[7] = 0x8000;
+            snprintf(label, sizeof label, "%s_two_level_degraded", algs[a].name);
+            run(m, r4, 64, 6, weight, 20, label, 0);
+        }
+        crush_destroy(m);
+    }
+    /* hash vectors */
+    printf(",\n  \"hash32_3\": [");
+    for (int i = 0; i < 32; i++) {
+        __u32 h = crush_hash32_3(CRUSH_HASH_RJENKINS1,
+                                 (__u32)(i * 2654435761u),
+                                 (__u32)(i ^ 0x55aa), (__u32)i);
+        printf("%s%u", i ? "," : "", h);
+    }
+    printf("],\n  \"hash32_2\": [");
+    for (int i = 0; i < 32; i++) {
+        __u32 h = crush_hash32_2(CRUSH_HASH_RJENKINS1,
+                                 (__u32)(i * 40503u), (__u32)(i + 7));
+        printf("%s%u", i ? "," : "", h);
+    }
+    printf("]\n}\n");
+    return 0;
+}
