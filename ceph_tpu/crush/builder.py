@@ -93,9 +93,11 @@ def build_hierarchy(
     for h in range(n_hosts):
         osds = list(range(h * osds_per_host, (h + 1) * osds_per_host))
         hb = make_bucket(map_, alg, host_type, osds, [osd_weight] * osds_per_host)
+        map_.bucket_names.setdefault(f"host{h}", hb.id)
         host_ids.append(hb.id)
         host_weights.append(hb.weight)
     root = make_bucket(map_, alg, root_type, host_ids, host_weights)
+    map_.bucket_names.setdefault("default", root.id)
     return root
 
 
@@ -106,10 +108,12 @@ def add_simple_rule(
     rule_type: int = 1,
     mode: str = "firstn",
     rule_id: int | None = None,
+    num: int = 0,
 ) -> int:
-    """CrushWrapper::add_simple_rule: take root; chooseleaf <mode> 0
-    <failure-domain>; emit.  ``mode='indep'`` with rule_type=3 is the
-    shape EC profiles create (ErasureCode.cc:76-100)."""
+    """CrushWrapper::add_simple_rule: take root; chooseleaf <mode> <num>
+    <failure-domain>; emit.  ``num=0`` selects pool-size items;
+    ``mode='indep'`` with rule_type=3 is the shape EC profiles create
+    (ErasureCode.cc:76-100)."""
     if rule_id is None:
         rule_id = max(map_.rules.keys(), default=-1) + 1
     steps = []
@@ -119,10 +123,62 @@ def add_simple_rule(
     op = RuleOp.CHOOSELEAF_FIRSTN if mode == "firstn" else RuleOp.CHOOSELEAF_INDEP
     if failure_domain_type == 0:
         op = RuleOp.CHOOSE_FIRSTN if mode == "firstn" else RuleOp.CHOOSE_INDEP
-    steps.append(RuleStep(op, 0, failure_domain_type))
+    steps.append(RuleStep(op, num, failure_domain_type))
     steps.append(RuleStep(RuleOp.EMIT, 0, 0))
     map_.rules[rule_id] = Rule(rule_type=rule_type, steps=steps)
     return rule_id
+
+
+def set_device_class(map_: CrushMap, osd: int, device_class: str) -> None:
+    """Tag an OSD with a device class (CrushWrapper class_map analogue);
+    class-restricted rules select only matching OSDs."""
+    map_.device_classes[osd] = device_class
+
+
+def create_ec_rule(
+    map_: CrushMap,
+    name: str,
+    root_name: str = "default",
+    failure_domain: str = "host",
+    num_failure_domains: int = 0,
+    osds_per_failure_domain: int = 0,
+    device_class: str | None = None,
+    mode: str = "indep",
+) -> int:
+    """Name-resolving EC rule creation — the seam
+    ErasureCode::create_rule drives (reference ErasureCode.cc:70-102 →
+    CrushWrapper::add_simple_rule / add_indep_multi_osd_per_failure_
+    domain_rule).  Returns the new rule id; registers ``name``.
+
+    ``device_class`` restricts choice to OSDs of that class.  The
+    reference materializes per-class shadow hierarchies
+    (CrushWrapper::populate_classes); here class filtering is applied by
+    the mapper via per-device class membership (same resulting OSD set).
+    """
+    if name in map_.rule_names:
+        raise ValueError(f"rule {name!r} already exists")
+    if root_name not in map_.bucket_names:
+        raise LookupError(f"root item {root_name!r} does not exist")
+    root_id = map_.bucket_names[root_name]
+    try:
+        fd_type = map_.type_id(failure_domain)
+    except KeyError:
+        raise LookupError(f"unknown type {failure_domain!r}") from None
+    if osds_per_failure_domain <= 1:
+        rid = add_simple_rule(
+            map_, root_id, fd_type,
+            rule_type=3, mode=mode, num=num_failure_domains,
+        )
+    else:
+        rid = add_osd_multi_per_domain_rule(
+            map_, root_id, fd_type,
+            num_per_domain=osds_per_failure_domain,
+            num_domains=num_failure_domains,
+        )
+    if device_class:
+        map_.rules[rid].device_class = device_class
+    map_.rule_names[name] = rid
+    return rid
 
 
 def add_osd_multi_per_domain_rule(
@@ -132,16 +188,17 @@ def add_osd_multi_per_domain_rule(
     num_per_domain: int,
     rule_type: int = 3,
     rule_id: int | None = None,
+    num_domains: int = 0,
 ) -> int:
     """CrushWrapper::add_indep_multi_osd_per_failure_domain_rule — the
-    LRC-style two-level indep rule: choose indep n/d domains, then
-    chooseleaf indep d osds in each."""
+    LRC-style two-level indep rule: choose indep <num_domains> domains,
+    then chooseleaf indep <num_per_domain> osds in each."""
     if rule_id is None:
         rule_id = max(map_.rules.keys(), default=-1) + 1
     map_.rules[rule_id] = Rule(rule_type=rule_type, steps=[
         RuleStep(RuleOp.SET_CHOOSELEAF_TRIES, 5, 0),
         RuleStep(RuleOp.TAKE, root_id, 0),
-        RuleStep(RuleOp.CHOOSE_INDEP, 0, failure_domain_type),
+        RuleStep(RuleOp.CHOOSE_INDEP, num_domains, failure_domain_type),
         RuleStep(RuleOp.CHOOSELEAF_INDEP, num_per_domain, 0),
         RuleStep(RuleOp.EMIT, 0, 0),
     ])

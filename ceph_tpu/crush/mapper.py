@@ -392,6 +392,16 @@ def crush_do_rule(
     rule = map_.rules[ruleno]
     if weights is None:
         weights = [0x10000] * map_.max_devices
+    if rule.device_class is not None:
+        # class-restricted rule: OSDs of other classes get weight 0,
+        # which is_out() rejects — selecting exactly the same OSD set
+        # the reference reaches via per-class shadow hierarchies
+        # (CrushWrapper::populate_classes); draw order may differ from
+        # the shadow-tree draw, which is fine for a from-scratch map.
+        weights = [
+            w if map_.device_classes.get(osd) == rule.device_class else 0
+            for osd, w in enumerate(weights)
+        ]
     t = map_.tunables
     work = _Work()
 

@@ -82,6 +82,10 @@ class RuleStep:
 class Rule:
     rule_type: int               # pg_pool type: 1 replicated / 3 erasure
     steps: list[RuleStep] = field(default_factory=list)
+    # restrict selection to OSDs of this device class (the reference
+    # rewrites TAKE args to per-class shadow buckets; we filter by class
+    # membership in the mapper — same resulting OSD set)
+    device_class: str | None = None
 
 
 @dataclass
@@ -116,9 +120,19 @@ class CrushMap:
     max_devices: int = 0
     tunables: Tunables = field(default_factory=Tunables)
     choose_args: dict[int, ChooseArg] = field(default_factory=dict)
+    # name tables (CrushWrapper name_map/rule_name_map, class_map)
+    bucket_names: dict[str, int] = field(default_factory=dict)
+    rule_names: dict[str, int] = field(default_factory=dict)
+    device_classes: dict[int, str] = field(default_factory=dict)  # osd -> class
 
     def bucket(self, bid: int) -> Bucket:
         return self.buckets[bid]
+
+    def type_id(self, name: str) -> int:
+        for tid, tname in self.types.items():
+            if tname == name:
+                return tid
+        raise KeyError(f"unknown CRUSH type {name!r}")
 
     def copy(self) -> "CrushMap":
         return dataclasses.replace(
@@ -129,9 +143,13 @@ class CrushMap:
                 sum_weights=list(v.sum_weights),
                 node_weights=list(v.node_weights), straws=list(v.straws),
             ) for k, v in self.buckets.items()},
-            rules={k: Rule(v.rule_type, [dataclasses.replace(s) for s in v.steps])
+            rules={k: Rule(v.rule_type, [dataclasses.replace(s) for s in v.steps],
+                           v.device_class)
                    for k, v in self.rules.items()},
             types=dict(self.types),
             tunables=dataclasses.replace(self.tunables),
             choose_args=dict(self.choose_args),
+            bucket_names=dict(self.bucket_names),
+            rule_names=dict(self.rule_names),
+            device_classes=dict(self.device_classes),
         )

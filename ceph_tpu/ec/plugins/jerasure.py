@@ -1,0 +1,246 @@
+"""jerasure-compatible plugin.
+
+Behavioral twin of the reference jerasure plugin
+(src/erasure-code/jerasure/ErasureCodeJerasure.{h,cc},
+ErasureCodePluginJerasure.cc): techniques ``reed_sol_van``,
+``reed_sol_r6_op``, ``cauchy_orig``, ``cauchy_good`` with the same
+profile keys (k/m/w/packetsize/jerasure-per-chunk-alignment), default
+parameters, chunk-size/alignment math (ErasureCodeJerasure.cc:80-103,
+174-186, 278-292) and chunk byte layout:
+
+- reed_sol techniques: GF(2^8) byte-stream matmul
+  (jerasure_matrix_encode);
+- cauchy techniques: packet-row XOR schedules
+  (jerasure_schedule_encode with w x w bit-matrix blocks and
+  ``packetsize`` rows) — see matrix_base for why that is the same TPU
+  kernel.
+
+The bit-matrix techniques with w != 8 (liberation, blaum_roth,
+liber8tion) operate over GF(2^w) words and are provided by the
+``liberation`` technique family once GF(2^w) tables land; they raise
+EINVAL with a clear message for now (the reference's own default
+technique set — reed_sol_van — is fully covered).
+"""
+
+from __future__ import annotations
+
+import errno
+
+import numpy as np
+
+from ceph_tpu.ec.interface import ECError
+from ceph_tpu.ec.plugins.matrix_base import MatrixErasureCode
+from ceph_tpu.models.matrices import (
+    cauchy_good_matrix,
+    cauchy_original_matrix,
+    jerasure_rs_r6_matrix,
+    jerasure_rs_vandermonde_matrix,
+)
+from ceph_tpu.ops.gf256 import gf_matrix_to_bitmatrix
+
+__erasure_code_version__ = "0.1.0"
+
+#: reference LARGEST_VECTOR_WORDSIZE (ErasureCodeJerasure.cc)
+LARGEST_VECTOR_WORDSIZE = 16
+
+DEFAULT_PACKETSIZE = "2048"
+
+
+class ErasureCodeJerasure(MatrixErasureCode):
+    """Common profile parsing (ErasureCodeJerasure.cc:62-78)."""
+
+    DEFAULT_K = "2"
+    DEFAULT_M = "1"
+    DEFAULT_W = "8"
+    technique = "?"
+
+    def parse(self, profile: dict) -> None:
+        super().parse(profile)
+        self.k = self.to_int("k", profile, self.DEFAULT_K)
+        self.m = self.to_int("m", profile, self.DEFAULT_M)
+        self.w = self.to_int("w", profile, self.DEFAULT_W)
+        if self.chunk_mapping and len(self.chunk_mapping) != self.k + self.m:
+            self.chunk_mapping = []
+            raise ECError(
+                errno.EINVAL,
+                f"mapping {profile.get('mapping')!r} maps "
+                f"{len(profile.get('mapping', ''))} chunks instead of "
+                f"the expected {self.k + self.m}",
+            )
+        self.sanity_check_k_m(self.k, self.m)
+        self._parse_technique(profile)
+        self._prepare()
+
+    def _parse_technique(self, profile: dict) -> None:
+        pass
+
+    def _prepare(self) -> None:
+        raise NotImplementedError
+
+    def get_alignment(self) -> int:
+        raise NotImplementedError
+
+    def get_chunk_size(self, object_size: int) -> int:
+        """ErasureCodeJerasure.cc:80-103."""
+        alignment = self.get_alignment()
+        if self.per_chunk_alignment:
+            chunk_size = -(-object_size // self.k)
+            if alignment > chunk_size:
+                chunk_size = alignment
+            modulo = chunk_size % alignment
+            if modulo:
+                chunk_size += alignment - modulo
+            return chunk_size
+        tail = object_size % alignment
+        padded = object_size + (alignment - tail if tail else 0)
+        assert padded % self.k == 0
+        return padded // self.k
+
+
+class ReedSolomonVandermonde(ErasureCodeJerasure):
+    """technique=reed_sol_van (ErasureCodeJerasure.cc:158-201)."""
+
+    DEFAULT_K = "7"
+    DEFAULT_M = "3"
+    technique = "reed_sol_van"
+
+    def _parse_technique(self, profile: dict) -> None:
+        if self.w not in (8, 16, 32):
+            raise ECError(
+                errno.EINVAL, f"reed_sol_van: w={self.w} must be one of {{8, 16, 32}}"
+            )
+        if self.w != 8:
+            raise ECError(
+                errno.EINVAL,
+                f"reed_sol_van: w={self.w} needs GF(2^{self.w}) tables not yet "
+                "built in ceph_tpu; use w=8 (the reference default)",
+            )
+        self.per_chunk_alignment = self.to_bool(
+            "jerasure-per-chunk-alignment", profile, "false"
+        )
+
+    def _prepare(self) -> None:
+        self.prepare(jerasure_rs_vandermonde_matrix(self.k, self.m))
+
+    def get_alignment(self) -> int:
+        """ErasureCodeJerasure.cc:174-186."""
+        if self.per_chunk_alignment:
+            return self.w * LARGEST_VECTOR_WORDSIZE
+        alignment = self.k * self.w * 4  # sizeof(int)
+        if (self.w * 4) % LARGEST_VECTOR_WORDSIZE:
+            alignment = self.k * self.w * LARGEST_VECTOR_WORDSIZE
+        return alignment
+
+
+class ReedSolomonRAID6(ReedSolomonVandermonde):
+    """technique=reed_sol_r6_op (ErasureCodeJerasure.cc:203-257)."""
+
+    DEFAULT_K = "7"
+    DEFAULT_M = "2"
+    technique = "reed_sol_r6_op"
+
+    def _parse_technique(self, profile: dict) -> None:
+        if self.m != 2:
+            raise ECError(errno.EINVAL, f"reed_sol_r6_op: m={self.m} must be 2 for RAID6")
+        super()._parse_technique(profile)
+
+    def _prepare(self) -> None:
+        self.prepare(jerasure_rs_r6_matrix(self.k))
+
+
+class CauchyBase(ErasureCodeJerasure):
+    """Packet-layout bitmatrix cauchy (ErasureCodeJerasure.cc:259-305)."""
+
+    DEFAULT_K = "7"
+    DEFAULT_M = "3"
+
+    def _parse_technique(self, profile: dict) -> None:
+        if self.w != 8:
+            raise ECError(
+                errno.EINVAL,
+                f"{self.technique}: w={self.w} unsupported here; the reference "
+                "default (and the only value the byte-level corpus pins) is 8",
+            )
+        self.packetsize = self.to_int("packetsize", profile, DEFAULT_PACKETSIZE)
+        if self.packetsize % 4:
+            raise ECError(errno.EINVAL, "packetsize must be a multiple of 4")
+        self.per_chunk_alignment = self.to_bool(
+            "jerasure-per-chunk-alignment", profile, "false"
+        )
+
+    def _cauchy_matrix(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def _prepare(self) -> None:
+        # jerasure_matrix_to_bitmatrix: (m*w, k*w) 0/1 expansion; the
+        # schedule's packet XORs == GF(2^8) matmul by the 0/1 matrix.
+        bits = gf_matrix_to_bitmatrix(self._cauchy_matrix())
+        self.prepare(bits, rows_per_chunk=self.w)
+
+    def get_alignment(self) -> int:
+        """ErasureCodeJerasure.cc:278-292."""
+        if self.per_chunk_alignment:
+            alignment = self.w * self.packetsize
+            modulo = alignment % LARGEST_VECTOR_WORDSIZE
+            if modulo:
+                alignment += LARGEST_VECTOR_WORDSIZE - modulo
+            return alignment
+        alignment = self.k * self.w * self.packetsize * 4
+        if (self.w * self.packetsize * 4) % LARGEST_VECTOR_WORDSIZE:
+            alignment = self.k * self.w * self.packetsize * LARGEST_VECTOR_WORDSIZE
+        return alignment
+
+
+class CauchyOrig(CauchyBase):
+    technique = "cauchy_orig"
+
+    def _cauchy_matrix(self) -> np.ndarray:
+        return cauchy_original_matrix(self.k, self.m)
+
+
+class CauchyGood(CauchyBase):
+    technique = "cauchy_good"
+
+    def _cauchy_matrix(self) -> np.ndarray:
+        return cauchy_good_matrix(self.k, self.m)
+
+
+TECHNIQUES = {
+    "reed_sol_van": ReedSolomonVandermonde,
+    "reed_sol_r6_op": ReedSolomonRAID6,
+    "cauchy_orig": CauchyOrig,
+    "cauchy_good": CauchyGood,
+}
+
+_UNSUPPORTED = ("liberation", "blaum_roth", "liber8tion")
+
+
+def _make(profile: dict):
+    technique = profile.get("technique", "reed_sol_van")
+    if technique in _UNSUPPORTED:
+        raise ECError(
+            errno.EINVAL,
+            f"technique={technique} (GF(2^w) minimal-density bitmatrix family) "
+            "is not yet available in ceph_tpu",
+        )
+    cls = TECHNIQUES.get(technique)
+    if cls is None:
+        raise ECError(
+            errno.ENOENT,
+            f"technique={technique} is not a valid coding technique. Choose one of "
+            "reed_sol_van, reed_sol_r6_op, cauchy_orig, cauchy_good",
+        )
+    profile.setdefault("technique", technique)
+    return cls()
+
+
+def __erasure_code_init__(name: str, registry) -> None:
+    from ceph_tpu.ec.registry import ErasureCodePlugin
+
+    class JerasurePlugin(ErasureCodePlugin):
+        def factory(self, profile: dict):
+            ec = _make(profile)
+            ec.init(profile)
+            return ec
+
+    registry.add(name, JerasurePlugin())

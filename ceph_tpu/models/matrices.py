@@ -119,6 +119,17 @@ def jerasure_rs_vandermonde_matrix(k: int, m: int) -> np.ndarray:
     return _big_vandermonde_distribution_matrix(k + m, k)[k:, :]
 
 
+def jerasure_rs_r6_matrix(k: int) -> np.ndarray:
+    """jerasure ``reed_sol_r6_coding_matrix(k, w)``: the RAID6 P/Q pair —
+    row 0 all ones (P = XOR), row 1 the geometric sequence 2^j (Q).
+    Used by the reed_sol_r6_op technique (ErasureCodeJerasure.cc:255)."""
+    _check_km(k, 2)
+    C = np.ones((2, k), dtype=np.uint8)
+    for j in range(1, k):
+        C[1, j] = gf_mul(C[1, j - 1], np.uint8(2))
+    return C
+
+
 def cauchy_original_matrix(k: int, m: int) -> np.ndarray:
     """jerasure ``cauchy_original_coding_matrix``: C[i,j] = 1/(i ^ (m+j))."""
     _check_km(k, m)

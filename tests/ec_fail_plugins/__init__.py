@@ -1,0 +1,4 @@
+"""Failure-mode test plugins for the EC registry, mirroring the
+reference's ErasureCodePluginFailToInitialize / FailToRegister /
+MissingEntryPoint / MissingVersion fixtures
+(src/test/erasure-code/ErasureCodePlugin*.cc)."""

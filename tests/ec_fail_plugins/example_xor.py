@@ -1,0 +1,42 @@
+"""Minimal k=2 m=1 XOR plugin — the ErasureCodeExample analogue
+(src/test/erasure-code/ErasureCodeExample.h), used by registry tests."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ceph_tpu.ec.interface import ErasureCode
+
+__erasure_code_version__ = "0.1.0"
+
+
+class ExampleXor(ErasureCode):
+    def get_chunk_count(self) -> int:
+        return 3
+
+    def get_data_chunk_count(self) -> int:
+        return 2
+
+    def get_chunk_size(self, object_size: int) -> int:
+        return -(-object_size // 2)
+
+    def encode_chunks(self, want_to_encode, encoded) -> None:
+        encoded[2][...] = encoded[0] ^ encoded[1]
+
+    def decode_chunks(self, want_to_read, chunks, decoded) -> None:
+        missing = [i for i in range(3) if i not in chunks]
+        for i in missing:
+            others = [decoded[j] for j in range(3) if j != i]
+            decoded[i][...] = np.bitwise_xor(*others)
+
+
+def __erasure_code_init__(name, registry):
+    from ceph_tpu.ec.registry import ErasureCodePlugin
+
+    class XorPlugin(ErasureCodePlugin):
+        def factory(self, profile):
+            ec = ExampleXor()
+            ec.init(profile)
+            return ec
+
+    registry.add(name, XorPlugin())

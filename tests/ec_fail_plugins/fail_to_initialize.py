@@ -1,0 +1,11 @@
+"""__erasure_code_init__ raises — EIO (FailToInitialize fixture)."""
+
+import errno
+
+from ceph_tpu.ec.interface import ECError
+
+__erasure_code_version__ = "0.1.0"
+
+
+def __erasure_code_init__(name, registry):
+    raise ECError(errno.ESRCH, "I failed to initialize")

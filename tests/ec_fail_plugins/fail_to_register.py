@@ -1,0 +1,7 @@
+"""__erasure_code_init__ returns without registering — EBADF."""
+
+__erasure_code_version__ = "0.1.0"
+
+
+def __erasure_code_init__(name, registry):
+    return None

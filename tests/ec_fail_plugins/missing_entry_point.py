@@ -1,0 +1,3 @@
+"""Has a version but no __erasure_code_init__ — ENOENT."""
+
+__erasure_code_version__ = "0.1.0"

@@ -1,0 +1,6 @@
+"""No __erasure_code_version__ — registry must refuse with EXDEV
+(ErasureCodePlugin.cc 'an older version' path)."""
+
+
+def __erasure_code_init__(name, registry):  # pragma: no cover
+    raise AssertionError("must not be called")

@@ -1,0 +1,361 @@
+"""EC plugin framework tests.
+
+Mirrors the reference's plugin test strategy (SURVEY.md §4 ring 1):
+TestErasureCodeJerasure.cc's typed suite over techniques
+(encode_decode / minimum_to_decode / chunk-size behavior),
+TestErasureCodeIsa.cc, and TestErasureCodePlugin.cc's registry
+failure-mode fixtures.
+"""
+
+from __future__ import annotations
+
+import errno
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import ECError, registry
+from ceph_tpu.ec.interface import ErasureCode
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+
+# (plugin, profile-extras) matrix — the TYPED_TEST_SUITE analogue.
+CODES = [
+    ("jerasure", {"technique": "reed_sol_van", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "reed_sol_van", "k": "7", "m": "3"}),
+    ("jerasure", {"technique": "reed_sol_r6_op", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "cauchy_orig", "k": "3", "m": "2", "packetsize": "8"}),
+    ("jerasure", {"technique": "cauchy_good", "k": "4", "m": "2", "packetsize": "8"}),
+    ("isa", {"technique": "reed_sol_van", "k": "4", "m": "2"}),
+    ("isa", {"technique": "cauchy", "k": "8", "m": "3"}),
+    ("jax", {"technique": "cauchy", "k": "8", "m": "3"}),
+    ("jax", {"technique": "reed_sol_van", "k": "4", "m": "2"}),
+]
+
+
+def make(plugin: str, extras: dict) -> ErasureCode:
+    return registry.factory(plugin, dict(extras))
+
+
+@pytest.fixture(params=CODES, ids=lambda c: f"{c[0]}-{c[1]['technique']}-k{c[1]['k']}m{c[1]['m']}")
+def code(request):
+    return make(*request.param)
+
+
+def payload(n: int, seed: int = 7) -> bytes:
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+class TestEncodeDecode:
+    def test_round_trip_unaligned(self, code):
+        """encode pads; decode_concat returns the padded object
+        (TestErasureCodeJerasure.cc encode_decode)."""
+        raw = payload(1553)
+        k, n = code.get_data_chunk_count(), code.get_chunk_count()
+        encoded = code.encode(set(range(n)), raw)
+        assert set(encoded) == set(range(n))
+        sizes = {len(c) for c in encoded.values()}
+        assert sizes == {code.get_chunk_size(len(raw))}
+        out = code.decode_concat(encoded)
+        assert bytes(out[: len(raw)]) == raw
+        assert not out[len(raw) :].any()  # zero padding
+
+    def test_all_erasure_patterns(self, code):
+        """Reconstruct every 1- and 2-erasure pattern (the exhaustive
+        sweep of ceph_erasure_code_benchmark --erasures-generation
+        exhaustive)."""
+        raw = payload(4096, seed=11)
+        n = code.get_chunk_count()
+        m = code.get_coding_chunk_count()
+        encoded = code.encode(set(range(n)), raw)
+        patterns = list(itertools.combinations(range(n), 1))
+        if m >= 2:
+            patterns += list(itertools.combinations(range(n), 2))
+        for erased in patterns:
+            avail = {i: c for i, c in encoded.items() if i not in erased}
+            decoded = code.decode(set(erased), avail)
+            for e in erased:
+                np.testing.assert_array_equal(decoded[e], encoded[e])
+
+    def test_decode_passthrough(self, code):
+        """want ⊆ available short-circuits without math
+        (ErasureCode.cc:225-244)."""
+        raw = payload(2048)
+        n = code.get_chunk_count()
+        encoded = code.encode(set(range(n)), raw)
+        out = code.decode({0, 1}, encoded)
+        np.testing.assert_array_equal(out[0], encoded[0])
+
+    def test_encode_subset_filter(self, code):
+        """encode() only returns requested chunks (ErasureCode.cc:216-222)."""
+        raw = payload(1024)
+        got = code.encode({0, code.get_chunk_count() - 1}, raw)
+        assert set(got) == {0, code.get_chunk_count() - 1}
+
+    def test_too_few_chunks_raises(self, code):
+        raw = payload(512)
+        n, k = code.get_chunk_count(), code.get_data_chunk_count()
+        encoded = code.encode(set(range(n)), raw)
+        avail = dict(itertools.islice(encoded.items(), k - 1))
+        with pytest.raises(ECError) as ei:
+            code.decode(set(range(n)) - set(avail), avail)
+        assert ei.value.errno == errno.EIO
+
+
+class TestMinimumToDecode:
+    def test_prefers_wanted(self, code):
+        n = code.get_chunk_count()
+        want, avail = {0}, set(range(n))
+        assert set(code.minimum_to_decode(want, avail)) == {0}
+
+    def test_first_k_when_missing(self, code):
+        k, n = code.get_data_chunk_count(), code.get_chunk_count()
+        avail = set(range(1, n))
+        got = code.minimum_to_decode({0}, avail)
+        assert set(got) == set(sorted(avail)[:k])
+        for runs in got.values():
+            assert runs == [(0, code.get_sub_chunk_count())]
+
+    def test_eio_when_undecodable(self, code):
+        k = code.get_data_chunk_count()
+        with pytest.raises(ECError) as ei:
+            code.minimum_to_decode({0}, set(range(1, k)))
+        assert ei.value.errno == errno.EIO
+
+    def test_with_cost(self, code):
+        n = code.get_chunk_count()
+        avail = {i: 1 for i in range(n)}
+        assert code.minimum_to_decode_with_cost({1}, avail) == {1}
+
+
+class TestChunkSize:
+    def test_jerasure_alignment(self):
+        """w=8, k=2: alignment = k*w*sizeof(int) = 64
+        (ErasureCodeJerasure.cc:174-186)."""
+        ec = make("jerasure", {"technique": "reed_sol_van", "k": "2", "m": "1"})
+        assert ec.get_chunk_size(1) == 32
+        assert ec.get_chunk_size(64) == 32
+        assert ec.get_chunk_size(65) == 64
+
+    def test_jerasure_per_chunk_alignment(self):
+        """per-chunk: alignment = w*16 = 128."""
+        ec = make(
+            "jerasure",
+            {
+                "technique": "reed_sol_van",
+                "k": "3",
+                "m": "1",
+                "jerasure-per-chunk-alignment": "true",
+            },
+        )
+        assert ec.get_chunk_size(1) == 128
+        assert ec.get_chunk_size(3 * 128) == 128
+        assert ec.get_chunk_size(3 * 128 + 1) == 256
+
+    def test_isa_alignment(self):
+        """ceil(size/k) rounded to 32 (ErasureCodeIsa.cc:66-79)."""
+        ec = make("isa", {"k": "4", "m": "2"})
+        assert ec.get_chunk_size(1) == 32
+        assert ec.get_chunk_size(4 * 32) == 32
+        assert ec.get_chunk_size(4 * 32 + 1) == 64
+
+    def test_cauchy_packet_alignment(self):
+        """non-per-chunk: k*w*packetsize*4 (ErasureCodeJerasure.cc:278-292)."""
+        ec = make(
+            "jerasure",
+            {"technique": "cauchy_good", "k": "2", "m": "2", "packetsize": "8"},
+        )
+        assert ec.get_chunk_size(1) == 2 * 8 * 8 * 4 // 2
+
+
+class TestProfileSemantics:
+    def test_defaults_backfilled(self):
+        """Parsing writes defaults into the profile (to_int semantics),
+        and get_profile returns the final profile."""
+        profile = {"technique": "reed_sol_van"}
+        ec = make("jerasure", profile)
+        assert ec.get_profile()["k"] == "7"
+        assert ec.get_profile()["m"] == "3"
+
+    def test_mapping_parse(self):
+        ec = make(
+            "jax", {"technique": "cauchy", "k": "2", "m": "1", "mapping": "_DD"}
+        )
+        assert ec.get_chunk_mapping() == [1, 2, 0]
+        raw = payload(1024)
+        encoded = ec.encode({0, 1, 2}, raw)
+        out = ec.decode_concat(encoded)
+        assert bytes(out[:1024]) == raw
+
+    def test_mapping_wrong_length(self):
+        with pytest.raises(ECError) as ei:
+            make("jerasure", {"k": "2", "m": "1", "mapping": "DD"})
+        assert ei.value.errno == errno.EINVAL
+
+    def test_r6_requires_m2(self):
+        with pytest.raises(ECError):
+            make("jerasure", {"technique": "reed_sol_r6_op", "k": "4", "m": "3"})
+
+    def test_isa_vandermonde_clamps(self):
+        with pytest.raises(ECError):
+            make("isa", {"technique": "reed_sol_van", "k": "4", "m": "5"})
+        with pytest.raises(ECError):
+            make("isa", {"technique": "reed_sol_van", "k": "22", "m": "4"})
+
+    def test_bad_technique(self):
+        with pytest.raises(ECError) as ei:
+            make("jerasure", {"technique": "no_such_thing"})
+        assert ei.value.errno == errno.ENOENT
+
+    def test_sanity_k_m(self):
+        with pytest.raises(ECError):
+            make("jax", {"k": "1", "m": "1"})
+        with pytest.raises(ECError):
+            make("jax", {"k": "2", "m": "0"})
+
+
+class TestEdgeCases:
+    def test_empty_object(self):
+        ec = make("isa", {"k": "4", "m": "2"})
+        enc = ec.encode(set(range(6)), b"")
+        assert set(enc) == set(range(6))
+        assert all(len(c) == 0 for c in enc.values())
+
+    def test_create_rule_unknown_root_enoent(self):
+        from ceph_tpu.crush.types import CrushMap
+
+        ec = make("jax", {"k": "4", "m": "2"})
+        with pytest.raises(ECError) as ei:
+            ec.create_rule("r", CrushMap())
+        assert ei.value.errno == errno.ENOENT
+
+    def test_create_rule_device_class_filters(self):
+        """crush-device-class profiles place only on matching OSDs."""
+        from ceph_tpu.crush import builder
+        from ceph_tpu.crush.mapper import crush_do_rule
+        from ceph_tpu.crush.types import CrushMap
+
+        m = CrushMap()
+        builder.build_hierarchy(m, osds_per_host=2, n_hosts=6)
+        for o in range(12):
+            builder.set_device_class(m, o, "ssd" if o % 2 else "hdd")
+        ec = make(
+            "jax",
+            {"k": "2", "m": "2", "crush-device-class": "ssd",
+             "crush-failure-domain": "host"},
+        )
+        rid = ec.create_rule("ssdrule", m)
+        osds = crush_do_rule(m, rid, x=77, result_max=4,
+                             weights=[0x10000] * 12)
+        assert all(o % 2 == 1 for o in osds if 0 <= o < 12), osds
+
+
+class TestKnownCoefficients:
+    """Structural bit-compat guards (corpus-style identities)."""
+
+    def test_r6_rows(self):
+        from ceph_tpu.models.matrices import jerasure_rs_r6_matrix
+
+        C = jerasure_rs_r6_matrix(4)
+        assert C[0].tolist() == [1, 1, 1, 1]
+        assert C[1].tolist() == [1, 2, 4, 8]
+
+    def test_cauchy_packet_layout(self):
+        """cauchy parity bytes follow jerasure's packet layout: with the
+        all-XOR first coding row of cauchy_good, parity0 packet rows are
+        the XOR of the matching data packet rows (schedule semantics of
+        jerasure_schedule_encode)."""
+        ec = make(
+            "jerasure",
+            {"technique": "cauchy_good", "k": "2", "m": "1", "packetsize": "8"},
+        )
+        # cauchy_good normalizes row 0 to all-ones -> parity = XOR of chunks
+        raw = payload(2 * ec.get_chunk_size(1))
+        enc = ec.encode({0, 1, 2}, raw)
+        np.testing.assert_array_equal(enc[2], enc[0] ^ enc[1])
+
+
+class TestRegistry:
+    def test_factory_loads_and_checks_profile(self):
+        ec = registry.factory("isa", {"k": "4", "m": "2"})
+        assert ec.get_data_chunk_count() == 4
+
+    def test_unknown_plugin_eio(self):
+        r = ErasureCodePluginRegistry()
+        with pytest.raises(ECError) as ei:
+            r.factory("no_such_plugin", {})
+        assert ei.value.errno == errno.EIO
+
+    def test_version_mismatch_exdev(self):
+        r = ErasureCodePluginRegistry()
+        with pytest.raises(ECError) as ei:
+            r.factory("missing_version", {}, directory="tests.ec_fail_plugins")
+        assert ei.value.errno == errno.EXDEV
+
+    def test_missing_entry_point_enoent(self):
+        r = ErasureCodePluginRegistry()
+        with pytest.raises(ECError) as ei:
+            r.factory("missing_entry_point", {}, directory="tests.ec_fail_plugins")
+        assert ei.value.errno == errno.ENOENT
+
+    def test_fail_to_initialize(self):
+        r = ErasureCodePluginRegistry()
+        with pytest.raises(ECError) as ei:
+            r.factory("fail_to_initialize", {}, directory="tests.ec_fail_plugins")
+        assert ei.value.errno == errno.ESRCH
+
+    def test_fail_to_register_ebadf(self):
+        r = ErasureCodePluginRegistry()
+        with pytest.raises(ECError) as ei:
+            r.factory("fail_to_register", {}, directory="tests.ec_fail_plugins")
+        assert ei.value.errno == errno.EBADF
+
+    def test_example_plugin_round_trip(self):
+        """The ErasureCodeExample XOR analogue end-to-end."""
+        r = ErasureCodePluginRegistry()
+        ec = r.factory("example_xor", {}, directory="tests.ec_fail_plugins")
+        raw = payload(1000)
+        enc = ec.encode({0, 1, 2}, raw)
+        np.testing.assert_array_equal(enc[2], enc[0] ^ enc[1])
+        dec = ec.decode({0}, {1: enc[1], 2: enc[2]})
+        np.testing.assert_array_equal(dec[0], enc[0])
+
+    def test_preload(self):
+        r = ErasureCodePluginRegistry()
+        r.preload("example_xor", directory="tests.ec_fail_plugins")
+        assert r.get("example_xor") is not None
+
+    def test_double_register_eexist(self):
+        r = ErasureCodePluginRegistry()
+        r.preload("example_xor", directory="tests.ec_fail_plugins")
+        with pytest.raises(ECError) as ei:
+            r.load("example_xor", directory="tests.ec_fail_plugins")
+        assert ei.value.errno == errno.EEXIST
+
+
+class TestStripesAPI:
+    def test_batched_encode_matches_scalar(self):
+        import jax.numpy as jnp
+
+        ec = make("jax", {"k": "4", "m": "2"})
+        rng = np.random.default_rng(3)
+        batch = rng.integers(0, 256, (5, 4, 1024), dtype=np.uint8)
+        parity = np.asarray(ec.encode_stripes(jnp.asarray(batch)))
+        for b in range(5):
+            obj = batch[b].reshape(-1).tobytes()
+            enc = ec.encode({4, 5}, obj)
+            np.testing.assert_array_equal(parity[b, 0], enc[4])
+            np.testing.assert_array_equal(parity[b, 1], enc[5])
+
+    def test_batched_decode(self):
+        import jax.numpy as jnp
+
+        ec = make("jax", {"k": "4", "m": "2"})
+        rng = np.random.default_rng(4)
+        data = rng.integers(0, 256, (3, 4, 512), dtype=np.uint8)
+        parity = np.asarray(ec.encode_stripes(jnp.asarray(data)))
+        full = np.concatenate([data, parity], axis=1)
+        damaged = full.copy()
+        damaged[:, 1] = 0
+        rec = np.asarray(ec.decode_stripes(jnp.asarray(damaged), (1,)))
+        np.testing.assert_array_equal(rec[:, 0], full[:, 1])
