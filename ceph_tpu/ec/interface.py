@@ -179,9 +179,13 @@ class ErasureCode(ErasureCodeInterface):
 
     @staticmethod
     def to_bool(name: str, profile: dict, default: str) -> bool:
-        v = str(profile.get(name, "") or default).lower()
-        profile.setdefault(name, default)
-        return v in ("true", "1", "yes", "y", "on")
+        # empty values are replaced by the default in the stored
+        # profile too (ErasureCode.cc to_bool writes profile[name])
+        v = str(profile.get(name, ""))
+        if v == "":
+            profile[name] = default
+            v = default
+        return v.lower() in ("true", "1", "yes", "y", "on")
 
     @staticmethod
     def to_string(name: str, profile: dict, default: str) -> str:
@@ -206,7 +210,10 @@ class ErasureCode(ErasureCodeInterface):
         )
         self.rule_device_class = profile.get("crush-device-class", "")
         self.parse(profile)
-        self._profile = profile
+        # store a *copy* (the reference's `_profile = profile` is a C++
+        # copy, ErasureCode.h): later mutation of either side is
+        # detected by the registry's factory cross-check
+        self._profile = dict(profile)
 
     def parse(self, profile: dict) -> None:
         """Subclass hook; base parses the `mapping` key

@@ -85,8 +85,9 @@ class ErasureCodeJerasure(MatrixErasureCode):
         alignment = self.get_alignment()
         if self.per_chunk_alignment:
             chunk_size = -(-object_size // self.k)
-            if alignment > chunk_size:
-                chunk_size = alignment
+            # the reference aborts here (ceph_assert(alignment <=
+            # chunk_size), ErasureCodeJerasure.cc:89) — never clamps
+            assert alignment <= chunk_size, (alignment, chunk_size)
             modulo = chunk_size % alignment
             if modulo:
                 chunk_size += alignment - modulo

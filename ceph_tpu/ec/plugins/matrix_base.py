@@ -116,13 +116,17 @@ class MatrixErasureCode(ErasureCode):
 
     # -- compute paths -------------------------------------------------------
 
+    _device_unavailable = False  # latched after the first failed import
+
     def _apply_matrix(self, M: np.ndarray, rows: np.ndarray) -> np.ndarray:
         """out = M @ rows over GF(2^8); device for big payloads."""
-        if rows.size >= self.device_min_bytes:
+        if rows.size >= self.device_min_bytes and not type(self)._device_unavailable:
             try:
                 return self._apply_device(M, rows)
-            except Exception:
-                pass  # no usable accelerator: host path is always correct
+            except ImportError:
+                # no jax on this host: latch so large ops don't re-pay
+                # the module-finder miss; host path is always correct
+                type(self)._device_unavailable = True
         return gf_matmul(M, rows)
 
     def _apply_device(self, M: np.ndarray, rows: np.ndarray) -> np.ndarray:

@@ -127,6 +127,9 @@ class ErasureCodePluginRegistry:
                     plugin = self.load(plugin_name, directory)
                 finally:
                     self.loading = False
+        # reference semantics (ErasureCodePlugin.cc:105-112): ``profile``
+        # is mutated in place by parsing (defaults injected), the plugin
+        # stores a copy, and the two must match exactly afterwards
         ec = plugin.factory(profile)
         if ec.get_profile() != profile:
             raise ECError(

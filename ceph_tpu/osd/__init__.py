@@ -1,0 +1,8 @@
+"""OSD-layer placement and data-path components.
+
+The pure placement pipeline (pg -> up/acting OSD sets) lives in
+``osdmap``; the batched whole-cluster remap engine in ``remap``.
+"""
+
+from ceph_tpu.osd.osdmap import OSDMap  # noqa: F401
+from ceph_tpu.osd.types import PgPool, pg_t  # noqa: F401

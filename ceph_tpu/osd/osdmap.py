@@ -1,0 +1,305 @@
+"""Cluster map and the pg -> up/acting placement pipeline.
+
+Behavioral twin of the reference OSDMap mapping path
+(src/osd/OSDMap.cc:2670-2971): CRUSH raw placement, upmap exception
+tables (explicit ``pg_upmap``, item swaps ``pg_upmap_items``, primary
+pins ``pg_upmap_primaries``), down/dne filtering with EC positional
+holes, hashed primary-affinity rejection, and pg_temp/primary_temp
+recovery overrides — composed exactly as ``_pg_to_up_acting_osds``
+(OSDMap.cc:2923-2971) does.
+
+This is the scalar host pipeline; the batched whole-cluster remap
+(ParallelPGMapper's job, src/osd/OSDMapMapping.h:18-114) runs on TPU via
+ceph_tpu.osd.remap.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ceph_tpu.crush.mapper import crush_do_rule
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE, ChooseArg, CrushMap
+from ceph_tpu.ops.hashing import crush_hash32_2
+from ceph_tpu.osd.types import (
+    CEPH_OSD_DEFAULT_PRIMARY_AFFINITY,
+    CEPH_OSD_MAX_PRIMARY_AFFINITY,
+    PgPool,
+    pg_t,
+)
+
+CEPH_OSD_EXISTS = 1
+CEPH_OSD_UP = 2
+
+
+@dataclass
+class OSDMap:
+    """Mutable cluster map (an epoch's worth of state).
+
+    ``osd_weight`` is the *out* weight (16.16; 0 = out, 0x10000 = in) —
+    distinct from CRUSH bucket weights, it drives probabilistic
+    rejection inside CRUSH (mapper.c is_out) and upmap validity.
+    """
+
+    crush: CrushMap
+    epoch: int = 1
+    max_osd: int = 0
+    osd_state: list[int] = field(default_factory=list)
+    osd_weight: list[int] = field(default_factory=list)
+    osd_primary_affinity: list[int] | None = None
+    pools: dict[int, PgPool] = field(default_factory=dict)
+    # exception tables, all keyed by *folded* pg (raw_pg_to_pg applied):
+    pg_upmap: dict[pg_t, list[int]] = field(default_factory=dict)
+    pg_upmap_items: dict[pg_t, list[tuple[int, int]]] = field(default_factory=dict)
+    pg_upmap_primaries: dict[pg_t, int] = field(default_factory=dict)
+    pg_temp: dict[pg_t, list[int]] = field(default_factory=dict)
+    primary_temp: dict[pg_t, int] = field(default_factory=dict)
+    erasure_code_profiles: dict[str, dict[str, str]] = field(default_factory=dict)
+    choose_args: dict[int, ChooseArg] | None = None
+
+    # -- osd state ---------------------------------------------------
+
+    def set_max_osd(self, n: int) -> None:
+        self.max_osd = n
+        self.osd_state += [0] * (n - len(self.osd_state))
+        self.osd_weight += [0] * (n - len(self.osd_weight))
+        if self.osd_primary_affinity is not None:
+            self.osd_primary_affinity += [CEPH_OSD_DEFAULT_PRIMARY_AFFINITY] * (
+                n - len(self.osd_primary_affinity)
+            )
+        del self.osd_state[n:]
+        del self.osd_weight[n:]
+
+    def new_osd(self, osd: int, weight: int = 0x10000, up: bool = True) -> None:
+        if osd >= self.max_osd:
+            self.set_max_osd(osd + 1)
+        self.osd_state[osd] = CEPH_OSD_EXISTS | (CEPH_OSD_UP if up else 0)
+        self.osd_weight[osd] = weight
+
+    def exists(self, osd: int) -> bool:
+        return (
+            0 <= osd < self.max_osd
+            and bool(self.osd_state[osd] & CEPH_OSD_EXISTS)
+        )
+
+    def is_up(self, osd: int) -> bool:
+        return self.exists(osd) and bool(self.osd_state[osd] & CEPH_OSD_UP)
+
+    def is_down(self, osd: int) -> bool:
+        return not self.is_up(osd)
+
+    def is_out(self, osd: int) -> bool:
+        return not self.exists(osd) or self.osd_weight[osd] == 0
+
+    def mark_down(self, osd: int) -> None:
+        self.osd_state[osd] &= ~CEPH_OSD_UP
+
+    def mark_up(self, osd: int) -> None:
+        self.osd_state[osd] |= CEPH_OSD_UP | CEPH_OSD_EXISTS
+
+    def mark_out(self, osd: int) -> None:
+        self.osd_weight[osd] = 0
+
+    def set_primary_affinity(self, osd: int, aff: int) -> None:
+        if self.osd_primary_affinity is None:
+            self.osd_primary_affinity = [
+                CEPH_OSD_DEFAULT_PRIMARY_AFFINITY
+            ] * self.max_osd
+        self.osd_primary_affinity[osd] = aff
+
+    def get_pg_pool(self, poolid: int) -> PgPool | None:
+        return self.pools.get(poolid)
+
+    # -- the pipeline (OSDMap.cc:2670-2971) --------------------------
+
+    def _remove_nonexistent_osds(self, pool: PgPool, osds: list[int]) -> None:
+        """OSDMap.cc:2646-2668: dne OSDs vanish (replicated) or become
+        positional holes (EC)."""
+        if pool.can_shift_osds():
+            osds[:] = [o for o in osds if self.exists(o)]
+        else:
+            for i, o in enumerate(osds):
+                if not self.exists(o):
+                    osds[i] = CRUSH_ITEM_NONE
+
+    def _pg_to_raw_osds(self, pool: PgPool, pg: pg_t) -> tuple[list[int], int]:
+        """OSDMap.cc:2670-2688."""
+        pps = pool.raw_pg_to_pps(pg)
+        osds: list[int] = []
+        if pool.crush_rule >= 0 and pool.crush_rule in self.crush.rules:
+            osds = crush_do_rule(
+                self.crush, pool.crush_rule, pps, pool.size,
+                self.osd_weight, self.choose_args,
+            )
+        self._remove_nonexistent_osds(pool, osds)
+        return osds, pps
+
+    @staticmethod
+    def _pick_primary(osds: list[int]) -> int:
+        """OSDMap.cc:2690-2697: first non-hole."""
+        for o in osds:
+            if o != CRUSH_ITEM_NONE:
+                return o
+        return -1
+
+    def _upmap_target_invalid(self, osd: int) -> bool:
+        """A target is unusable if it is marked out or an invalid id."""
+        return not (
+            osd != CRUSH_ITEM_NONE
+            and 0 <= osd < self.max_osd
+            and self.osd_weight[osd] != 0
+        )
+
+    def _apply_upmap(self, pool: PgPool, raw_pg: pg_t, raw: list[int]) -> None:
+        """OSDMap.cc:2699-2765."""
+        pg = pool.raw_pg_to_pg(raw_pg)
+        explicit = self.pg_upmap.get(pg)
+        if explicit is not None:
+            for osd in explicit:
+                if (
+                    osd != CRUSH_ITEM_NONE
+                    and 0 <= osd < self.max_osd
+                    and self.osd_weight[osd] == 0
+                ):
+                    return  # reject the whole explicit mapping
+            raw[:] = list(explicit)
+            # fall through: pg_upmap_items still applies
+        for osd_from, osd_to in self.pg_upmap_items.get(pg, []):
+            exists = False
+            pos = -1
+            # skip only when osd_to is a *valid* id that is marked out
+            # (OSDMap.cc:2736-2740); invalid ids are applied and later
+            # filtered into holes by _raw_to_up_osds
+            to_valid_but_out = (
+                osd_to != CRUSH_ITEM_NONE
+                and 0 <= osd_to < self.max_osd
+                and self.osd_weight[osd_to] == 0
+            )
+            for i, osd in enumerate(raw):
+                if osd == osd_to:
+                    exists = True
+                    break
+                if osd == osd_from and pos < 0 and not to_valid_but_out:
+                    pos = i
+            if not exists and pos >= 0:
+                raw[pos] = osd_to
+        new_prim = self.pg_upmap_primaries.get(pg)
+        if new_prim is not None and not self._upmap_target_invalid(new_prim):
+            new_prim_idx = 0
+            for i in range(1, len(raw)):  # start from 1 on purpose
+                if raw[i] == new_prim:
+                    new_prim_idx = i
+                    break
+            if new_prim_idx > 0:
+                raw[new_prim_idx] = raw[0]
+                raw[0] = new_prim
+
+    def _raw_to_up_osds(self, pool: PgPool, raw: list[int]) -> list[int]:
+        """OSDMap.cc:2767-2791: drop (replicated) or hole-out (EC) the
+        down/dne members."""
+        if pool.can_shift_osds():
+            return [o for o in raw if self.exists(o) and not self.is_down(o)]
+        return [
+            CRUSH_ITEM_NONE if (not self.exists(o) or self.is_down(o)) else o
+            for o in raw
+        ]
+
+    def _apply_primary_affinity(
+        self, seed: int, pool: PgPool, osds: list[int], primary: int
+    ) -> int:
+        """OSDMap.cc:2793-2846: hashed proportional rejection so an OSD
+        with affinity a primaries only a/0x10000 of its PGs."""
+        aff = self.osd_primary_affinity
+        if aff is None:
+            return primary
+        if not any(
+            o != CRUSH_ITEM_NONE and aff[o] != CEPH_OSD_DEFAULT_PRIMARY_AFFINITY
+            for o in osds
+        ):
+            return primary
+        pos = -1
+        for i, o in enumerate(osds):
+            if o == CRUSH_ITEM_NONE:
+                continue
+            a = aff[o]
+            if a < CEPH_OSD_MAX_PRIMARY_AFFINITY and (
+                int(crush_hash32_2(seed, o)) >> 16
+            ) >= a:
+                if pos < 0:
+                    pos = i  # fallback, keep looking
+            else:
+                pos = i
+                break
+        if pos < 0:
+            return primary
+        primary = osds[pos]
+        if pool.can_shift_osds() and pos > 0:
+            # move the new primary to the front
+            for i in range(pos, 0, -1):
+                osds[i] = osds[i - 1]
+            osds[0] = primary
+        return primary
+
+    def _get_temp_osds(self, pool: PgPool, raw_pg: pg_t) -> tuple[list[int], int]:
+        """OSDMap.cc:2848-2881: recovery-time acting-set overrides."""
+        pg = pool.raw_pg_to_pg(raw_pg)
+        temp_pg: list[int] = []
+        for o in self.pg_temp.get(pg, []):
+            if not self.exists(o) or self.is_down(o):
+                if pool.can_shift_osds():
+                    continue
+                temp_pg.append(CRUSH_ITEM_NONE)
+            else:
+                temp_pg.append(o)
+        temp_primary = self.primary_temp.get(pg, -1)
+        if temp_primary == -1 and temp_pg:
+            temp_primary = self._pick_primary(temp_pg)
+        return temp_pg, temp_primary
+
+    # -- public queries ----------------------------------------------
+
+    def pg_to_raw_osds(self, pg: pg_t) -> tuple[list[int], int]:
+        """(raw osds, primary) before upmap/filters (OSDMap.cc:2883)."""
+        pool = self.get_pg_pool(pg.pool)
+        if pool is None:
+            return [], -1
+        raw, _ = self._pg_to_raw_osds(pool, pg)
+        return raw, self._pick_primary(raw)
+
+    def pg_to_raw_up(self, pg: pg_t) -> tuple[list[int], int]:
+        """OSDMap.cc:2909-2925."""
+        pool = self.get_pg_pool(pg.pool)
+        if pool is None:
+            return [], -1
+        raw, pps = self._pg_to_raw_osds(pool, pg)
+        self._apply_upmap(pool, pg, raw)
+        up = self._raw_to_up_osds(pool, raw)
+        primary = self._pick_primary(raw)
+        primary = self._apply_primary_affinity(pps, pool, up, primary)
+        return up, primary
+
+    def pg_to_up_acting_osds(
+        self, pg: pg_t, folded: bool = False
+    ) -> tuple[list[int], int, list[int], int]:
+        """(up, up_primary, acting, acting_primary) —
+        OSDMap.cc:2923-2971.  ``pg`` is a raw pg by default (the
+        pipeline folds it, raw_pg_to_pg=true branch); with
+        ``folded=True`` the ps must already be in [0, pg_num) and
+        out-of-range returns empty."""
+        pool = self.get_pg_pool(pg.pool)
+        if pool is None or (folded and pg.ps >= pool.pg_num):
+            return [], -1, [], -1
+        acting, acting_primary = self._get_temp_osds(pool, pg)
+        raw, pps = self._pg_to_raw_osds(pool, pg)
+        self._apply_upmap(pool, pg, raw)
+        up = self._raw_to_up_osds(pool, raw)
+        up_primary = self._pick_primary(up)
+        up_primary = self._apply_primary_affinity(pps, pool, up, up_primary)
+        if not acting:
+            acting = list(up)
+            if acting_primary == -1:
+                acting_primary = up_primary
+        return up, up_primary, acting, acting_primary
+
+    def pg_is_ec(self, pg: pg_t) -> bool:
+        pool = self.get_pg_pool(pg.pool)
+        return pool is not None and pool.is_erasure()

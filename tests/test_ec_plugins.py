@@ -148,9 +148,12 @@ class TestChunkSize:
                 "jerasure-per-chunk-alignment": "true",
             },
         )
-        assert ec.get_chunk_size(1) == 128
         assert ec.get_chunk_size(3 * 128) == 128
         assert ec.get_chunk_size(3 * 128 + 1) == 256
+        # objects smaller than k*alignment trip the reference's
+        # ceph_assert(alignment <= chunk_size) (ErasureCodeJerasure.cc:89)
+        with pytest.raises(AssertionError):
+            ec.get_chunk_size(1)
 
     def test_isa_alignment(self):
         """ceil(size/k) rounded to 32 (ErasureCodeIsa.cc:66-79)."""
