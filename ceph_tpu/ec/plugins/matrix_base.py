@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import collections
 import os
-from typing import Mapping
+from typing import Iterable, Mapping
 
 import numpy as np
 
@@ -120,13 +120,21 @@ class MatrixErasureCode(ErasureCode):
 
     def _apply_matrix(self, M: np.ndarray, rows: np.ndarray) -> np.ndarray:
         """out = M @ rows over GF(2^8); device for big payloads."""
-        if rows.size >= self.device_min_bytes and not type(self)._device_unavailable:
+        if (
+            rows.size >= self.device_min_bytes
+            and not MatrixErasureCode._device_unavailable
+        ):
             try:
                 return self._apply_device(M, rows)
             except ImportError:
-                # no jax on this host: latch so large ops don't re-pay
-                # the module-finder miss; host path is always correct
-                type(self)._device_unavailable = True
+                # no jax on this host: latch (on the shared base class)
+                # so large ops don't re-pay the module-finder miss
+                MatrixErasureCode._device_unavailable = True
+            except Exception:
+                # device runtime failure (backend init, OOM, ...):
+                # fall through — the host path is always correct —
+                # but don't latch; the condition may be transient
+                pass
         return gf_matmul(M, rows)
 
     def _apply_device(self, M: np.ndarray, rows: np.ndarray) -> np.ndarray:
@@ -177,31 +185,62 @@ class MatrixErasureCode(ErasureCode):
             self._decode_cache.popitem(last=False)
         return D
 
+    def decode_payloads(
+        self,
+        available: Mapping[int, np.ndarray],
+        want_chunks: Iterable[int],
+    ) -> dict[int, np.ndarray]:
+        """Reconstruct ``want_chunks`` (chunk ids) from available shard
+        payloads of any multiple of the superpacket size — one matmul
+        regardless of how many stripes the payloads span.  ``available``
+        is keyed by shard position; results are keyed by chunk id.
+
+        This is the single home of the survivor/erasure algebra; both
+        per-stripe decode_chunks and ECUtil's whole-payload batched
+        decode (reference ECUtil.cc:50-121) go through it.
+        """
+        import errno as _errno
+
+        n = self.k + self.m
+        erasures = tuple(c for c in range(n) if self.chunk_index(c) not in available)
+        survivors = [c for c in range(n) if self.chunk_index(c) in available][: self.k]
+        if len(survivors) < self.k:
+            raise ECError(_errno.EIO, "not enough chunks to decode")
+        out: dict[int, np.ndarray] = {}
+        need_rec = [c for c in want_chunks if c in erasures]
+        if need_rec:
+            D = self._decode_matrix(erasures)
+            rows = np.concatenate(
+                [
+                    self._chunk_to_rows(
+                        np.ascontiguousarray(available[self.chunk_index(c)])
+                    )
+                    for c in survivors
+                ]
+            )
+            rec = self._apply_matrix(D, rows)
+            r = self.rows_per_chunk
+            for t, c in enumerate(erasures):
+                if c in need_rec:
+                    out[c] = self._rows_to_chunk(rec[t * r : (t + 1) * r])
+        for c in want_chunks:
+            if c not in out:
+                out[c] = np.asarray(available[self.chunk_index(c)])
+        return out
+
     def decode_chunks(
         self,
         want_to_read: set[int],
         chunks: Mapping[int, np.ndarray],
         decoded: dict[int, np.ndarray],
     ) -> None:
-        import errno as _errno
-
         # keys of chunks/decoded are shard positions; the matrix algebra
         # runs over chunk ids (chunk c lives at shard chunk_index(c))
         n = self.k + self.m
-        erasures = tuple(c for c in range(n) if self.chunk_index(c) not in chunks)
-        survivors = [c for c in range(n) if self.chunk_index(c) in chunks][: self.k]
-        if len(survivors) < self.k:
-            raise ECError(_errno.EIO, "not enough chunks to decode")
-        D = self._decode_matrix(erasures)
-        rows = np.concatenate(
-            [self._chunk_to_rows(decoded[self.chunk_index(c)]) for c in survivors]
-        )
-        rec = self._apply_matrix(D, rows)
-        r = self.rows_per_chunk
-        for t, c in enumerate(erasures):
-            decoded[self.chunk_index(c)][...] = self._rows_to_chunk(
-                rec[t * r : (t + 1) * r]
-            )
+        erased = [c for c in range(n) if self.chunk_index(c) not in chunks]
+        rec = self.decode_payloads(chunks, erased)
+        for c in erased:
+            decoded[self.chunk_index(c)][...] = rec[c]
 
     # -- batched stripe API (TPU hot path used by the OSD EC backend) --------
 
