@@ -187,3 +187,72 @@ def decode_matrix_for(C: np.ndarray, erasures: list[int]) -> np.ndarray:
     B = full[survivors]          # (k, k): survivors = B @ data
     Binv = gf_mat_inv(B)         # data = Binv @ survivors
     return gf_matmul(full[list(erasures)], Binv)
+
+
+# --- SHEC (shingled erasure code) ------------------------------------------
+
+
+def shec_recovery_efficiency(k: int, m1: int, m2: int, c1: int, c2: int) -> float:
+    """SHEC's r_e1 metric: mean chunks read to recover one lost chunk,
+    for a split of the parity rows into two shingle groups (m1,c1) and
+    (m2,c2) (reference src/erasure-code/shec/ErasureCodeShec.cc
+    shec_calc_recovery_efficiency1)."""
+    if m1 < c1 or m2 < c2:
+        return -1.0
+    if (m1 == 0 and c1 != 0) or (m2 == 0 and c2 != 0):
+        return -1.0
+    r_eff_k = [10**8] * k
+    r_e1 = 0.0
+    for m_g, c_g in ((m1, c1), (m2, c2)):
+        for rr in range(m_g):
+            start = ((rr * k) // m_g) % k
+            end = (((rr + c_g) * k) // m_g) % k
+            cc = start
+            first = True
+            while first or cc != end:
+                first = False
+                r_eff_k[cc] = min(r_eff_k[cc], ((rr + c_g) * k) // m_g - (rr * k) // m_g)
+                cc = (cc + 1) % k
+            r_e1 += ((rr + c_g) * k) // m_g - (rr * k) // m_g
+    r_e1 += sum(r_eff_k)
+    return r_e1 / (k + m1 + m2)
+
+
+def shec_coding_matrix(k: int, m: int, c: int, single: bool = False) -> np.ndarray:
+    """SHEC's shingled (m, k) coding matrix: the jerasure RS-Vandermonde
+    matrix with, per parity row, all columns outside that row's shingle
+    window zeroed (reference ErasureCodeShec.cc
+    shec_reedsolomon_coding_matrix).  ``single`` keeps one shingle group
+    (technique=single); otherwise the (m1,c1)/(m2,c2) split minimizing
+    :func:`shec_recovery_efficiency` is chosen, scanning c1 in 0..c/2 and
+    m1 in 0..m exactly as the reference does."""
+    if single:
+        m1, c1 = 0, 0
+    else:
+        best = (-1, -1)
+        min_r = 100.0
+        eps = np.finfo(float).eps
+        for c1 in range(c // 2 + 1):
+            for m1 in range(m + 1):
+                c2, m2 = c - c1, m - m1
+                if m1 < c1 or m2 < c2:
+                    continue
+                if (m1 == 0 and c1 != 0) or (m2 == 0 and c2 != 0):
+                    continue
+                if (m1 != 0 and c1 == 0) or (m2 != 0 and c2 == 0):
+                    continue
+                r = shec_recovery_efficiency(k, m1, m2, c1, c2)
+                if min_r - r > eps and r < min_r:
+                    min_r = r
+                    best = (c1, m1)
+        c1, m1 = best
+    m2, c2 = m - m1, c - c1
+    M = jerasure_rs_vandermonde_matrix(k, m)
+    for off, m_g, c_g in ((0, m1, c1), (m1, m2, c2)):
+        for rr in range(m_g):
+            end = ((rr * k) // m_g) % k
+            cc = (((rr + c_g) * k) // m_g) % k
+            while cc != end:
+                M[off + rr, cc] = 0
+                cc = (cc + 1) % k
+    return M

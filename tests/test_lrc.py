@@ -1,0 +1,163 @@
+"""LRC plugin tests.
+
+Mirrors the reference's TestErasureCodeLrc.cc: kml parameter generation,
+explicit mapping+layers configuration, layered minimum_to_decode
+(local-group reads for single losses), and cascading multi-layer
+recovery.
+"""
+
+import itertools
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.interface import ECError
+from ceph_tpu.ec.plugins.lrc import ErasureCodeLrc
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+
+
+def make_lrc(**profile):
+    ec = ErasureCodeLrc()
+    ec.init(profile)
+    return ec
+
+
+def payload(n, seed=3):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8)
+
+
+class TestKml:
+    def test_generated_mapping_and_layers(self):
+        profile = {"k": "4", "m": "2", "l": "3"}
+        ec = make_lrc(**profile)
+        # (k+m)/l = 2 groups: mapping DD__DD__ pattern of len 8
+        assert ec.get_chunk_count() == 8
+        assert ec.get_data_chunk_count() == 4
+        # global layer + one local layer per group
+        assert len(ec.layers) == 3
+        # generated internals are not exposed in the stored profile
+        assert "mapping" not in ec.get_profile()
+        assert "layers" not in ec.get_profile()
+
+    def test_kml_all_or_nothing(self):
+        with pytest.raises(ECError):
+            make_lrc(k="4", m="2")
+
+    def test_kml_modulo_checks(self):
+        with pytest.raises(ECError):
+            make_lrc(k="4", m="2", l="4")  # (k+m) % l != 0
+
+    def test_kml_generated_conflict(self):
+        with pytest.raises(ECError):
+            make_lrc(k="4", m="2", l="3", mapping="DD__DD__")
+
+    def test_kml_round_trip(self):
+        ec = make_lrc(k="4", m="2", l="3")
+        n = ec.get_chunk_count()
+        data = payload(4 * 50 + 5)
+        encoded = ec.encode(set(range(n)), data)
+        out = ec.decode_concat({i: encoded[i] for i in encoded})
+        np.testing.assert_array_equal(out[: len(data)], data)
+
+
+class TestExplicitLayers:
+    PROFILE = {
+        "mapping": "__DD__DD",
+        "layers": json.dumps([
+            ["_cDD_cDD", ""],   # global: 4 data, 2 parity
+            ["cDDD____", ""],   # local group 1
+            ["____cDDD", ""],   # local group 2
+        ]),
+    }
+
+    def test_init(self):
+        ec = make_lrc(**dict(self.PROFILE))
+        assert ec.get_chunk_count() == 8
+        assert ec.get_data_chunk_count() == 4
+
+    def test_encode_decode_single_loss(self):
+        ec = make_lrc(**dict(self.PROFILE))
+        n = ec.get_chunk_count()
+        data = payload(4 * 64)
+        encoded = ec.encode(set(range(n)), data)
+        for lost in range(n):
+            avail = {i: encoded[i] for i in encoded if i != lost}
+            decoded = ec.decode({lost}, avail)
+            np.testing.assert_array_equal(decoded[lost], encoded[lost])
+
+    def test_minimum_single_loss_is_local(self):
+        """One lost chunk reads only its local group (the LRC win)."""
+        ec = make_lrc(**dict(self.PROFILE))
+        n = ec.get_chunk_count()
+        # chunk 3 is in local layer "cDDD____" = chunks {0,1,2,3}
+        mins = set(ec.minimum_to_decode({3}, set(range(n)) - {3}))
+        assert mins == {0, 1, 2}
+
+    def test_minimum_no_erasure(self):
+        ec = make_lrc(**dict(self.PROFILE))
+        mins = set(ec.minimum_to_decode({2, 3}, set(range(8))))
+        assert mins == {2, 3}
+
+    def test_double_loss_same_group_uses_global(self):
+        ec = make_lrc(**dict(self.PROFILE))
+        n = ec.get_chunk_count()
+        data = payload(4 * 64)
+        encoded = ec.encode(set(range(n)), data)
+        # two data chunks of group 1 lost: local layer (1 parity) cannot
+        # fix; the global layer (2 parities) must
+        lost = (2, 3)
+        avail = {i: encoded[i] for i in encoded if i not in lost}
+        decoded = ec.decode(set(lost), avail)
+        for i in lost:
+            np.testing.assert_array_equal(decoded[i], encoded[i])
+
+    def test_cascading_recovery(self):
+        """Three losses: local layers fix what they can, the global
+        layer rides on those recoveries (reference decode_chunks
+        gradual-improvement comment)."""
+        ec = make_lrc(**dict(self.PROFILE))
+        n = ec.get_chunk_count()
+        data = payload(4 * 32)
+        encoded = ec.encode(set(range(n)), data)
+        lost = (1, 3, 7)  # global parity + one data in each group
+        avail = {i: encoded[i] for i in encoded if i not in lost}
+        decoded = ec.decode(set(lost), avail)
+        for i in lost:
+            np.testing.assert_array_equal(decoded[i], encoded[i])
+
+    def test_undecodable_raises_eio(self):
+        ec = make_lrc(**dict(self.PROFILE))
+        n = ec.get_chunk_count()
+        # lose all of group 1's data + its local parity + 1 global parity:
+        # 3 in-layer erasures overwhelm every layer
+        lost = {0, 2, 3, 1}
+        with pytest.raises(ECError):
+            ec.minimum_to_decode({2}, set(range(n)) - lost)
+
+
+class TestLayerValidation:
+    def test_missing_layers(self):
+        with pytest.raises(ECError):
+            make_lrc(mapping="DD__")
+
+    def test_bad_json(self):
+        with pytest.raises(ECError):
+            make_lrc(mapping="DD__", layers="not json")
+
+    def test_mapping_size_mismatch(self):
+        with pytest.raises(ECError):
+            make_lrc(mapping="DD__", layers=json.dumps([["DDc", ""]]))
+
+    def test_layer_profile_object(self):
+        ec = make_lrc(
+            mapping="DD__",
+            layers=json.dumps([["DDcc", {"technique": "cauchy_good"}]]),
+        )
+        assert ec.layers[0].profile["technique"] == "cauchy_good"
+
+    def test_registry_load(self):
+        reg = ErasureCodePluginRegistry()
+        profile = {"plugin": "lrc", "k": "4", "m": "2", "l": "3"}
+        ec = reg.factory("lrc", profile)
+        assert ec.get_chunk_count() == 8
