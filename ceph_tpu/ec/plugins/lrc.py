@@ -380,9 +380,9 @@ class ErasureCodeLrc(ErasureCode):
             layer_want = {
                 j for j, c in enumerate(layer.chunks) if c in want_to_encode
             }
+            # layer_encoded aliases encoded's buffers, so the inner
+            # plugin's in-place writes land directly in encoded
             layer.erasure_code.encode_chunks(layer_want, layer_encoded)
-            for j, c in enumerate(layer.chunks):
-                encoded[c][...] = layer_encoded[j]
 
     def decode_chunks(self, want_to_read, chunks, decoded) -> None:
         n = self.get_chunk_count()
@@ -412,11 +412,12 @@ class ErasureCodeLrc(ErasureCode):
             layer_want = {
                 j for j, c in enumerate(layer.chunks) if c in want_to_read
             }
+            # layer_decoded aliases decoded's buffers: recovered chunks
+            # land in place, ready for deeper layers to reuse
             layer.erasure_code.decode_chunks(
                 layer_want, layer_chunks, layer_decoded
             )
-            for j, c in enumerate(layer.chunks):
-                decoded[c][...] = layer_decoded[j]
+            for c in layer.chunks:
                 erasures.discard(c)
             want_to_read_erasures = erasures & set(want_to_read)
             if not want_to_read_erasures:

@@ -220,6 +220,27 @@ class ErasureCodeShec(MatrixErasureCode):
         )
         return {i for i in range(n) if minimum[i]}
 
+    def decode_payloads(self, available, want_chunks):
+        """SHEC override of the MDS fast path: the base implementation
+        inverts the first-k survivor submatrix, which can be singular
+        for a shingled (non-MDS) code even when the pattern is
+        recoverable.  Route ECUtil's batched payload decode through the
+        minimal-decoding-set search instead (same algebra as
+        decode_chunks, payload-length agnostic)."""
+        n = self.k + self.m
+        want = set(want_chunks)
+        chunks = {
+            s: np.ascontiguousarray(np.asarray(v, dtype=np.uint8).reshape(-1))
+            for s, v in available.items()
+        }
+        length = len(next(iter(chunks.values()))) if chunks else 0
+        decoded: dict[int, np.ndarray] = {}
+        for c in range(n):
+            s = self.chunk_index(c)
+            decoded[s] = chunks[s] if s in chunks else np.zeros(length, np.uint8)
+        self.decode_chunks(want, chunks, decoded)
+        return {c: decoded[self.chunk_index(c)] for c in want}
+
     def decode_chunks(self, want_to_read, chunks, decoded) -> None:
         k, m, M = self.k, self.m, self.coding_matrix
         n = k + m

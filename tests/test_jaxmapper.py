@@ -1,0 +1,229 @@
+"""Batched CRUSH engine vs the scalar oracle.
+
+The scalar mapper is pinned to the reference C by golden vectors
+(tests/test_crush_golden.py); these tests pin the batched jit/vmap
+engine (ceph_tpu/crush/jaxmapper.py) and the whole-cluster remap
+(ceph_tpu/osd/remap.py) to the scalar mapper, so equality here means
+bit-identical placements vs reference src/crush/mapper.c and
+src/osd/OSDMap.cc:2646-2971.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import builder as B
+from ceph_tpu.crush.jaxmapper import (
+    BatchedRuleMapper,
+    UnsupportedMap,
+    compile_map,
+)
+from ceph_tpu.crush.mapper import crush_do_rule
+from ceph_tpu.crush.types import BucketAlg, ChooseArg, CrushMap, Tunables
+from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.osd.remap import BatchedClusterMapper
+from ceph_tpu.osd.types import PgPool, PoolType, pg_t
+
+
+def three_level_map(rng, racks=4, hosts=4, osds=3):
+    """root -> rack -> host -> osd with randomized osd weights."""
+    m = CrushMap()
+    m.types = {0: "osd", 1: "host", 3: "rack", 10: "root"}
+    rack_ids, rack_w = [], []
+    osd = 0
+    for _ in range(racks):
+        host_ids, host_w = [], []
+        for _h in range(hosts):
+            devs = list(range(osd, osd + osds))
+            osd += osds
+            w = [int(rng.integers(0x8000, 0x30000)) for _ in devs]
+            hb = B.make_bucket(m, BucketAlg.STRAW2, 1, devs, w)
+            host_ids.append(hb.id)
+            host_w.append(hb.weight)
+        rb = B.make_bucket(m, BucketAlg.STRAW2, 3, host_ids, host_w)
+        rack_ids.append(rb.id)
+        rack_w.append(rb.weight)
+    root = B.make_bucket(m, BucketAlg.STRAW2, 10, rack_ids, rack_w)
+    m.bucket_names["default"] = root.id
+    return m, root
+
+
+def assert_rule_matches(m, ruleno, result_max, xs, weights=None, choose_args=None):
+    cc = compile_map(m, choose_args=choose_args)
+    bm = BatchedRuleMapper(cc, ruleno, result_max)
+    vals, cnt = bm(xs, weights)
+    for i, x in enumerate(xs):
+        ref = crush_do_rule(m, ruleno, int(x), result_max, weights, choose_args)
+        got = [int(v) for v in vals[i, : cnt[i]]]
+        assert ref == got, f"x={x}: ref={ref} got={got}"
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(20260730)
+
+
+@pytest.fixture(scope="module")
+def deep_map(rng):
+    return three_level_map(rng)
+
+
+XS = np.random.default_rng(11).integers(0, 2**32, 120, dtype=np.uint32)
+
+
+class TestBatchedRules:
+    def test_replicated_chooseleaf_firstn(self, deep_map):
+        m, root = deep_map
+        rid = B.add_simple_rule(m, root.id, 1, mode="firstn", rule_id=10)
+        assert_rule_matches(m, 10, 3, XS)
+        assert_rule_matches(m, 10, 5, XS)
+
+    def test_ec_chooseleaf_indep(self, deep_map):
+        m, root = deep_map
+        B.add_simple_rule(m, root.id, 1, mode="indep", rule_type=3, rule_id=11)
+        assert_rule_matches(m, 11, 6, XS)
+
+    def test_indep_rack_domain(self, deep_map):
+        m, root = deep_map
+        B.add_simple_rule(m, root.id, 3, mode="indep", rule_type=3, rule_id=12)
+        assert_rule_matches(m, 12, 4, XS)
+
+    def test_two_step_lrc_rule(self, deep_map):
+        m, root = deep_map
+        B.add_osd_multi_per_domain_rule(
+            m, root.id, 3, num_per_domain=2, num_domains=4, rule_id=13
+        )
+        assert_rule_matches(m, 13, 8, XS)
+
+    def test_choose_firstn_osd_direct(self, deep_map):
+        m, root = deep_map
+        B.add_simple_rule(m, root.id, 0, mode="firstn", rule_id=14)
+        assert_rule_matches(m, 14, 3, XS)
+
+    def test_reweights_zero_and_partial(self, deep_map, rng):
+        m, root = deep_map
+        B.add_simple_rule(m, root.id, 1, mode="firstn", rule_id=15)
+        B.add_simple_rule(m, root.id, 1, mode="indep", rule_type=3, rule_id=16)
+        w = np.full(m.max_devices, 0x10000, np.int64)
+        w[rng.integers(0, m.max_devices, 8)] = 0
+        w[rng.integers(0, m.max_devices, 8)] = rng.integers(1, 0x10000, 8)
+        weights = [int(v) for v in w]
+        assert_rule_matches(m, 15, 3, XS, weights=weights)
+        assert_rule_matches(m, 16, 6, XS, weights=weights)
+
+    def test_device_class_filter(self, deep_map):
+        m, root = deep_map
+        for o in range(m.max_devices):
+            B.set_device_class(m, o, "ssd" if o % 3 == 0 else "hdd")
+        rid = B.add_simple_rule(m, root.id, 1, mode="firstn", rule_id=17)
+        m.rules[rid].device_class = "hdd"
+        assert_rule_matches(m, 17, 3, XS)
+        m.rules[rid].device_class = None
+
+    def test_legacy_tunables(self, deep_map):
+        m, root = deep_map
+        B.add_simple_rule(m, root.id, 1, mode="firstn", rule_id=18)
+        B.add_simple_rule(m, root.id, 1, mode="indep", rule_type=3, rule_id=19)
+        saved = m.tunables
+        m.tunables = Tunables(
+            choose_local_tries=2, choose_local_fallback_tries=0,
+            choose_total_tries=19, chooseleaf_descend_once=0,
+            chooseleaf_vary_r=0, chooseleaf_stable=0,
+        )
+        try:
+            assert_rule_matches(m, 18, 3, XS)
+            assert_rule_matches(m, 19, 6, XS)
+        finally:
+            m.tunables = saved
+
+    def test_choose_args_weight_sets(self, deep_map, rng):
+        m, root = deep_map
+        B.add_simple_rule(m, root.id, 1, mode="firstn", rule_id=20)
+        n = root.size
+        ca = {
+            root.id: ChooseArg(
+                root.id,
+                weight_set=[
+                    [int(rng.integers(0x8000, 0x30000)) for _ in range(n)],
+                    [int(rng.integers(0x8000, 0x30000)) for _ in range(n)],
+                ],
+            )
+        }
+        assert_rule_matches(m, 20, 3, XS, choose_args=ca)
+
+    def test_unsupported_fallback_signalled(self):
+        m = CrushMap()
+        b = B.make_bucket(m, BucketAlg.LIST, 1, [0, 1, 2], [0x10000] * 3)
+        m.bucket_names["default"] = b.id
+        with pytest.raises(UnsupportedMap):
+            compile_map(m)
+
+
+class TestBatchedRemap:
+    @pytest.fixture(scope="class")
+    def cluster(self):
+        rng = np.random.default_rng(5)
+        m = CrushMap()
+        root = B.build_hierarchy(m, osds_per_host=4, n_hosts=8)
+        r_rep = B.add_simple_rule(m, root.id, 1, mode="firstn")
+        r_ec = B.add_simple_rule(m, root.id, 1, mode="indep", rule_type=3)
+        om = OSDMap(crush=m)
+        for o in range(32):
+            om.new_osd(o)
+        om.mark_down(5)
+        om.mark_down(17)
+        om.mark_out(9)
+        om.osd_weight[11] = 0x8000
+        om.set_primary_affinity(3, 0x4000)
+        om.set_primary_affinity(20, 0)
+        om.pools[1] = PgPool(
+            id=1, type=PoolType.REPLICATED, size=3,
+            crush_rule=r_rep, pg_num=64, pgp_num=64,
+        )
+        om.pools[2] = PgPool(
+            id=2, type=PoolType.ERASURE, size=6, min_size=5,
+            crush_rule=r_ec, pg_num=32, pgp_num=32,
+        )
+        om.pg_upmap[pg_t(1, 3)] = [0, 4, 8]
+        om.pg_upmap_items[pg_t(1, 7)] = [(1, 2)]
+        om.pg_upmap_items[pg_t(2, 5)] = [(6, 7)]
+        om.pg_upmap_primaries[pg_t(1, 9)] = 8
+        om.pg_temp[pg_t(2, 11)] = [1, 2, 3, 4, 6, 7]
+        om.primary_temp[pg_t(1, 13)] = 12
+        return om
+
+    def test_cluster_remap_matches_scalar(self, cluster):
+        bcm = BatchedClusterMapper(cluster)
+        for pid, pm in bcm.map_cluster().items():
+            pool = cluster.pools[pid]
+            for ps in range(pool.pg_num):
+                ref = cluster.pg_to_up_acting_osds(pg_t(pid, ps), folded=True)
+                assert pm.rows(ps) == (ref[0], ref[1], ref[2], ref[3]), (
+                    pid, ps,
+                )
+
+    def test_ec_rows_keep_positional_holes(self, cluster):
+        bcm = BatchedClusterMapper(cluster)
+        pm = bcm.map_pool(2)
+        # every EC row has exactly pool.size positions
+        assert (pm.up_cnt == 6).all()
+
+    def test_epoch_change_remap(self, cluster):
+        """Kill an OSD -> whole-cluster remap still matches scalar."""
+        om = OSDMap(
+            crush=cluster.crush, epoch=cluster.epoch + 1,
+            max_osd=cluster.max_osd,
+            osd_state=list(cluster.osd_state),
+            osd_weight=list(cluster.osd_weight),
+            osd_primary_affinity=list(cluster.osd_primary_affinity),
+            pools=cluster.pools,
+        )
+        om.mark_down(0)
+        om.mark_out(0)
+        bcm = BatchedClusterMapper(om)
+        for pid, pm in bcm.map_cluster().items():
+            pool = om.pools[pid]
+            for ps in range(pool.pg_num):
+                ref = om.pg_to_up_acting_osds(pg_t(pid, ps), folded=True)
+                assert pm.rows(ps) == (ref[0], ref[1], ref[2], ref[3])

@@ -6,7 +6,6 @@ explicit mapping+layers configuration, layered minimum_to_decode
 recovery.
 """
 
-import itertools
 import json
 
 import numpy as np
