@@ -172,6 +172,16 @@ def compile_map(
     )
 
 
+def _jm_for(cc: CompiledCrush) -> "_Jm":
+    """One shared device-side view per compiled map (the arrays are
+    immutable after compile, so every rule mapper can reuse them)."""
+    jm = getattr(cc, "_jm_cache", None)
+    if jm is None:
+        jm = _Jm(cc)
+        cc._jm_cache = jm
+    return jm
+
+
 class _Jm:
     """Device-side (traced-constant) view of a CompiledCrush."""
 
@@ -683,7 +693,7 @@ class BatchedRuleMapper:
         import jax.numpy as jnp
 
         cc = self.cc
-        jm = _Jm(cc)
+        jm = _jm_for(cc)
         if self.rule.device_class is not None:
             mask = np.zeros(max(cc.max_devices, 1), bool)
             for osd, cls in cc.device_classes.items():

@@ -1,0 +1,14 @@
+"""Wire transport (reference src/msg/): denc encoding, msgr2-style
+frames, the asyncio messenger, and the typed message set."""
+
+from ceph_tpu.msg.denc import Decoder, Encoder, EncodingError
+from ceph_tpu.msg.messenger import Connection, Message, Messenger
+
+__all__ = [
+    "Connection",
+    "Decoder",
+    "Encoder",
+    "EncodingError",
+    "Message",
+    "Messenger",
+]

@@ -1,0 +1,95 @@
+"""msgr2-style framed wire protocol.
+
+Behavioral twin of the reference's protocol v2 framing
+(src/msg/async/frames_v2.h:40-143): a banner exchange, then segmented
+frames — preamble (tag, segment count, segment lengths, preamble crc)
+followed by the segments and an epilogue carrying per-segment crc32c.
+Secure (AES-GCM) mode and on-wire compression are not implemented yet;
+crc mode matches the reference's rev1 epilogue semantics.
+
+All crcs use the native crc32c runtime (ceph_tpu/native), seeded -1
+like the reference frame crcs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+from ceph_tpu.native import crc32c
+
+BANNER = b"ceph_tpu msgr2.0\n"
+MAX_SEGMENTS = 4
+MAX_FRAME_LEN = 256 * 1024 * 1024
+
+
+class Tag:
+    """frames_v2.h:40-54 (the subset the mini-cluster speaks)."""
+
+    HELLO = 1
+    AUTH_REQUEST = 2
+    AUTH_DONE = 3
+    MESSAGE = 17
+    KEEPALIVE2 = 14
+    KEEPALIVE2_ACK = 15
+    ACK = 16
+    CLOSE = 18
+
+
+class FrameError(ConnectionError):
+    pass
+
+
+async def send_banner(writer: asyncio.StreamWriter, features: int = 1) -> None:
+    writer.write(BANNER + struct.pack("<Q", features))
+    await writer.drain()
+
+
+async def recv_banner(reader: asyncio.StreamReader) -> int:
+    got = await reader.readexactly(len(BANNER))
+    if got != BANNER:
+        raise FrameError(f"bad banner {got!r}")
+    (features,) = struct.unpack("<Q", await reader.readexactly(8))
+    return features
+
+
+def _preamble(tag: int, seg_lens: list[int]) -> bytes:
+    head = struct.pack(
+        "<BB4I", tag, len(seg_lens),
+        *(seg_lens + [0] * (MAX_SEGMENTS - len(seg_lens))),
+    )
+    return head + struct.pack("<I", crc32c(head))
+
+
+async def write_frame(
+    writer: asyncio.StreamWriter, tag: int, segments: list[bytes]
+) -> None:
+    assert 0 < len(segments) <= MAX_SEGMENTS
+    segs = [bytes(s) for s in segments]
+    writer.write(_preamble(tag, [len(s) for s in segs]))
+    for s in segs:
+        writer.write(s)
+    # epilogue: one crc32c per present segment (frames_v2.h:124-143)
+    writer.write(struct.pack(f"<{len(segs)}I", *(crc32c(s) for s in segs)))
+    await writer.drain()
+
+
+async def read_frame(
+    reader: asyncio.StreamReader,
+) -> tuple[int, list[bytes]]:
+    head = await reader.readexactly(18)
+    (want_crc,) = struct.unpack("<I", await reader.readexactly(4))
+    if crc32c(head) != want_crc:
+        raise FrameError("preamble crc mismatch")
+    tag, nseg = head[0], head[1]
+    if not 0 < nseg <= MAX_SEGMENTS:
+        raise FrameError(f"bad segment count {nseg}")
+    seg_lens = struct.unpack("<4I", head[2:])[:nseg]
+    if sum(seg_lens) > MAX_FRAME_LEN:
+        raise FrameError("frame too large")
+    segs = [await reader.readexactly(n) for n in seg_lens]
+    crcs = struct.unpack(f"<{nseg}I", await reader.readexactly(4 * nseg))
+    for s, c in zip(segs, crcs):
+        if crc32c(s) != c:
+            raise FrameError("segment crc mismatch")
+    return tag, list(segs)

@@ -1,0 +1,238 @@
+"""Async messenger: connections, dispatch, typed messages.
+
+Behavioral twin of the reference messenger layer (src/msg/Messenger.h,
+src/msg/async/AsyncMessenger.cc): an entity (osd.3, mon.0, client.17)
+owns one Messenger; connections are established lazily by address,
+carry a HELLO handshake (peer identity exchange, ProtocolV2.cc
+HelloFrame), and deliver typed messages to the owner's dispatcher.
+The asyncio event loop plays the role of the reference's epoll worker
+threads; per-connection send serialization replaces the write-queue
+locks.
+
+Messages subclass :class:`Message` and register a wire type id; the
+MESSAGE frame is [header segment | payload segment] like the
+reference's msgr2 message frames (header: type, source entity, seq).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable
+
+from ceph_tpu.msg import frames
+from ceph_tpu.msg.denc import Decoder, Encoder
+
+log = logging.getLogger("ceph_tpu.msg")
+
+_REGISTRY: dict[int, type] = {}
+
+
+class Message:
+    """Typed wire message.  Subclasses set ``TYPE`` and implement
+    encode_payload/decode_payload."""
+
+    TYPE = 0
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if cls.TYPE:
+            prev = _REGISTRY.setdefault(cls.TYPE, cls)
+            assert prev is cls, f"duplicate message type {cls.TYPE}"
+
+    # filled in on receive
+    src: tuple[str, int] | None = None
+    conn: "Connection | None" = None
+
+    def encode_payload(self, enc: Encoder) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder) -> "Message":  # pragma: no cover
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__}>"
+
+
+def encode_message(msg: Message, src: tuple[str, int], seq: int) -> list[bytes]:
+    head = Encoder()
+    head.u32(type(msg).TYPE)
+    head.str_(src[0])
+    head.i64(src[1])
+    head.u64(seq)
+    payload = Encoder()
+    msg.encode_payload(payload)
+    return [head.bytes(), payload.bytes()]
+
+
+def decode_message(segments: list[bytes]) -> Message:
+    dec = Decoder(segments[0])
+    mtype = dec.u32()
+    src = (dec.str_(), dec.i64())
+    _seq = dec.u64()
+    cls = _REGISTRY.get(mtype)
+    if cls is None:
+        raise frames.FrameError(f"unknown message type {mtype}")
+    msg = cls.decode_payload(Decoder(segments[1]))
+    msg.src = src
+    return msg
+
+
+class Connection:
+    """One established peer session (reference AsyncConnection)."""
+
+    def __init__(
+        self,
+        messenger: "Messenger",
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        peer: tuple[str, int] | None = None,
+    ):
+        self.messenger = messenger
+        self.reader = reader
+        self.writer = writer
+        self.peer = peer            # entity, learned in HELLO
+        self.peer_addr: tuple[str, int] | None = None  # (host, port), for reconnect
+        self._send_lock = asyncio.Lock()
+        self._seq = 0
+        self._closed = False
+        self._reader_task: asyncio.Task | None = None
+
+    async def send_message(self, msg: Message) -> None:
+        if self._closed:
+            raise ConnectionError("connection closed")
+        async with self._send_lock:
+            self._seq += 1
+            segs = encode_message(msg, self.messenger.entity, self._seq)
+            await frames.write_frame(self.writer, frames.Tag.MESSAGE, segs)
+
+    async def _run(self) -> None:
+        try:
+            while not self._closed:
+                tag, segs = await frames.read_frame(self.reader)
+                if tag == frames.Tag.MESSAGE:
+                    msg = decode_message(segs)
+                    msg.conn = self
+                    await self.messenger._dispatch(msg)
+                elif tag == frames.Tag.KEEPALIVE2:
+                    await frames.write_frame(
+                        self.writer, frames.Tag.KEEPALIVE2_ACK, segs
+                    )
+                elif tag == frames.Tag.CLOSE:
+                    break
+        except (
+            asyncio.IncompleteReadError, ConnectionError, OSError
+        ) as e:
+            if not self._closed:
+                log.debug("%s: connection lost: %r", self.messenger.entity, e)
+        finally:
+            await self.close(notify=True)
+
+    async def close(self, notify: bool = False) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.messenger._forget(self)
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        if notify:
+            await self.messenger._handle_reset(self)
+
+
+class Messenger:
+    """Owns the listener + connection table for one entity."""
+
+    def __init__(
+        self,
+        entity: tuple[str, int],
+        dispatcher: Callable[[Message], Awaitable[None]] | None = None,
+        on_reset: Callable[[Connection], Awaitable[None]] | None = None,
+    ):
+        self.entity = entity
+        self.dispatcher = dispatcher
+        self.on_reset = on_reset
+        self._server: asyncio.base_events.Server | None = None
+        self._conns: dict[tuple[str, int], Connection] = {}  # by entity
+        self._accepted: set[Connection] = set()
+        self.addr: tuple[str, int] | None = None
+
+    async def _dispatch(self, msg: Message) -> None:
+        if self.dispatcher is not None:
+            await self.dispatcher(msg)
+
+    async def _handle_reset(self, conn: Connection) -> None:
+        if self.on_reset is not None:
+            await self.on_reset(conn)
+
+    def _forget(self, conn: Connection) -> None:
+        self._accepted.discard(conn)
+        if conn.peer is not None and self._conns.get(conn.peer) is conn:
+            del self._conns[conn.peer]
+
+    # -- server side ---------------------------------------------------
+
+    async def bind(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._accept, host, port)
+        sock = self._server.sockets[0]
+        self.addr = sock.getsockname()[:2]
+        return self.addr
+
+    async def _accept(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        conn = Connection(self, reader, writer)
+        try:
+            await frames.send_banner(writer)
+            await frames.recv_banner(reader)
+            # HELLO: peer introduces itself first, then we do
+            tag, segs = await frames.read_frame(reader)
+            if tag != frames.Tag.HELLO:
+                raise frames.FrameError(f"expected HELLO, got {tag}")
+            dec = Decoder(segs[0])
+            conn.peer = (dec.str_(), dec.i64())
+            enc = Encoder()
+            enc.str_(self.entity[0])
+            enc.i64(self.entity[1])
+            await frames.write_frame(writer, frames.Tag.HELLO, [enc.bytes()])
+        except (ConnectionError, asyncio.IncompleteReadError, OSError):
+            writer.close()
+            return
+        self._conns[conn.peer] = conn
+        self._accepted.add(conn)
+        conn._reader_task = asyncio.ensure_future(conn._run())
+
+    # -- client side ---------------------------------------------------
+
+    async def connect(self, host: str, port: int) -> Connection:
+        reader, writer = await asyncio.open_connection(host, port)
+        conn = Connection(self, reader, writer)
+        conn.peer_addr = (host, port)
+        await frames.recv_banner(reader)
+        await frames.send_banner(writer)
+        enc = Encoder()
+        enc.str_(self.entity[0])
+        enc.i64(self.entity[1])
+        await frames.write_frame(writer, frames.Tag.HELLO, [enc.bytes()])
+        tag, segs = await frames.read_frame(reader)
+        if tag != frames.Tag.HELLO:
+            raise frames.FrameError(f"expected HELLO, got {tag}")
+        dec = Decoder(segs[0])
+        conn.peer = (dec.str_(), dec.i64())
+        self._conns[conn.peer] = conn
+        conn._reader_task = asyncio.ensure_future(conn._run())
+        return conn
+
+    def get_connection(self, peer: tuple[str, int]) -> Connection | None:
+        return self._conns.get(peer)
+
+    async def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for conn in list(self._conns.values()) + list(self._accepted):
+            await conn.close()
+        self._conns.clear()
+        self._accepted.clear()

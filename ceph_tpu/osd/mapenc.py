@@ -1,0 +1,322 @@
+"""OSDMap / CrushMap wire encoding.
+
+The reference versions every map struct (OSDMap::encode
+src/osd/OSDMap.cc, CrushWrapper::encode src/crush/CrushWrapper.cc) so
+maps can ship between daemons and persist in the mon store.  Same
+contract here over the denc module: ``encode_osdmap``/``decode_osdmap``
+round-trip the full cluster map — crush buckets/rules/tunables/
+choose_args, pools, osd states/weights/affinity/addresses, upmap and
+temp exception tables, EC profiles.
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.crush.types import (
+    Bucket,
+    BucketAlg,
+    ChooseArg,
+    CrushMap,
+    Rule,
+    RuleOp,
+    RuleStep,
+    Tunables,
+)
+from ceph_tpu.msg.denc import Decoder, Encoder
+from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.osd.types import PgPool, pg_t
+
+
+# -- crush ------------------------------------------------------------------
+
+def encode_crush(enc: Encoder, m: CrushMap) -> None:
+    with enc.versioned(1, 1):
+        enc.u32(m.max_devices)
+        enc.u32(len(m.buckets))
+        for bid in sorted(m.buckets):
+            b = m.buckets[bid]
+            enc.i32(b.id)
+            enc.i32(b.type)
+            enc.u8(int(b.alg))
+            enc.u8(b.hash)
+            enc.u32(b.size)
+            for it in b.items:
+                enc.i32(it)
+            for w in b.item_weights:
+                enc.u32(w)
+            for name, arr in (
+                ("sum", b.sum_weights),
+                ("node", b.node_weights),
+                ("straw", b.straws),
+            ):
+                enc.u32(len(arr))
+                for v in arr:
+                    enc.u64(v)
+        enc.u32(len(m.rules))
+        for rid in sorted(m.rules):
+            r = m.rules[rid]
+            enc.u32(rid)
+            enc.u32(r.rule_type)
+            enc.bool_(r.device_class is not None)
+            if r.device_class is not None:
+                enc.str_(r.device_class)
+            enc.u32(len(r.steps))
+            for s in r.steps:
+                enc.u32(int(s.op))
+                enc.i32(s.arg1)
+                enc.i32(s.arg2)
+        enc.u32(len(m.types))
+        for tid in sorted(m.types):
+            enc.i32(tid)
+            enc.str_(m.types[tid])
+        t = m.tunables
+        for v in (
+            t.choose_local_tries, t.choose_local_fallback_tries,
+            t.choose_total_tries, t.chooseleaf_descend_once,
+            t.chooseleaf_vary_r, t.chooseleaf_stable,
+        ):
+            enc.u32(v)
+        enc.u32(len(m.choose_args))
+        for bid in sorted(m.choose_args):
+            arg = m.choose_args[bid]
+            enc.i32(bid)
+            ws = arg.weight_set or []
+            enc.u32(len(ws))
+            for pos in ws:
+                enc.u32(len(pos))
+                for w in pos:
+                    enc.u64(w)
+            ids = arg.ids
+            enc.bool_(ids is not None)
+            if ids is not None:
+                enc.u32(len(ids))
+                for i in ids:
+                    enc.i32(i)
+        enc.u32(len(m.bucket_names))
+        for name in sorted(m.bucket_names):
+            enc.str_(name)
+            enc.i32(m.bucket_names[name])
+        enc.u32(len(m.rule_names))
+        for name in sorted(m.rule_names):
+            enc.str_(name)
+            enc.i32(m.rule_names[name])
+        enc.u32(len(m.device_classes))
+        for osd in sorted(m.device_classes):
+            enc.i32(osd)
+            enc.str_(m.device_classes[osd])
+
+
+def decode_crush(dec: Decoder) -> CrushMap:
+    m = CrushMap(types={})
+    with dec.versioned():
+        m.max_devices = dec.u32()
+        for _ in range(dec.u32()):
+            bid = dec.i32()
+            btype = dec.i32()
+            alg = BucketAlg(dec.u8())
+            hash_ = dec.u8()
+            size = dec.u32()
+            items = [dec.i32() for _ in range(size)]
+            weights = [dec.u32() for _ in range(size)]
+            b = Bucket(
+                id=bid, type=btype, alg=alg, hash=hash_,
+                items=items, item_weights=weights,
+            )
+            b.sum_weights = [dec.u64() for _ in range(dec.u32())]
+            b.node_weights = [dec.u64() for _ in range(dec.u32())]
+            b.straws = [dec.u64() for _ in range(dec.u32())]
+            m.buckets[bid] = b
+        for _ in range(dec.u32()):
+            rid = dec.u32()
+            rtype = dec.u32()
+            device_class = dec.str_() if dec.bool_() else None
+            steps = [
+                RuleStep(RuleOp(dec.u32()), dec.i32(), dec.i32())
+                for _ in range(dec.u32())
+            ]
+            m.rules[rid] = Rule(
+                rule_type=rtype, steps=steps, device_class=device_class
+            )
+        for _ in range(dec.u32()):
+            tid = dec.i32()
+            m.types[tid] = dec.str_()
+        m.tunables = Tunables(
+            choose_local_tries=dec.u32(),
+            choose_local_fallback_tries=dec.u32(),
+            choose_total_tries=dec.u32(),
+            chooseleaf_descend_once=dec.u32(),
+            chooseleaf_vary_r=dec.u32(),
+            chooseleaf_stable=dec.u32(),
+        )
+        for _ in range(dec.u32()):
+            bid = dec.i32()
+            nws = dec.u32()
+            ws = [[dec.u64() for _ in range(dec.u32())] for _ in range(nws)]
+            ids = None
+            if dec.bool_():
+                ids = [dec.i32() for _ in range(dec.u32())]
+            m.choose_args[bid] = ChooseArg(
+                bid, weight_set=ws or None, ids=ids
+            )
+        for _ in range(dec.u32()):
+            name = dec.str_()
+            m.bucket_names[name] = dec.i32()
+        for _ in range(dec.u32()):
+            name = dec.str_()
+            m.rule_names[name] = dec.i32()
+        for _ in range(dec.u32()):
+            osd = dec.i32()
+            m.device_classes[osd] = dec.str_()
+    return m
+
+
+# -- pools ------------------------------------------------------------------
+
+def _encode_pool(enc: Encoder, p: PgPool) -> None:
+    with enc.versioned(1, 1):
+        enc.i64(p.id)
+        enc.u8(p.type)
+        enc.u32(p.size)
+        enc.u32(p.min_size)
+        enc.i32(p.crush_rule)
+        enc.u32(p.pg_num)
+        enc.u32(p.pgp_num)
+        enc.u32(p.flags)
+        enc.str_(p.erasure_code_profile)
+        enc.u32(len(p.extra))
+        for k in sorted(p.extra):
+            enc.str_(k)
+            enc.str_(str(p.extra[k]))
+
+
+def _decode_pool(dec: Decoder) -> PgPool:
+    with dec.versioned():
+        p = PgPool(
+            id=dec.i64(), type=dec.u8(), size=dec.u32(), min_size=dec.u32(),
+            crush_rule=dec.i32(), pg_num=dec.u32(), pgp_num=dec.u32(),
+            flags=dec.u32(), erasure_code_profile=dec.str_(),
+        )
+        for _ in range(dec.u32()):
+            k = dec.str_()
+            p.extra[k] = dec.str_()
+    return p
+
+
+# -- osdmap -----------------------------------------------------------------
+
+def _encode_pg_table(enc: Encoder, table: dict, value_enc) -> None:
+    enc.u32(len(table))
+    for pg in sorted(table, key=lambda g: (g.pool, g.ps)):
+        enc.i64(pg.pool)
+        enc.u32(pg.ps)
+        value_enc(table[pg])
+
+
+def _decode_pg_table(dec: Decoder, value_dec) -> dict:
+    out = {}
+    for _ in range(dec.u32()):
+        pool = dec.i64()
+        ps = dec.u32()
+        out[pg_t(pool, ps)] = value_dec()
+    return out
+
+
+def encode_osdmap(m: OSDMap) -> bytes:
+    enc = Encoder()
+    with enc.versioned(1, 1):
+        enc.u32(m.epoch)
+        enc.u32(m.max_osd)
+        for s in m.osd_state:
+            enc.u8(s)
+        for w in m.osd_weight:
+            enc.u32(w)
+        enc.bool_(m.osd_primary_affinity is not None)
+        if m.osd_primary_affinity is not None:
+            for a in m.osd_primary_affinity:
+                enc.u32(a)
+        enc.u32(len(m.pools))
+        for pid in sorted(m.pools):
+            _encode_pool(enc, m.pools[pid])
+        _encode_pg_table(
+            enc, m.pg_upmap,
+            lambda v: (enc.u32(len(v)), [enc.i32(o) for o in v]),
+        )
+        _encode_pg_table(
+            enc, m.pg_upmap_items,
+            lambda v: (
+                enc.u32(len(v)),
+                [(enc.i32(a), enc.i32(b)) for a, b in v],
+            ),
+        )
+        _encode_pg_table(enc, m.pg_upmap_primaries, lambda v: enc.i32(v))
+        _encode_pg_table(
+            enc, m.pg_temp,
+            lambda v: (enc.u32(len(v)), [enc.i32(o) for o in v]),
+        )
+        _encode_pg_table(enc, m.primary_temp, lambda v: enc.i32(v))
+        enc.u32(len(m.erasure_code_profiles))
+        for name in sorted(m.erasure_code_profiles):
+            enc.str_(name)
+            prof = m.erasure_code_profiles[name]
+            enc.u32(len(prof))
+            for k in sorted(prof):
+                enc.str_(k)
+                enc.str_(prof[k])
+        enc.u32(len(m.osd_addrs))
+        for osd in sorted(m.osd_addrs):
+            host, port = m.osd_addrs[osd]
+            enc.i32(osd)
+            enc.str_(host)
+            enc.u32(port)
+        encode_crush(enc, m.crush)
+    return enc.bytes()
+
+
+def decode_osdmap(data: bytes) -> OSDMap:
+    dec = Decoder(data)
+    with dec.versioned():
+        epoch = dec.u32()
+        max_osd = dec.u32()
+        osd_state = [dec.u8() for _ in range(max_osd)]
+        osd_weight = [dec.u32() for _ in range(max_osd)]
+        affinity = None
+        if dec.bool_():
+            affinity = [dec.u32() for _ in range(max_osd)]
+        pools = {}
+        for _ in range(dec.u32()):
+            p = _decode_pool(dec)
+            pools[p.id] = p
+        pg_upmap = _decode_pg_table(
+            dec, lambda: [dec.i32() for _ in range(dec.u32())]
+        )
+        pg_upmap_items = _decode_pg_table(
+            dec,
+            lambda: [(dec.i32(), dec.i32()) for _ in range(dec.u32())],
+        )
+        pg_upmap_primaries = _decode_pg_table(dec, dec.i32)
+        pg_temp = _decode_pg_table(
+            dec, lambda: [dec.i32() for _ in range(dec.u32())]
+        )
+        primary_temp = _decode_pg_table(dec, dec.i32)
+        profiles = {}
+        for _ in range(dec.u32()):
+            name = dec.str_()
+            profiles[name] = {
+                dec.str_(): dec.str_() for _ in range(dec.u32())
+            }
+        addrs = {}
+        for _ in range(dec.u32()):
+            osd = dec.i32()
+            host = dec.str_()
+            addrs[osd] = (host, dec.u32())
+        crush = decode_crush(dec)
+    om = OSDMap(
+        crush=crush, epoch=epoch, max_osd=max_osd,
+        osd_state=osd_state, osd_weight=osd_weight,
+        osd_primary_affinity=affinity, pools=pools,
+        pg_upmap=pg_upmap, pg_upmap_items=pg_upmap_items,
+        pg_upmap_primaries=pg_upmap_primaries,
+        pg_temp=pg_temp, primary_temp=primary_temp,
+        erasure_code_profiles=profiles, osd_addrs=addrs,
+    )
+    om.choose_args = crush.choose_args or None
+    return om

@@ -55,6 +55,8 @@ class OSDMap:
     primary_temp: dict[pg_t, int] = field(default_factory=dict)
     erasure_code_profiles: dict[str, dict[str, str]] = field(default_factory=dict)
     choose_args: dict[int, ChooseArg] | None = None
+    # entity addresses (reference OSDMap osd_addrs): osd -> (host, port)
+    osd_addrs: dict[int, tuple[str, int]] = field(default_factory=dict)
 
     # -- osd state ---------------------------------------------------
 

@@ -30,7 +30,7 @@ from ceph_tpu.crush.jaxmapper import (
 )
 from ceph_tpu.crush.types import CRUSH_ITEM_NONE
 from ceph_tpu.ops.hashing import crush_hash32_2
-from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.osd.osdmap import CEPH_OSD_EXISTS, CEPH_OSD_UP, OSDMap
 from ceph_tpu.osd.types import (
     CEPH_OSD_DEFAULT_PRIMARY_AFFINITY,
     CEPH_OSD_MAX_PRIMARY_AFFINITY,
@@ -103,7 +103,17 @@ class BatchedClusterMapper:
         if pool is None:
             raise KeyError(f"no pool {poolid}")
         b = pool.pg_num
+        # rows must hold the widest legal result: CRUSH output is
+        # pool.size wide, but explicit pg_upmap vectors and pg_temp
+        # acting sets may legally be longer (the scalar pipeline returns
+        # them whole)
         width = pool.size
+        for pg, vec in om.pg_upmap.items():
+            if pg.pool == poolid:
+                width = max(width, len(vec))
+        for pg, vec in om.pg_temp.items():
+            if pg.pool == poolid:
+                width = max(width, len(vec))
 
         ps = np.arange(b, dtype=np.uint32)
         pgp = _stable_mod_vec(ps, pool.pgp_num, pool.pgp_num_mask)
@@ -118,9 +128,10 @@ class BatchedClusterMapper:
             else None
         )
         if mapper is not None:
-            raw, cnt = mapper(pps, om.osd_weight)
-            raw = raw.astype(np.int32).copy()
+            raw0, cnt = mapper(pps, om.osd_weight)
             cnt = cnt.astype(np.int32).copy()
+            raw = np.full((b, width), _NONE, np.int32)
+            raw[:, : raw0.shape[1]] = raw0
         elif pool.crush_rule in om.crush.rules:
             # scalar fallback (unsupported map features)
             raw = np.full((b, width), _NONE, np.int32)
@@ -140,8 +151,11 @@ class BatchedClusterMapper:
 
         max_osd = om.max_osd
         state = np.asarray(om.osd_state + [0], np.int64)  # +pad for max_osd==0
-        exists = (state[:-1] & 1).astype(bool) if max_osd else np.zeros(0, bool)
-        up_ok = (state[:-1] & 2).astype(bool) & exists if max_osd else exists
+        if max_osd:
+            exists = (state[:-1] & CEPH_OSD_EXISTS).astype(bool)
+            up_ok = (state[:-1] & CEPH_OSD_UP).astype(bool) & exists
+        else:
+            exists = up_ok = np.zeros(0, bool)
 
         in_prefix = np.arange(width)[None, :] < cnt[:, None]
         valid = in_prefix & (raw != _NONE)
@@ -155,10 +169,12 @@ class BatchedClusterMapper:
                 ok[:] = False
             return ok
 
-        # 1. _remove_nonexistent_osds (OSDMap.cc:2646-2668)
+        # 1. _remove_nonexistent_osds (OSDMap.cc:2646-2668): shiftable
+        # pools drop every non-existent entry INCLUDING holes (the
+        # scalar keeps only exists(o)); EC pools hole them out in place
         keep = _alive(exists)
         if pool.can_shift_osds():
-            raw, cnt = self._compact(raw, cnt, keep | ~valid, in_prefix)
+            raw, cnt = self._compact(raw, cnt, keep, in_prefix)
         else:
             raw = np.where(valid & ~keep, _NONE, raw)
 
@@ -171,6 +187,8 @@ class BatchedClusterMapper:
         for psv in affected:
             row = [int(v) for v in raw[psv, : cnt[psv]]]
             om._apply_upmap(pool, pg_t(poolid, psv), row)
+            assert len(row) <= width, (len(row), width)
+            raw[psv, :] = _NONE
             raw[psv, : len(row)] = row
             cnt[psv] = len(row)
 
@@ -200,9 +218,10 @@ class BatchedClusterMapper:
         for psv in temp_ps:
             temp_pg, temp_primary = om._get_temp_osds(pool, pg_t(poolid, psv))
             if temp_pg:
-                n = min(len(temp_pg), width)
+                n = len(temp_pg)
+                assert n <= width, (n, width)
                 acting[psv, :] = _NONE
-                acting[psv, :n] = temp_pg[:n]
+                acting[psv, :n] = temp_pg
                 acting_cnt[psv] = n
                 acting_primary[psv] = temp_primary
             elif temp_primary != -1:

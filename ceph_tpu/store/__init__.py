@@ -1,0 +1,23 @@
+"""Local storage engines (reference src/os/): the ObjectStore
+transaction seam and the in-RAM MemStore used by tests and the
+mini-cluster OSD."""
+
+from ceph_tpu.store.memstore import MemStore
+from ceph_tpu.store.objectstore import (
+    META_COLL,
+    ObjectStore,
+    Transaction,
+    TxOp,
+    coll_t,
+    ghobject_t,
+)
+
+__all__ = [
+    "META_COLL",
+    "MemStore",
+    "ObjectStore",
+    "Transaction",
+    "TxOp",
+    "coll_t",
+    "ghobject_t",
+]
