@@ -1,0 +1,5 @@
+"""Client access library (reference src/librados + src/osdc)."""
+
+from ceph_tpu.client.rados import IoCtx, RadosClient, RadosError
+
+__all__ = ["IoCtx", "RadosClient", "RadosError"]

@@ -1,0 +1,227 @@
+"""librados-style client: cluster handle, IoCtx, op targeting.
+
+Twin of the reference client stack (librados IoCtx ->
+IoCtxImpl::operate -> Objecter::op_submit, SURVEY.md §3.1): the cluster
+handle subscribes to maps from the mon; each op hashes the object name
+to a PG (object_locator_to_pg via ceph_str_hash_rjenkins), computes the
+acting primary with the same OSDMap pipeline the OSDs use
+(Objecter::_calc_target, src/osdc/Objecter.cc:2783), sends an MOSDOp
+to it, and resends after a map change when the primary moved or
+replied -EAGAIN — the Objecter's resend-on-new-epoch behavior.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+import itertools
+import logging
+import os
+
+from ceph_tpu.msg.messages import (
+    MMonCommand,
+    MMonCommandAck,
+    MOSDMap,
+    MOSDOp,
+    MOSDOpReply,
+    OP_DELETE,
+    OP_READ,
+    OP_STAT,
+    OP_WRITE_FULL,
+)
+from ceph_tpu.msg.messenger import Connection, Message, Messenger
+from ceph_tpu.osd.daemon import object_to_pg
+from ceph_tpu.osd.mapenc import decode_osdmap
+from ceph_tpu.osd.osdmap import OSDMap
+
+log = logging.getLogger("ceph_tpu.client")
+
+OP_TIMEOUT = 30.0
+MAX_RETRIES = 12
+
+
+class RadosError(OSError):
+    pass
+
+
+class RadosClient:
+    """The cluster handle (librados::Rados)."""
+
+    def __init__(self, client_id: int | None = None):
+        self.id = client_id if client_id is not None else (os.getpid() << 8) | 1
+        self.messenger = Messenger(("client", self.id), self._dispatch)
+        self.osdmap: OSDMap | None = None
+        self._mon_conn: Connection | None = None
+        self._tids = itertools.count(1)
+        self._op_waiters: dict[int, asyncio.Future] = {}
+        self._cmd_waiters: dict[int, asyncio.Future] = {}
+        self._map_event = asyncio.Event()
+
+    async def connect(self, mon_host: str, mon_port: int) -> None:
+        from ceph_tpu.msg.messages import MMonSubscribe
+
+        self._mon_conn = await self.messenger.connect_to(
+            ("mon", 0), mon_host, mon_port
+        )
+        await self._mon_conn.send_message(MMonSubscribe())
+        await self._wait_new_map(0, timeout=10.0)
+        if self.osdmap is None:
+            raise RadosError(errno.ETIMEDOUT, "no map from mon")
+
+    async def shutdown(self) -> None:
+        await self.messenger.shutdown()
+
+    async def _dispatch(self, msg: Message) -> None:
+        if isinstance(msg, MOSDMap):
+            for epoch in sorted(msg.maps):
+                if self.osdmap is None or epoch > self.osdmap.epoch:
+                    self.osdmap = decode_osdmap(msg.maps[epoch])
+            ev, self._map_event = self._map_event, asyncio.Event()
+            ev.set()  # wake everyone waiting for "a newer map than X"
+        elif isinstance(msg, MOSDOpReply):
+            fut = self._op_waiters.get(msg.tid)
+            if fut and not fut.done():
+                fut.set_result(msg)
+        elif isinstance(msg, MMonCommandAck):
+            fut = self._cmd_waiters.get(msg.tid)
+            if fut and not fut.done():
+                fut.set_result(msg)
+
+    async def _wait_new_map(self, than_epoch: int, timeout: float = 10.0) -> None:
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while self.osdmap is None or self.osdmap.epoch <= than_epoch:
+            # snapshot the event BEFORE re-checking: the dispatcher swaps
+            # it under us when a map lands
+            ev = self._map_event
+            if self.osdmap is not None and self.osdmap.epoch > than_epoch:
+                return
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                return
+            try:
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                return
+
+    # -- admin commands ------------------------------------------------
+
+    async def command(self, cmd: dict[str, str]) -> tuple[int, str, bytes]:
+        tid = next(self._tids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._cmd_waiters[tid] = fut
+        try:
+            await self._mon_conn.send_message(MMonCommand(tid=tid, cmd=cmd))
+            ack: MMonCommandAck = await asyncio.wait_for(fut, OP_TIMEOUT)
+            return ack.code, ack.rs, ack.data
+        finally:
+            self._cmd_waiters.pop(tid, None)
+
+    async def pool_create(
+        self, name: str, pg_num: int = 8, pool_type: str = "replicated", **kw
+    ) -> int:
+        import json
+
+        cmd = {
+            "prefix": "osd pool create", "name": name,
+            "pg_num": str(pg_num), "pool_type": pool_type,
+        }
+        cmd.update({k: str(v) for k, v in kw.items()})
+        code, rs, data = await self.command(cmd)
+        if code != 0:
+            raise RadosError(-code, rs)
+        return json.loads(data)["pool_id"]
+
+    async def ec_profile_set(self, name: str, profile: dict[str, str]) -> None:
+        code, rs, _ = await self.command({
+            "prefix": "osd erasure-code-profile set", "name": name,
+            "profile": " ".join(f"{k}={v}" for k, v in profile.items()),
+        })
+        if code != 0:
+            raise RadosError(-code, rs)
+
+    def ioctx(self, pool_name: str) -> "IoCtx":
+        pid = self.osdmap.lookup_pg_pool_name(pool_name)
+        if pid < 0:
+            raise RadosError(errno.ENOENT, f"no pool {pool_name!r}")
+        return IoCtx(self, pid)
+
+    # -- op engine (Objecter) ------------------------------------------
+
+    async def _submit(self, pool_id: int, op: MOSDOp) -> MOSDOpReply:
+        """op_submit/_calc_target/resend loop."""
+        last_err = errno.EIO
+        for _try in range(MAX_RETRIES):
+            om = self.osdmap
+            pool = om.get_pg_pool(pool_id)
+            if pool is None:
+                raise RadosError(errno.ENOENT, f"pool {pool_id} vanished")
+            pg = object_to_pg(pool, op.oid)
+            _, _, _, primary = om.pg_to_up_acting_osds(pg)
+            if primary < 0:
+                await self._wait_new_map(om.epoch)
+                continue
+            addr = om.osd_addrs.get(primary)
+            if addr is None:
+                await self._wait_new_map(om.epoch)
+                continue
+            op.tid = next(self._tids)
+            op.epoch = om.epoch
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._op_waiters[op.tid] = fut
+            try:
+                conn = await self.messenger.connect_to(("osd", primary), *addr)
+                await conn.send_message(op)
+                reply: MOSDOpReply = await asyncio.wait_for(fut, OP_TIMEOUT)
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                log.debug("client: op to osd.%d failed (%r), waiting for map", primary, e)
+                await self._wait_new_map(om.epoch)
+                last_err = errno.EIO
+                continue
+            finally:
+                self._op_waiters.pop(op.tid, None)
+            if reply.result == -errno.EAGAIN:
+                # peer had a different map; wait for something newer
+                await self._wait_new_map(min(om.epoch, reply.epoch - 1))
+                last_err = errno.EAGAIN
+                continue
+            return reply
+        raise RadosError(last_err, f"op {op.oid!r} failed after {MAX_RETRIES} tries")
+
+
+class IoCtx:
+    """Per-pool I/O handle (librados::IoCtx)."""
+
+    def __init__(self, client: RadosClient, pool_id: int):
+        self.client = client
+        self.pool_id = pool_id
+
+    async def write_full(self, oid: str, data: bytes) -> None:
+        reply = await self.client._submit(self.pool_id, MOSDOp(
+            pool=self.pool_id, oid=oid, op=OP_WRITE_FULL, data=bytes(data),
+        ))
+        if reply.result != 0:
+            raise RadosError(-reply.result, f"write_full {oid!r}")
+
+    async def read(self, oid: str, off: int = 0, length: int = 0) -> bytes:
+        reply = await self.client._submit(self.pool_id, MOSDOp(
+            pool=self.pool_id, oid=oid, op=OP_READ, off=off, length=length,
+        ))
+        if reply.result != 0:
+            raise RadosError(-reply.result, f"read {oid!r}")
+        return reply.data
+
+    async def stat(self, oid: str) -> int:
+        reply = await self.client._submit(self.pool_id, MOSDOp(
+            pool=self.pool_id, oid=oid, op=OP_STAT,
+        ))
+        if reply.result != 0:
+            raise RadosError(-reply.result, f"stat {oid!r}")
+        return reply.size
+
+    async def remove(self, oid: str) -> None:
+        reply = await self.client._submit(self.pool_id, MOSDOp(
+            pool=self.pool_id, oid=oid, op=OP_DELETE,
+        ))
+        if reply.result != 0:
+            raise RadosError(-reply.result, f"remove {oid!r}")

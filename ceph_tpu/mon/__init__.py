@@ -1,0 +1,6 @@
+"""Cluster control plane (reference src/mon/): the map-authority
+monitor of the mini-cluster."""
+
+from ceph_tpu.mon.monitor import Monitor
+
+__all__ = ["Monitor"]

@@ -1,0 +1,284 @@
+"""Monitor: the cluster-map authority.
+
+Mini-cluster twin of the reference monitor's OSDMonitor role
+(src/mon/OSDMonitor.cc): owns the OSDMap, advances epochs on osd
+boot/failure/out, serves map subscriptions, and executes admin commands
+— EC profile set, pool create (profile -> plugin factory -> CRUSH rule,
+the seam OSDMonitor::prepare_new_pool / crush_rule_create_erasure
+drives, OSDMonitor.cc:7339,7466-7523), osd down/out.
+
+Single-monitor for now: the Paxos quorum replicating this state is the
+control-plane milestone (SURVEY.md §7 step 5); the command and map
+semantics here are what Paxos will replicate.
+
+Failure handling: failure reports (MOSDFailure) mark the target down
+immediately (reference grace logic OSDMonitor::check_failure collapses
+to one report in a mini cluster), and a beacon-liveness sweep marks
+OSDs down/out when beacons stop — both produce new map epochs that are
+pushed to every subscriber, which is what triggers peer OSDs to
+re-peer and recover.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from ceph_tpu.crush.types import CrushMap
+from ceph_tpu.ec import registry as ec_registry
+from ceph_tpu.msg.messages import (
+    MMonCommand,
+    MMonCommandAck,
+    MMonSubscribe,
+    MOSDBeacon,
+    MOSDBoot,
+    MOSDFailure,
+    MOSDMap,
+)
+from ceph_tpu.msg.messenger import Connection, Message, Messenger
+from ceph_tpu.osd.mapenc import encode_osdmap
+from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.osd.types import PgPool, PoolType
+
+log = logging.getLogger("ceph_tpu.mon")
+
+
+class Monitor:
+    def __init__(
+        self,
+        crush: CrushMap | None = None,
+        beacon_grace: float = 0.0,
+        out_interval: float = 0.0,
+    ):
+        """``beacon_grace``/``out_interval``: seconds without a beacon
+        before an OSD is marked down / out; 0 disables the sweep (tests
+        drive failure via MOSDFailure or commands)."""
+        self.osdmap = OSDMap(crush=crush or CrushMap())
+        self.messenger = Messenger(("mon", 0), self._dispatch)
+        self.beacon_grace = beacon_grace
+        self.out_interval = out_interval
+        self._epoch_blobs: dict[int, bytes] = {}
+        self._subscribers: dict[tuple[str, int], Connection] = {}
+        self._last_beacon: dict[int, float] = {}
+        self._down_at: dict[int, float] = {}
+        self._pool_ids: dict[str, int] = {}
+        self._next_pool = 1
+        self._tick_task: asyncio.Task | None = None
+        self.addr: tuple[str, int] | None = None
+        self._snapshot()
+
+    # -- lifecycle -----------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+        self.addr = await self.messenger.bind(host, port)
+        if self.beacon_grace > 0:
+            self._tick_task = asyncio.ensure_future(self._tick())
+        return self.addr
+
+    async def stop(self) -> None:
+        if self._tick_task:
+            self._tick_task.cancel()
+        await self.messenger.shutdown()
+
+    # -- map publication ----------------------------------------------
+
+    def _snapshot(self) -> None:
+        self._epoch_blobs[self.osdmap.epoch] = encode_osdmap(self.osdmap)
+        # bound history
+        for e in sorted(self._epoch_blobs)[:-500]:
+            del self._epoch_blobs[e]
+
+    async def _new_epoch(self) -> None:
+        self.osdmap.epoch += 1
+        self._snapshot()
+        await self._publish()
+
+    async def _publish(self) -> None:
+        blob = {self.osdmap.epoch: self._epoch_blobs[self.osdmap.epoch]}
+        for peer, conn in list(self._subscribers.items()):
+            try:
+                await conn.send_message(MOSDMap(maps=dict(blob)))
+            except ConnectionError:
+                self._subscribers.pop(peer, None)
+
+    # -- dispatch ------------------------------------------------------
+
+    async def _dispatch(self, msg: Message) -> None:
+        if isinstance(msg, MOSDBoot):
+            await self._handle_boot(msg)
+        elif isinstance(msg, MOSDBeacon):
+            self._last_beacon[msg.osd] = time.monotonic()
+        elif isinstance(msg, MOSDFailure):
+            await self._handle_failure(msg)
+        elif isinstance(msg, MMonSubscribe):
+            self._subscribers[msg.src] = msg.conn
+            await msg.conn.send_message(
+                MOSDMap(maps={
+                    self.osdmap.epoch: self._epoch_blobs[self.osdmap.epoch]
+                })
+            )
+        elif isinstance(msg, MMonCommand):
+            code, rs, data = await self._command(msg.cmd)
+            await msg.conn.send_message(
+                MMonCommandAck(tid=msg.tid, code=code, rs=rs, data=data)
+            )
+
+    async def _handle_boot(self, m: MOSDBoot) -> None:
+        om = self.osdmap
+        om.new_osd(m.osd, weight=m.weight, up=True)
+        om.osd_addrs[m.osd] = (m.host, m.port)
+        self._last_beacon[m.osd] = time.monotonic()
+        self._down_at.pop(m.osd, None)
+        log.info("mon: osd.%d booted at %s:%d", m.osd, m.host, m.port)
+        await self._new_epoch()
+
+    async def _handle_failure(self, m: MOSDFailure) -> None:
+        om = self.osdmap
+        if 0 <= m.failed < om.max_osd and om.is_up(m.failed):
+            log.info(
+                "mon: osd.%d reported failed by osd.%d", m.failed, m.reporter
+            )
+            om.mark_down(m.failed)
+            self._down_at[m.failed] = time.monotonic()
+            await self._new_epoch()
+
+    async def _tick(self) -> None:
+        while True:
+            await asyncio.sleep(self.beacon_grace / 4)
+            now = time.monotonic()
+            changed = False
+            om = self.osdmap
+            for osd, last in list(self._last_beacon.items()):
+                if om.is_up(osd) and now - last > self.beacon_grace:
+                    log.info("mon: osd.%d beacon timeout -> down", osd)
+                    om.mark_down(osd)
+                    self._down_at[osd] = now
+                    changed = True
+            if self.out_interval > 0:
+                for osd, when in list(self._down_at.items()):
+                    if not om.is_out(osd) and now - when > self.out_interval:
+                        log.info("mon: osd.%d down too long -> out", osd)
+                        om.mark_out(osd)
+                        changed = True
+            if changed:
+                await self._new_epoch()
+
+    # -- commands (the MonCommands.h slice) ----------------------------
+
+    async def _command(self, cmd: dict[str, str]) -> tuple[int, str, bytes]:
+        import errno
+        import json
+
+        prefix = cmd.get("prefix", "")
+        try:
+            if prefix == "osd erasure-code-profile set":
+                name = cmd["name"]
+                profile = dict(
+                    kv.split("=", 1) for kv in cmd.get("profile", "").split() if kv
+                )
+                profile.setdefault("plugin", "jax")
+                # instantiate once to validate + fill defaults
+                ec_registry.factory(profile["plugin"], profile)
+                self.osdmap.erasure_code_profiles[name] = profile
+                await self._new_epoch()
+                return 0, f"profile {name} set", b""
+            if prefix == "osd pool create":
+                return await self._pool_create(cmd)
+            if prefix == "osd down":
+                osd = int(cmd["id"])
+                if self.osdmap.is_up(osd):
+                    self.osdmap.mark_down(osd)
+                    await self._new_epoch()
+                return 0, f"osd.{osd} down", b""
+            if prefix == "osd out":
+                osd = int(cmd["id"])
+                if not self.osdmap.is_out(osd):
+                    self.osdmap.mark_out(osd)
+                    await self._new_epoch()
+                return 0, f"osd.{osd} out", b""
+            if prefix == "status":
+                om = self.osdmap
+                up = sum(om.is_up(o) for o in range(om.max_osd))
+                inn = sum(
+                    not om.is_out(o) for o in range(om.max_osd) if om.exists(o)
+                )
+                data = json.dumps({
+                    "epoch": om.epoch,
+                    "num_osds": sum(om.exists(o) for o in range(om.max_osd)),
+                    "num_up_osds": up,
+                    "num_in_osds": inn,
+                    "pools": {
+                        str(pid): {"name": name, "pg_num": om.pools[pid].pg_num}
+                        for name, pid in self._pool_ids.items()
+                    },
+                }).encode()
+                return 0, "", data
+            return -errno.EINVAL, f"unknown command {prefix!r}", b""
+        except KeyError as e:
+            return -errno.EINVAL, f"missing arg {e}", b""
+        except Exception as e:  # command errors must not kill the mon
+            code = -getattr(e, "errno", errno.EINVAL) or -errno.EINVAL
+            return code, str(e), b""
+
+    async def _pool_create(self, cmd: dict[str, str]) -> tuple[int, str, bytes]:
+        """OSDMonitor::prepare_new_pool (OSDMonitor.cc:7339): erasure
+        pools pull their profile, build the plugin, create the CRUSH
+        rule through it, and size the pool k+m."""
+        import errno
+        import json
+
+        name = cmd["name"]
+        if name in self._pool_ids:
+            pid = self._pool_ids[name]
+            return 0, f"pool {name!r} already exists", json.dumps({"pool_id": pid}).encode()
+        pg_num = int(cmd.get("pg_num", "8"))
+        pool_type = cmd.get("pool_type", "replicated")
+        om = self.osdmap
+        pid = self._next_pool
+        if pool_type == "erasure":
+            profile_name = cmd.get("erasure_code_profile", "default")
+            profile = om.erasure_code_profiles.get(profile_name)
+            if profile is None:
+                return -errno.ENOENT, f"no profile {profile_name!r}", b""
+            ec = ec_registry.factory(profile["plugin"], dict(profile))
+            rule_name = cmd.get("rule", name)
+            if rule_name in om.crush.rule_names:
+                rule = om.crush.rule_names[rule_name]
+            else:
+                rule = ec.create_rule(rule_name, om.crush)
+            k = ec.get_data_chunk_count()
+            m = ec.get_coding_chunk_count()
+            pool = PgPool(
+                id=pid, type=PoolType.ERASURE, size=k + m, min_size=k,
+                crush_rule=rule, pg_num=pg_num, pgp_num=pg_num,
+                erasure_code_profile=profile_name,
+            )
+        else:
+            size = int(cmd.get("size", "3"))
+            rule_name = cmd.get("rule", "replicated_rule")
+            if rule_name in om.crush.rule_names:
+                rule = om.crush.rule_names[rule_name]
+            else:
+                from ceph_tpu.crush import builder
+
+                root = om.crush.bucket_names.get("default")
+                if root is None:
+                    return -errno.ENOENT, "no default crush root", b""
+                try:
+                    fd = om.crush.type_id("host")
+                except KeyError:
+                    fd = 1
+                rule = builder.add_simple_rule(om.crush, root, fd, mode="firstn")
+                om.crush.rule_names[rule_name] = rule
+            pool = PgPool(
+                id=pid, type=PoolType.REPLICATED, size=size,
+                min_size=max(1, size - 1), crush_rule=rule,
+                pg_num=pg_num, pgp_num=pg_num,
+            )
+        om.pools[pid] = pool
+        om.pool_names[pid] = name
+        self._pool_ids[name] = pid
+        self._next_pool += 1
+        await self._new_epoch()
+        return 0, f"pool {name!r} created", json.dumps({"pool_id": pid}).encode()

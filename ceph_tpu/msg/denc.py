@@ -142,12 +142,11 @@ class Decoder:
         end = self._off + length
         if end > len(self._d):
             raise EncodingError("versioned struct overruns buffer")
-        if struct_compat > compat and v > compat:
+        if struct_compat > compat:
             # peer says decoders older than struct_compat can't parse it
-            if compat < struct_compat:
-                raise EncodingError(
-                    f"struct compat {struct_compat} > supported {compat}"
-                )
+            raise EncodingError(
+                f"struct compat {struct_compat} > supported {compat}"
+            )
         yield v
         if self._off > end:
             raise EncodingError("versioned struct over-read")

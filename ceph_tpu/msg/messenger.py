@@ -126,6 +126,8 @@ class Connection:
         ) as e:
             if not self._closed:
                 log.debug("%s: connection lost: %r", self.messenger.entity, e)
+        except asyncio.CancelledError:
+            pass  # cancelled by local close(); nothing to notify
         finally:
             await self.close(notify=True)
 
@@ -138,6 +140,9 @@ class Connection:
             self.writer.close()
         except Exception:
             pass
+        task = self._reader_task
+        if task is not None and task is not asyncio.current_task():
+            task.cancel()
         if notify:
             await self.messenger._handle_reset(self)
 
@@ -157,6 +162,7 @@ class Messenger:
         self._server: asyncio.base_events.Server | None = None
         self._conns: dict[tuple[str, int], Connection] = {}  # by entity
         self._accepted: set[Connection] = set()
+        self._connect_locks: dict[tuple[str, int], asyncio.Lock] = {}
         self.addr: tuple[str, int] | None = None
 
     async def _dispatch(self, msg: Message) -> None:
@@ -200,11 +206,43 @@ class Messenger:
         except (ConnectionError, asyncio.IncompleteReadError, OSError):
             writer.close()
             return
-        self._conns[conn.peer] = conn
+        await self._register(conn)
         self._accepted.add(conn)
         conn._reader_task = asyncio.ensure_future(conn._run())
 
+    async def _register(self, conn: Connection) -> None:
+        """Latest connection wins per peer; a displaced predecessor is
+        closed so its socket and reader task don't leak (the reference
+        resolves the same race with connect-sequence numbers,
+        ProtocolV2 reconnect)."""
+        displaced = self._conns.get(conn.peer)
+        self._conns[conn.peer] = conn
+        if displaced is not None and displaced is not conn:
+            await displaced.close()
+
     # -- client side ---------------------------------------------------
+
+    async def connect_to(
+        self, peer: tuple[str, int], host: str, port: int
+    ) -> Connection:
+        """Connection to a known peer, deduplicated: reuses a live
+        session (either direction) and serializes concurrent dials so
+        only one socket per peer exists."""
+        conn = self._conns.get(peer)
+        if conn is not None and not conn._closed:
+            return conn
+        lock = self._connect_locks.setdefault(peer, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(peer)
+            if conn is not None and not conn._closed:
+                return conn
+            conn = await self.connect(host, port)
+            if conn.peer != peer:
+                await conn.close()
+                raise ConnectionError(
+                    f"dialed {host}:{port} expecting {peer}, got {conn.peer}"
+                )
+            return conn
 
     async def connect(self, host: str, port: int) -> Connection:
         reader, writer = await asyncio.open_connection(host, port)
@@ -221,7 +259,7 @@ class Messenger:
             raise frames.FrameError(f"expected HELLO, got {tag}")
         dec = Decoder(segs[0])
         conn.peer = (dec.str_(), dec.i64())
-        self._conns[conn.peer] = conn
+        await self._register(conn)
         conn._reader_task = asyncio.ensure_future(conn._run())
         return conn
 
@@ -231,8 +269,15 @@ class Messenger:
     async def shutdown(self) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+        # close connections FIRST: in py3.12 Server.wait_closed() also
+        # waits for accepted transports, which our reader tasks hold open
         for conn in list(self._conns.values()) + list(self._accepted):
             await conn.close()
         self._conns.clear()
         self._accepted.clear()
+        await asyncio.sleep(0)  # let cancelled reader tasks unwind
+        if self._server is not None:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 2)
+            except asyncio.TimeoutError:
+                pass

@@ -182,3 +182,47 @@ def crush_hash32_2_jax(a, b):
     x, a, h = _mix_jax(x, a, h)
     b, y, h = _mix_jax(b, y, h)
     return h
+
+
+@_wrapping
+def ceph_str_hash_rjenkins(data: bytes | str) -> int:
+    """Object-name hash (reference src/common/ceph_hash.cc
+    ceph_str_hash_rjenkins): Jenkins lookup2 over 12-byte blocks with
+    the length folded into c — the hash that places objects into PGs
+    (object_locator_to_pg, src/osd/osd_types.cc)."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    k = data
+    length = len(k)
+    a = np.uint32(0x9E3779B9)
+    b = np.uint32(0x9E3779B9)
+    c = np.uint32(0)
+    off = 0
+    ln = length
+    while ln >= 12:
+        a = a + np.uint32(int.from_bytes(k[off : off + 4], "little"))
+        b = b + np.uint32(int.from_bytes(k[off + 4 : off + 8], "little"))
+        c = c + np.uint32(int.from_bytes(k[off + 8 : off + 12], "little"))
+        a, b, c = _mix_np(a, b, c)
+        off += 12
+        ln -= 12
+    c = c + np.uint32(length)
+    tail = k[off:]
+    t = tail + b"\0" * (11 - len(tail))
+    if ln >= 9:
+        # the first byte of c is reserved for the length
+        c = c + np.uint32(
+            (t[8] << 8) | (t[9] << 16 if ln >= 10 else 0) | (t[10] << 24 if ln >= 11 else 0)
+        )
+    if ln >= 5:
+        b = b + np.uint32(
+            t[4] | (t[5] << 8 if ln >= 6 else 0) | (t[6] << 16 if ln >= 7 else 0)
+            | (t[7] << 24 if ln >= 8 else 0)
+        )
+    if ln >= 1:
+        a = a + np.uint32(
+            t[0] | (t[1] << 8 if ln >= 2 else 0) | (t[2] << 16 if ln >= 3 else 0)
+            | (t[3] << 24 if ln >= 4 else 0)
+        )
+    a, b, c = _mix_np(a, b, c)
+    return int(c)

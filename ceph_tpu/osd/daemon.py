@@ -1,0 +1,693 @@
+"""OSD daemon: the object-service process of the mini-cluster.
+
+The asyncio twin of the reference OSD's op path (src/osd/OSD.cc
+dispatch -> PrimaryLogPG::do_op -> PGBackend submit, SURVEY.md §3.1):
+boots into the mon (MOSDBoot), subscribes to maps, serves client ops as
+primary, fans EC chunk writes/reads out to shard peers
+(MOSDECSubOpWrite/Read — ECBackend::submit_transaction/handle_sub_*,
+src/osd/ECBackend.cc:943,1022,1472), replicates full objects for
+replicated pools (MOSDRepOp), and reconstructs missing shards after map
+changes (RecoveryBackend::continue_recovery_op, ECBackend.cc:563 →
+decode via ECUtil + MOSDPGPush).
+
+Data layout matches the reference: one collection per PG shard
+(coll_t(pool, ps, shard), ECTransaction.cc:80-88), chunk payloads at
+chunk offsets, per-shard HashInfo crc chains in the ``hinfo`` xattr
+(ECUtil.cc:164-248) and the logical size in ``_size`` (the object_info
+analogue).
+
+Differences from the reference, deliberate for this slice: peering is
+implicit (the map is the authority; the primary probes acting members
+instead of exchanging pg_info), there is no PG log yet (recovery is
+backfill-style full-object reconstruction), and a brand-new primary
+with no local data asks the first data-holding acting member for the
+object list instead of running the peering state machine.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+import itertools
+import logging
+
+import numpy as np
+
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+from ceph_tpu.ec import registry as ec_registry
+from ceph_tpu.msg.messages import (
+    MMonSubscribe,
+    MOSDBeacon,
+    MOSDBoot,
+    MOSDECSubOpRead,
+    MOSDECSubOpReadReply,
+    MOSDECSubOpWrite,
+    MOSDECSubOpWriteReply,
+    MOSDFailure,
+    MOSDMap,
+    MOSDOp,
+    MOSDOpReply,
+    MOSDPGPush,
+    MOSDPGPushReply,
+    MOSDRepOp,
+    MOSDRepOpReply,
+    OP_DELETE,
+    OP_READ,
+    OP_STAT,
+    OP_WRITE_FULL,
+)
+from ceph_tpu.msg.messenger import Connection, Message, Messenger
+from ceph_tpu.ops.hashing import ceph_str_hash_rjenkins
+from ceph_tpu.osd import ecutil
+from ceph_tpu.osd.mapenc import decode_osdmap
+from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.osd.types import PgPool, pg_t
+from ceph_tpu.store import MemStore, Transaction, coll_t, ghobject_t
+
+log = logging.getLogger("ceph_tpu.osd")
+
+NO_SHARD = -1
+STRIPE_UNIT = 4096  # logical bytes per data chunk per stripe
+SUBOP_TIMEOUT = 30.0
+
+SIZE_ATTR = "_size"
+HINFO_ATTR = "hinfo"
+
+
+def object_to_pg(pool: PgPool, oid: str) -> pg_t:
+    """object_locator_to_pg (src/osd/osd_types.cc): name hash -> raw pg
+    (the mapping pipeline folds it into pg_num)."""
+    return pg_t(pool.id, int(ceph_str_hash_rjenkins(oid)))
+
+
+class OSDDaemon:
+    def __init__(
+        self,
+        osd_id: int,
+        mon_addr: tuple[str, int],
+        store: MemStore | None = None,
+        beacon_interval: float = 0.0,
+    ):
+        self.id = osd_id
+        self.mon_addr = mon_addr
+        self.store = store or MemStore()
+        self.messenger = Messenger(
+            ("osd", osd_id), self._dispatch, on_reset=self._on_reset
+        )
+        self.osdmap: OSDMap | None = None
+        self.beacon_interval = beacon_interval
+        self.addr: tuple[str, int] | None = None
+        self._mon_conn: Connection | None = None
+        self._tids = itertools.count(1)
+        self._waiters: dict[int, asyncio.Future] = {}
+        self._push_waiters: dict[tuple, asyncio.Future] = {}
+        self._ec_cache: dict[str, object] = {}
+        self._beacon_task: asyncio.Task | None = None
+        self._recovery_task: asyncio.Task | None = None
+        self._map_event = asyncio.Event()
+        self.stopping = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.addr = await self.messenger.bind(host, port)
+        self._mon_conn = await self.messenger.connect_to(
+            ("mon", 0), *self.mon_addr
+        )
+        await self._mon_conn.send_message(
+            MOSDBoot(osd=self.id, host=self.addr[0], port=self.addr[1])
+        )
+        await self._mon_conn.send_message(MMonSubscribe())
+        if self.beacon_interval > 0:
+            self._beacon_task = asyncio.ensure_future(self._beacon())
+        # wait for the first map so ops can be served
+        await asyncio.wait_for(self._map_event.wait(), 10)
+
+    async def stop(self) -> None:
+        self.stopping = True
+        for t in (self._beacon_task, self._recovery_task):
+            if t:
+                t.cancel()
+        await self.messenger.shutdown()
+
+    async def _beacon(self) -> None:
+        while not self.stopping:
+            await asyncio.sleep(self.beacon_interval)
+            try:
+                await self._mon_conn.send_message(
+                    MOSDBeacon(osd=self.id, epoch=self.epoch)
+                )
+            except ConnectionError:
+                return
+
+    @property
+    def epoch(self) -> int:
+        return self.osdmap.epoch if self.osdmap else 0
+
+    # -- plumbing ------------------------------------------------------
+
+    async def _on_reset(self, conn: Connection) -> None:
+        """Connection to a peer died: fail pending sub-ops and report
+        the peer (the OSD::ms_handle_reset + failure-report path)."""
+        if self.stopping or conn.peer is None:
+            return
+        kind, peer_id = conn.peer
+        for tid, fut in list(self._waiters.items()):
+            if getattr(fut, "peer", None) == conn.peer and not fut.done():
+                fut.set_exception(ConnectionError(f"peer {conn.peer} reset"))
+        if kind == "osd" and self.osdmap and self.osdmap.is_up(peer_id):
+            try:
+                await self._mon_conn.send_message(
+                    MOSDFailure(
+                        reporter=self.id, failed=peer_id, epoch=self.epoch
+                    )
+                )
+            except ConnectionError:
+                pass
+
+    async def _osd_conn(self, osd: int) -> Connection:
+        addr = self.osdmap.osd_addrs.get(osd)
+        if addr is None:
+            raise ConnectionError(f"no address for osd.{osd}")
+        return await self.messenger.connect_to(("osd", osd), *addr)
+
+    async def _sub_op(self, osd: int, msg: Message, tid: int):
+        """Send a sub-op and await its reply future."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        fut.peer = ("osd", osd)
+        self._waiters[tid] = fut
+        try:
+            conn = await self._osd_conn(osd)
+            await conn.send_message(msg)
+            return await asyncio.wait_for(fut, SUBOP_TIMEOUT)
+        finally:
+            self._waiters.pop(tid, None)
+
+    def _ec_for(self, pool: PgPool):
+        prof_name = pool.erasure_code_profile
+        if prof_name not in self._ec_cache:
+            profile = dict(self.osdmap.erasure_code_profiles[prof_name])
+            ec = ec_registry.factory(profile.get("plugin", "jax"), profile)
+            self._ec_cache[prof_name] = ec
+        return self._ec_cache[prof_name]
+
+    def _sinfo(self, ec) -> ecutil.StripeInfo:
+        k = ec.get_data_chunk_count()
+        chunk = ec.get_chunk_size(STRIPE_UNIT * k)
+        return ecutil.StripeInfo(k, chunk * k)
+
+    def _acting(self, pool: PgPool, pg: pg_t) -> tuple[list[int], int]:
+        _, _, acting, primary = self.osdmap.pg_to_up_acting_osds(pg)
+        return acting, primary
+
+    # -- dispatch ------------------------------------------------------
+
+    async def _dispatch(self, msg: Message) -> None:
+        try:
+            if isinstance(msg, MOSDMap):
+                await self._handle_map(msg)
+            elif isinstance(msg, MOSDOp):
+                asyncio.ensure_future(self._handle_client_op(msg))
+            elif isinstance(msg, MOSDECSubOpWrite):
+                await self._handle_sub_write(msg)
+            elif isinstance(msg, MOSDECSubOpRead):
+                await self._handle_sub_read(msg)
+            elif isinstance(msg, MOSDRepOp):
+                await self._handle_rep_op(msg)
+            elif isinstance(msg, MOSDPGPush):
+                await self._handle_push(msg)
+            elif isinstance(
+                msg,
+                (MOSDECSubOpWriteReply, MOSDECSubOpReadReply, MOSDRepOpReply),
+            ):
+                fut = self._waiters.get(msg.tid)
+                if fut and not fut.done():
+                    fut.set_result(msg)
+            elif isinstance(msg, MOSDPGPushReply):
+                fut = self._push_waiters.get((msg.pg, msg.shard, msg.from_osd))
+                if fut and not fut.done():
+                    fut.set_result(msg)
+        except Exception:
+            log.exception("osd.%d: dispatch failed for %r", self.id, msg)
+
+    async def _handle_map(self, msg: MOSDMap) -> None:
+        for epoch in sorted(msg.maps):
+            if self.osdmap is None or epoch > self.osdmap.epoch:
+                self.osdmap = decode_osdmap(msg.maps[epoch])
+        self._map_event.set()
+        log.info("osd.%d: map epoch %d", self.id, self.epoch)
+        if self._recovery_task is None or self._recovery_task.done():
+            self._recovery_task = asyncio.ensure_future(self._recover_all())
+
+    # -- client ops (the PrimaryLogPG::do_op slice) --------------------
+
+    async def _handle_client_op(self, msg: MOSDOp) -> None:
+        try:
+            reply = await self._execute_op(msg)
+        except ECConnErrors as e:
+            log.warning("osd.%d: op tid %d failed: %r", self.id, msg.tid, e)
+            reply = MOSDOpReply(
+                tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch
+            )
+        except Exception:
+            log.exception("osd.%d: op tid %d crashed", self.id, msg.tid)
+            reply = MOSDOpReply(tid=msg.tid, result=-errno.EIO, epoch=self.epoch)
+        try:
+            await msg.conn.send_message(reply)
+        except ConnectionError:
+            pass
+
+    async def _execute_op(self, msg: MOSDOp) -> MOSDOpReply:
+        pool = self.osdmap.get_pg_pool(msg.pool) if self.osdmap else None
+        if pool is None:
+            return MOSDOpReply(tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch)
+        pg = object_to_pg(pool, msg.oid)
+        acting, primary = self._acting(pool, pg)
+        if primary != self.id:
+            # client raced a map change; tell it to retry on a newer map
+            return MOSDOpReply(tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
+        if pool.is_erasure():
+            return await self._ec_op(pool, pg, acting, msg)
+        return await self._rep_op(pool, pg, acting, msg)
+
+    # -- EC backend ----------------------------------------------------
+
+    def _shard_coll(self, pool: PgPool, pg: pg_t, shard: int) -> coll_t:
+        return coll_t(pool.id, pool.raw_pg_to_pg(pg).ps, shard)
+
+    def _ensure_coll(self, t: Transaction, c: coll_t) -> None:
+        if not self.store.collection_exists(c):
+            t.create_collection(c)
+
+    async def _ec_op(
+        self, pool: PgPool, pg: pg_t, acting: list[int], msg: MOSDOp
+    ) -> MOSDOpReply:
+        ec = self._ec_for(pool)
+        sinfo = self._sinfo(ec)
+        if msg.op == OP_WRITE_FULL:
+            return await self._ec_write_full(pool, pg, acting, msg, ec, sinfo)
+        if msg.op in (OP_READ, OP_STAT):
+            return await self._ec_read(pool, pg, acting, msg, ec, sinfo)
+        if msg.op == OP_DELETE:
+            return await self._ec_delete(pool, pg, acting, msg)
+        return MOSDOpReply(tid=msg.tid, result=-errno.EOPNOTSUPP, epoch=self.epoch)
+
+    async def _ec_write_full(self, pool, pg, acting, msg, ec, sinfo) -> MOSDOpReply:
+        data = np.frombuffer(msg.data, dtype=np.uint8)
+        padded_len = sinfo.logical_to_next_stripe_offset(len(data))
+        padded = np.zeros(padded_len, np.uint8)
+        padded[: len(data)] = data
+        if padded_len:
+            shards = ecutil.encode(sinfo, ec, padded)
+        else:  # empty object: every shard holds an empty chunk
+            empty = np.zeros(0, np.uint8)
+            shards = {s: empty for s in range(ec.get_chunk_count())}
+        hinfo = ecutil.HashInfo(ec.get_chunk_count())
+        hinfo.append(0, shards)
+        attrs = {
+            HINFO_ATTR: hinfo.to_bytes(),
+            SIZE_ATTR: str(len(data)).encode(),
+        }
+        live = [
+            (shard, osd)
+            for shard, osd in enumerate(acting)
+            if osd != CRUSH_ITEM_NONE
+        ]
+        if len(live) < pool.min_size:
+            return MOSDOpReply(tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
+        waits = []
+        for shard, osd in live:
+            payload = shards[shard].tobytes()
+            if osd == self.id:
+                self._apply_shard_write(
+                    pool, pg, shard, msg.oid, payload, attrs
+                )
+            else:
+                tid = next(self._tids)
+                waits.append(self._sub_op(osd, MOSDECSubOpWrite(
+                    tid=tid, pg=pg, shard=shard, from_osd=self.id,
+                    oid=msg.oid, off=0, data=payload, attrs=attrs,
+                    epoch=self.epoch, truncate=len(payload),
+                ), tid))
+        if waits:
+            replies = await asyncio.gather(*waits)
+            for rep in replies:
+                if rep.result != 0:
+                    return MOSDOpReply(
+                        tid=msg.tid, result=rep.result, epoch=self.epoch
+                    )
+        return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
+
+    def _apply_shard_write(
+        self, pool, pg, shard, oid, payload: bytes, attrs, delete=False
+    ) -> None:
+        c = self._shard_coll(pool, pg, shard)
+        o = ghobject_t(oid, shard=shard)
+        t = Transaction()
+        self._ensure_coll(t, c)
+        if delete:
+            if self.store.exists(c, o):
+                t.remove(c, o)
+        else:
+            t.touch(c, o).truncate(c, o, len(payload)).write(c, o, 0, payload)
+            t.setattrs(c, o, attrs)
+        self.store.queue_transaction(t)
+
+    async def _ec_read(self, pool, pg, acting, msg, ec, sinfo) -> MOSDOpReply:
+        k = ec.get_data_chunk_count()
+        avail = {
+            shard: osd for shard, osd in enumerate(acting)
+            if osd != CRUSH_ITEM_NONE and self.osdmap.is_up(osd)
+        }
+        excluded: dict[int, int] = {}  # shard -> errno seen
+        for _attempt in range(len(acting) + 1):
+            usable = {s: o for s, o in avail.items() if s not in excluded}
+            want = set(range(k))
+            try:
+                minimum = ec.minimum_to_decode(want, set(usable))
+            except Exception:
+                break  # not enough shards left to decode
+            need_shards = set(minimum)
+            chunks: dict[int, np.ndarray] = {}
+            attrs: dict[str, bytes] = {}
+            failed = None
+            for shard in sorted(need_shards):
+                osd = usable[shard]
+                try:
+                    payload, a, eno = await self._read_shard(
+                        pool, pg, shard, osd, msg.oid
+                    )
+                except (OSError, asyncio.TimeoutError, ConnectionError):
+                    payload, a, eno = None, None, errno.EIO
+                if payload is None:
+                    failed = (shard, eno)
+                    break
+                chunks[shard] = np.frombuffer(payload, np.uint8)
+                if a:
+                    attrs = a
+            if failed is not None:
+                excluded[failed[0]] = failed[1]
+                continue
+            if not attrs or SIZE_ATTR not in attrs:
+                return MOSDOpReply(
+                    tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch
+                )
+            size = int(attrs[SIZE_ATTR])
+            if msg.op == OP_STAT:
+                return MOSDOpReply(
+                    tid=msg.tid, result=0, epoch=self.epoch, size=size
+                )
+            logical = ecutil.decode_concat(sinfo, ec, chunks)[:size]
+            off = msg.off
+            end = size if msg.length == 0 else min(off + msg.length, size)
+            return MOSDOpReply(
+                tid=msg.tid, result=0, epoch=self.epoch, size=size,
+                data=logical[off:end].tobytes(),
+            )
+        # decode never succeeded: a fully-absent object reports ENOENT,
+        # anything else is a real I/O failure
+        if excluded and all(e == errno.ENOENT for e in excluded.values()):
+            return MOSDOpReply(tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch)
+        return MOSDOpReply(tid=msg.tid, result=-errno.EIO, epoch=self.epoch)
+
+    async def _read_shard(self, pool, pg, shard, osd, oid):
+        """Full-chunk read of one shard: (payload, attrs, errno)."""
+        if osd == self.id:
+            c = self._shard_coll(pool, pg, shard)
+            o = ghobject_t(oid, shard=shard)
+            if not self.store.exists(c, o):
+                return None, None, errno.ENOENT
+            return self.store.read(c, o), self.store.getattrs(c, o), 0
+        tid = next(self._tids)
+        rep = await self._sub_op(osd, MOSDECSubOpRead(
+            tid=tid, pg=pg, shard=shard, from_osd=self.id, oid=oid,
+            off=0, length=0, want_attrs=True, epoch=self.epoch,
+        ), tid)
+        if rep.result != 0:
+            return None, None, -rep.result
+        return rep.data, rep.attrs, 0
+
+    async def _ec_delete(self, pool, pg, acting, msg) -> MOSDOpReply:
+        waits = []
+        for shard, osd in enumerate(acting):
+            if osd == CRUSH_ITEM_NONE:
+                continue
+            if osd == self.id:
+                self._apply_shard_write(
+                    pool, pg, shard, msg.oid, b"", {}, delete=True
+                )
+            else:
+                tid = next(self._tids)
+                waits.append(self._sub_op(osd, MOSDECSubOpWrite(
+                    tid=tid, pg=pg, shard=shard, from_osd=self.id,
+                    oid=msg.oid, off=0, data=b"", attrs={},
+                    epoch=self.epoch, delete=True,
+                ), tid))
+        if waits:
+            await asyncio.gather(*waits)
+        return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
+
+    async def _handle_sub_write(self, msg: MOSDECSubOpWrite) -> None:
+        pool = self.osdmap.get_pg_pool(msg.pg.pool)
+        result = 0
+        try:
+            self._apply_shard_write(
+                pool, msg.pg, msg.shard, msg.oid, msg.data, msg.attrs,
+                delete=msg.delete,
+            )
+        except OSError as e:
+            result = -(e.errno or errno.EIO)
+        await msg.conn.send_message(MOSDECSubOpWriteReply(
+            tid=msg.tid, pg=msg.pg, shard=msg.shard, from_osd=self.id,
+            result=result, epoch=self.epoch,
+        ))
+
+    async def _handle_sub_read(self, msg: MOSDECSubOpRead) -> None:
+        pool = self.osdmap.get_pg_pool(msg.pg.pool)
+        c = self._shard_coll(pool, msg.pg, msg.shard)
+        o = ghobject_t(msg.oid, shard=msg.shard)
+        if not self.store.exists(c, o):
+            rep = MOSDECSubOpReadReply(
+                tid=msg.tid, pg=msg.pg, shard=msg.shard, from_osd=self.id,
+                result=-errno.ENOENT, epoch=self.epoch,
+            )
+        else:
+            data = self.store.read(
+                c, o, msg.off, None if msg.length == 0 else msg.length
+            )
+            attrs = self.store.getattrs(c, o) if msg.want_attrs else {}
+            rep = MOSDECSubOpReadReply(
+                tid=msg.tid, pg=msg.pg, shard=msg.shard, from_osd=self.id,
+                result=0, data=data, attrs=attrs, epoch=self.epoch,
+            )
+        await msg.conn.send_message(rep)
+
+    # -- replicated backend -------------------------------------------
+
+    async def _rep_op(self, pool, pg, acting, msg) -> MOSDOpReply:
+        c = self._shard_coll(pool, pg, NO_SHARD)
+        o = ghobject_t(msg.oid)
+        if msg.op == OP_READ:
+            if not self.store.exists(c, o):
+                return MOSDOpReply(tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch)
+            data = self.store.read(c, o, msg.off, msg.length or None)
+            return MOSDOpReply(
+                tid=msg.tid, result=0, data=data, epoch=self.epoch,
+                size=self.store.stat(c, o),
+            )
+        if msg.op == OP_STAT:
+            if not self.store.exists(c, o):
+                return MOSDOpReply(tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch)
+            return MOSDOpReply(
+                tid=msg.tid, result=0, epoch=self.epoch, size=self.store.stat(c, o)
+            )
+        if msg.op not in (OP_WRITE_FULL, OP_DELETE):
+            return MOSDOpReply(tid=msg.tid, result=-errno.EOPNOTSUPP, epoch=self.epoch)
+        delete = msg.op == OP_DELETE
+        attrs = {SIZE_ATTR: str(len(msg.data)).encode()}
+        self._apply_full_object(pool, pg, msg.oid, msg.data, attrs, delete)
+        waits = []
+        for osd in acting:
+            if osd in (self.id, CRUSH_ITEM_NONE):
+                continue
+            tid = next(self._tids)
+            waits.append(self._sub_op(osd, MOSDRepOp(
+                tid=tid, pg=pg, from_osd=self.id, oid=msg.oid,
+                data=b"" if delete else msg.data, attrs=attrs,
+                delete=delete, epoch=self.epoch,
+            ), tid))
+        if waits:
+            replies = await asyncio.gather(*waits)
+            for rep in replies:
+                if rep.result != 0:
+                    return MOSDOpReply(tid=msg.tid, result=rep.result, epoch=self.epoch)
+        return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
+
+    def _apply_full_object(self, pool, pg, oid, data, attrs, delete=False):
+        c = self._shard_coll(pool, pg, NO_SHARD)
+        o = ghobject_t(oid)
+        t = Transaction()
+        self._ensure_coll(t, c)
+        if delete:
+            if self.store.exists(c, o):
+                t.remove(c, o)
+        else:
+            t.touch(c, o).truncate(c, o, len(data)).write(c, o, 0, data)
+            t.setattrs(c, o, attrs)
+        self.store.queue_transaction(t)
+
+    async def _handle_rep_op(self, msg: MOSDRepOp) -> None:
+        pool = self.osdmap.get_pg_pool(msg.pg.pool)
+        result = 0
+        try:
+            self._apply_full_object(
+                pool, msg.pg, msg.oid, msg.data, msg.attrs, msg.delete
+            )
+        except OSError as e:
+            result = -(e.errno or errno.EIO)
+        await msg.conn.send_message(MOSDRepOpReply(
+            tid=msg.tid, pg=msg.pg, from_osd=self.id, result=result,
+            epoch=self.epoch,
+        ))
+
+    # -- recovery ------------------------------------------------------
+
+    async def _recover_all(self) -> None:
+        """After a map change: for every PG this OSD leads, reconstruct
+        missing shards/objects on the current acting set (the
+        do_recovery -> recover_object path, §3.3).  Re-runs until a
+        full pass has seen the newest map (epochs can land mid-pass)."""
+        done_epoch = -1
+        while done_epoch != self.epoch and not self.stopping:
+            done_epoch = self.epoch
+            try:
+                om = self.osdmap
+                for pid, pool in list(om.pools.items()):
+                    for ps in range(pool.pg_num):
+                        pg = pg_t(pid, ps)
+                        _, _, acting, primary = om.pg_to_up_acting_osds(
+                            pg, folded=True
+                        )
+                        if primary != self.id:
+                            continue
+                        if pool.is_erasure():
+                            await self._recover_pg_ec(pool, pg, acting)
+                        else:
+                            await self._recover_pg_rep(pool, pg, acting)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("osd.%d: recovery pass failed", self.id)
+                return
+
+    def _local_objects(self, pool, pg, shard) -> list[str]:
+        c = coll_t(pool.id, pg.ps, shard)
+        if not self.store.collection_exists(c):
+            return []
+        return sorted({o.name for o in self.store.collection_list(c)})
+
+    async def _recover_pg_ec(self, pool: PgPool, pg: pg_t, acting: list[int]) -> None:
+        ec = self._ec_for(pool)
+        sinfo = self._sinfo(ec)
+        my_shard = next(
+            (s for s, o in enumerate(acting) if o == self.id), None
+        )
+        if my_shard is None:
+            return
+        names = self._local_objects(pool, pg, my_shard)
+        for oid in names:
+            # probe which acting members miss this object's shard
+            present: dict[int, int] = {}
+            missing: list[tuple[int, int]] = []
+            for shard, osd in enumerate(acting):
+                if osd == CRUSH_ITEM_NONE:
+                    continue
+                try:
+                    payload, attrs = await self._probe_shard(
+                        pool, pg, shard, osd, oid
+                    )
+                except (OSError, asyncio.TimeoutError, ConnectionError):
+                    continue
+                if payload is None:
+                    missing.append((shard, osd))
+                else:
+                    present[shard] = osd
+            if not missing:
+                continue
+            log.info(
+                "osd.%d: recovering %s/%s shards %s", self.id, pg, oid,
+                [s for s, _ in missing],
+            )
+            # read enough present shards to rebuild the missing ones
+            need = {s for s, _ in missing}
+            chunks: dict[int, np.ndarray] = {}
+            attrs_src: dict[str, bytes] = {}
+            for shard, osd in present.items():
+                payload, attrs, _eno = await self._read_shard(pool, pg, shard, osd, oid)
+                if payload is not None:
+                    chunks[shard] = np.frombuffer(payload, np.uint8)
+                    if attrs:
+                        attrs_src = attrs
+            rebuilt = ecutil.decode_shards(sinfo, ec, chunks, need)
+            for shard, osd in missing:
+                payload = rebuilt[shard].tobytes()
+                await self._push(pool, pg, shard, osd, oid, payload, attrs_src)
+
+    async def _recover_pg_rep(self, pool: PgPool, pg: pg_t, acting: list[int]) -> None:
+        names = self._local_objects(pool, pg, NO_SHARD)
+        c = self._shard_coll(pool, pg, NO_SHARD)
+        for oid in names:
+            data = self.store.read(c, ghobject_t(oid))
+            attrs = self.store.getattrs(c, ghobject_t(oid))
+            for osd in acting:
+                if osd in (self.id, CRUSH_ITEM_NONE):
+                    continue
+                payload, _ = await self._probe_shard(pool, pg, NO_SHARD, osd, oid)
+                if payload is None:
+                    await self._push(pool, pg, NO_SHARD, osd, oid, data, attrs)
+
+    async def _probe_shard(self, pool, pg, shard, osd, oid):
+        """Presence probe: zero-length read with attrs."""
+        if osd == self.id:
+            c = self._shard_coll(pool, pg, shard)
+            o = ghobject_t(oid, shard=shard)
+            if not self.store.exists(c, o):
+                return None, None
+            return b"", self.store.getattrs(c, o)
+        tid = next(self._tids)
+        rep = await self._sub_op(osd, MOSDECSubOpRead(
+            tid=tid, pg=pg, shard=shard, from_osd=self.id, oid=oid,
+            off=0, length=1, want_attrs=True, epoch=self.epoch,
+        ), tid)
+        if rep.result != 0:
+            return None, None
+        return rep.data, rep.attrs
+
+    async def _push(self, pool, pg, shard, osd, oid, payload, attrs) -> None:
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._push_waiters[(pg, shard, osd)] = fut
+        try:
+            conn = await self._osd_conn(osd)
+            await conn.send_message(MOSDPGPush(
+                pg=pg, shard=shard, from_osd=self.id,
+                pushes=[(oid, payload, attrs)], epoch=self.epoch,
+            ))
+            await asyncio.wait_for(fut, SUBOP_TIMEOUT)
+        finally:
+            self._push_waiters.pop((pg, shard, osd), None)
+
+    async def _handle_push(self, msg: MOSDPGPush) -> None:
+        pool = self.osdmap.get_pg_pool(msg.pg.pool)
+        for oid, payload, attrs in msg.pushes:
+            if msg.shard == NO_SHARD:
+                self._apply_full_object(pool, msg.pg, oid, payload, attrs)
+            else:
+                self._apply_shard_write(
+                    pool, msg.pg, msg.shard, oid, payload, attrs
+                )
+        await msg.conn.send_message(MOSDPGPushReply(
+            pg=msg.pg, shard=msg.shard, from_osd=self.id, epoch=self.epoch,
+        ))
+
+
+ECConnErrors = (ConnectionError, asyncio.TimeoutError)

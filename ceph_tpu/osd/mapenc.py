@@ -26,6 +26,40 @@ from ceph_tpu.osd.osdmap import OSDMap
 from ceph_tpu.osd.types import PgPool, pg_t
 
 
+# -- choose_args (shared by crush + osdmap sections) ------------------------
+
+def _enc_choose_args(enc: Encoder, table: dict[int, ChooseArg]) -> None:
+    enc.u32(len(table))
+    for bid in sorted(table):
+        arg = table[bid]
+        enc.i32(bid)
+        ws = arg.weight_set or []
+        enc.u32(len(ws))
+        for pos in ws:
+            enc.u32(len(pos))
+            for w in pos:
+                enc.u64(w)
+        ids = arg.ids
+        enc.bool_(ids is not None)
+        if ids is not None:
+            enc.u32(len(ids))
+            for i in ids:
+                enc.i32(i)
+
+
+def _dec_choose_args(dec: Decoder) -> dict[int, ChooseArg]:
+    out: dict[int, ChooseArg] = {}
+    for _ in range(dec.u32()):
+        bid = dec.i32()
+        nws = dec.u32()
+        ws = [[dec.u64() for _ in range(dec.u32())] for _ in range(nws)]
+        ids = None
+        if dec.bool_():
+            ids = [dec.i32() for _ in range(dec.u32())]
+        out[bid] = ChooseArg(bid, weight_set=ws or None, ids=ids)
+    return out
+
+
 # -- crush ------------------------------------------------------------------
 
 def encode_crush(enc: Encoder, m: CrushMap) -> None:
@@ -75,22 +109,7 @@ def encode_crush(enc: Encoder, m: CrushMap) -> None:
             t.chooseleaf_vary_r, t.chooseleaf_stable,
         ):
             enc.u32(v)
-        enc.u32(len(m.choose_args))
-        for bid in sorted(m.choose_args):
-            arg = m.choose_args[bid]
-            enc.i32(bid)
-            ws = arg.weight_set or []
-            enc.u32(len(ws))
-            for pos in ws:
-                enc.u32(len(pos))
-                for w in pos:
-                    enc.u64(w)
-            ids = arg.ids
-            enc.bool_(ids is not None)
-            if ids is not None:
-                enc.u32(len(ids))
-                for i in ids:
-                    enc.i32(i)
+        _enc_choose_args(enc, m.choose_args)
         enc.u32(len(m.bucket_names))
         for name in sorted(m.bucket_names):
             enc.str_(name)
@@ -147,16 +166,7 @@ def decode_crush(dec: Decoder) -> CrushMap:
             chooseleaf_vary_r=dec.u32(),
             chooseleaf_stable=dec.u32(),
         )
-        for _ in range(dec.u32()):
-            bid = dec.i32()
-            nws = dec.u32()
-            ws = [[dec.u64() for _ in range(dec.u32())] for _ in range(nws)]
-            ids = None
-            if dec.bool_():
-                ids = [dec.i32() for _ in range(dec.u32())]
-            m.choose_args[bid] = ChooseArg(
-                bid, weight_set=ws or None, ids=ids
-            )
+        m.choose_args = _dec_choose_args(dec)
         for _ in range(dec.u32()):
             name = dec.str_()
             m.bucket_names[name] = dec.i32()
@@ -184,8 +194,15 @@ def _encode_pool(enc: Encoder, p: PgPool) -> None:
         enc.str_(p.erasure_code_profile)
         enc.u32(len(p.extra))
         for k in sorted(p.extra):
+            v = p.extra[k]
+            if not isinstance(v, str):
+                from ceph_tpu.msg.denc import EncodingError
+
+                raise EncodingError(
+                    f"pool {p.id} extra[{k!r}] must be str, got {type(v).__name__}"
+                )
             enc.str_(k)
-            enc.str_(str(p.extra[k]))
+            enc.str_(v)
 
 
 def _decode_pool(dec: Decoder) -> PgPool:
@@ -267,6 +284,15 @@ def encode_osdmap(m: OSDMap) -> bytes:
             enc.i32(osd)
             enc.str_(host)
             enc.u32(port)
+        enc.u32(len(m.pool_names))
+        for pid in sorted(m.pool_names):
+            enc.i64(pid)
+            enc.str_(m.pool_names[pid])
+        # the mapping pipeline consumes OSDMap.choose_args (balancer
+        # overrides), which is distinct from the crush map's own table
+        enc.bool_(m.choose_args is not None)
+        if m.choose_args is not None:
+            _enc_choose_args(enc, m.choose_args)
         encode_crush(enc, m.crush)
     return enc.bytes()
 
@@ -308,6 +334,11 @@ def decode_osdmap(data: bytes) -> OSDMap:
             osd = dec.i32()
             host = dec.str_()
             addrs[osd] = (host, dec.u32())
+        pool_names = {}
+        for _ in range(dec.u32()):
+            pid = dec.i64()
+            pool_names[pid] = dec.str_()
+        choose_args = _dec_choose_args(dec) if dec.bool_() else None
         crush = decode_crush(dec)
     om = OSDMap(
         crush=crush, epoch=epoch, max_osd=max_osd,
@@ -317,6 +348,6 @@ def decode_osdmap(data: bytes) -> OSDMap:
         pg_upmap_primaries=pg_upmap_primaries,
         pg_temp=pg_temp, primary_temp=primary_temp,
         erasure_code_profiles=profiles, osd_addrs=addrs,
+        pool_names=pool_names, choose_args=choose_args,
     )
-    om.choose_args = crush.choose_args or None
     return om

@@ -57,6 +57,14 @@ class OSDMap:
     choose_args: dict[int, ChooseArg] | None = None
     # entity addresses (reference OSDMap osd_addrs): osd -> (host, port)
     osd_addrs: dict[int, tuple[str, int]] = field(default_factory=dict)
+    # pool id -> name (reference OSDMap pool_name map)
+    pool_names: dict[int, str] = field(default_factory=dict)
+
+    def lookup_pg_pool_name(self, name: str) -> int:
+        for pid, n in self.pool_names.items():
+            if n == name:
+                return pid
+        return -1
 
     # -- osd state ---------------------------------------------------
 

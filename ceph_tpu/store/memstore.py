@@ -82,6 +82,9 @@ class MemStore(ObjectStore):
                     raise FileNotFoundError(f"{src_c}/{src_o}")
                 if dst_c not in colls:
                     raise FileNotFoundError(f"collection {dst_c}")
+                if dst_o in colls[dst_c]:
+                    # reference MemStore::_collection_move_rename -EEXIST
+                    raise FileExistsError(f"{dst_c}/{dst_o}")
                 colls[src_c].discard(src_o)
                 colls[dst_c].add(dst_o)
                 continue
@@ -109,7 +112,9 @@ class MemStore(ObjectStore):
                     raise FileNotFoundError(f"{c}/{o}")
 
     def _obj(self, c: coll_t, o: ghobject_t, create: bool = False) -> _Obj:
-        coll = self._colls[c]
+        coll = self._colls.get(c)
+        if coll is None:
+            raise FileNotFoundError(f"collection {c}")
         if o not in coll:
             if not create:
                 raise FileNotFoundError(f"{c}/{o}")

@@ -168,7 +168,8 @@ class ObjectStore:
         raise NotImplementedError
 
     def stat(self, c: coll_t, o: ghobject_t) -> int:
-        """Returns object size; raises KeyError if missing."""
+        """Returns object size; raises FileNotFoundError if the
+        collection or object is missing (all read methods do)."""
         raise NotImplementedError
 
     def exists(self, c: coll_t, o: ghobject_t) -> bool:

@@ -137,3 +137,15 @@ class TestAtomicity:
         t.register_on_commit(lambda: events.append("commit"))
         store.queue_transaction(t)
         assert events == ["applied", "commit"]
+
+    def test_move_rename_onto_existing_rejected(self, store):
+        c2 = coll_t(1, 1, 2)
+        store.queue_transaction(Transaction().create_collection(c2))
+        store.queue_transaction(Transaction().write(C, O1, 0, b"src"))
+        store.queue_transaction(Transaction().write(c2, O2, 0, b"live"))
+        with pytest.raises(FileExistsError):
+            store.queue_transaction(
+                Transaction().collection_move_rename(C, O1, c2, O2)
+            )
+        assert store.read(c2, O2) == b"live"  # untouched
+        assert store.read(C, O1) == b"src"

@@ -164,7 +164,14 @@ class TestMapEncoding:
         om.primary_temp[pg_t(1, 5)] = 6
         om.osd_addrs[0] = ("127.0.0.1", 6800)
 
+        # NON-uniform balancer overrides on the OSDMap itself: these
+        # drive placement and must survive the wire (straw2 is
+        # scale-invariant, so only a non-uniform set catches bugs)
+        om.choose_args = {
+            root.id: ChooseArg(root.id, weight_set=[[0x8000, 0x10000, 0x18000, 0x20000]])
+        }
         om2 = decode_osdmap(encode_osdmap(om))
+        assert om2.choose_args == om.choose_args
         assert om2.epoch == 5
         assert om2.osd_state == om.osd_state
         assert om2.osd_weight == om.osd_weight
