@@ -34,7 +34,7 @@ def main() -> int:
     on_tpu = jax.default_backend() not in ("cpu",)
     # 512 MiB of data on TPU; small on CPU (CI smoke).
     S = 64 * 2**20 if on_tpu else 2**16
-    tile = 131072 if on_tpu else 4096
+    tile = 262144 if on_tpu else 4096
 
     rng = np.random.default_rng(0)
     data = jnp.asarray(rng.integers(0, 256, (k, S), dtype=np.uint8))

@@ -22,6 +22,7 @@ re-peer and recover.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
 import time
 
@@ -35,6 +36,8 @@ from ceph_tpu.msg.messages import (
     MOSDBoot,
     MOSDFailure,
     MOSDMap,
+    MOSDScrub,
+    MOSDScrubReply,
 )
 from ceph_tpu.msg.messenger import Connection, Message, Messenger
 from ceph_tpu.osd.mapenc import encode_osdmap
@@ -64,6 +67,8 @@ class Monitor:
         self._down_at: dict[int, float] = {}
         self._pool_ids: dict[str, int] = {}
         self._next_pool = 1
+        self._tids = itertools.count(1)
+        self._scrub_waiters: dict[int, asyncio.Future] = {}
         self._tick_task: asyncio.Task | None = None
         self.addr: tuple[str, int] | None = None
         self._snapshot()
@@ -118,6 +123,10 @@ class Monitor:
                     self.osdmap.epoch: self._epoch_blobs[self.osdmap.epoch]
                 })
             )
+        elif isinstance(msg, MOSDScrubReply):
+            fut = self._scrub_waiters.get(msg.tid)
+            if fut and not fut.done():
+                fut.set_result(msg)
         elif isinstance(msg, MMonCommand):
             code, rs, data = await self._command(msg.cmd)
             await msg.conn.send_message(
@@ -197,6 +206,8 @@ class Monitor:
                     self.osdmap.mark_out(osd)
                     await self._new_epoch()
                 return 0, f"osd.{osd} out", b""
+            if prefix in ("pg scrub", "pg deep-scrub"):
+                return await self._scrub(cmd, deep=prefix == "pg deep-scrub")
             if prefix == "status":
                 om = self.osdmap
                 up = sum(om.is_up(o) for o in range(om.max_osd))
@@ -218,8 +229,38 @@ class Monitor:
         except KeyError as e:
             return -errno.EINVAL, f"missing arg {e}", b""
         except Exception as e:  # command errors must not kill the mon
-            code = -getattr(e, "errno", errno.EINVAL) or -errno.EINVAL
-            return code, str(e), b""
+            eno = getattr(e, "errno", None) or errno.EINVAL
+            return -eno, str(e) or type(e).__name__, b""
+
+    async def _scrub(self, cmd: dict[str, str], deep: bool) -> tuple[int, str, bytes]:
+        """Forward a scrub request to the PG's primary and return its
+        report (OSDMonitor scrub command -> MOSDScrub to the OSD)."""
+        import errno
+
+        from ceph_tpu.osd.types import pg_t
+
+        pool_id, ps = cmd["pgid"].split(".", 1)
+        pool_id, ps = int(pool_id), int(ps, 16) if ps.startswith("0x") else int(ps)
+        om = self.osdmap
+        if om.get_pg_pool(pool_id) is None:
+            return -errno.ENOENT, f"no pool {pool_id}", b""
+        _, _, _, primary = om.pg_to_up_acting_osds(pg_t(pool_id, ps), folded=True)
+        if primary < 0:
+            return -errno.EAGAIN, f"pg {cmd['pgid']} has no primary", b""
+        conn = self._subscribers.get(("osd", primary))
+        if conn is None:
+            return -errno.EAGAIN, f"primary osd.{primary} not connected", b""
+        tid = next(self._tids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._scrub_waiters[tid] = fut
+        try:
+            await conn.send_message(
+                MOSDScrub(tid=tid, pool=pool_id, ps=ps, deep=deep)
+            )
+            reply: MOSDScrubReply = await asyncio.wait_for(fut, 60)
+        finally:
+            self._scrub_waiters.pop(tid, None)
+        return reply.result, "", reply.report
 
     async def _pool_create(self, cmd: dict[str, str]) -> tuple[int, str, bytes]:
         """OSDMonitor::prepare_new_pool (OSDMonitor.cc:7339): erasure

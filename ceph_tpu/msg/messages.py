@@ -250,11 +250,20 @@ class MOSDECSubOpWrite(Message):
         from_osd: int = 0, oid: str = "", off: int = 0,
         data: bytes = b"", attrs: dict[str, bytes] | None = None,
         epoch: int = 0, truncate: int = -1, delete: bool = False,
+        version=None, guard=None,
     ):
         self.tid, self.pg, self.shard, self.from_osd = tid, pg, shard, from_osd
         self.oid, self.off, self.data = oid, off, data
         self.attrs = attrs or {}
         self.epoch, self.truncate, self.delete = epoch, truncate, delete
+        from ceph_tpu.osd.pglog import ZERO
+
+        # the pg-log eversion this write commits at (ZERO = unlogged,
+        # e.g. recovery pushes)
+        self.version = version if version is not None else ZERO
+        # recovery delete-replay guard: skip if the local object is
+        # newer than this (ZERO = unconditional)
+        self.guard = guard if guard is not None else ZERO
 
     def encode_payload(self, enc):
         enc.u64(self.tid)
@@ -267,6 +276,8 @@ class MOSDECSubOpWrite(Message):
         enc.u32(self.epoch)
         enc.i64(self.truncate)
         enc.bool_(self.delete)
+        _enc_ev(enc, self.version)
+        _enc_ev(enc, self.guard)
 
     @classmethod
     def decode_payload(cls, dec):
@@ -275,7 +286,7 @@ class MOSDECSubOpWrite(Message):
         return cls(
             tid, pg, shard, dec.i32(), dec.str_(), dec.u64(),
             dec.bytes_(), _dec_map_str_bytes(dec), dec.u32(),
-            dec.i64(), dec.bool_(),
+            dec.i64(), dec.bool_(), _dec_ev(dec), _dec_ev(dec),
         )
 
 
@@ -377,12 +388,15 @@ class MOSDRepOp(Message):
     def __init__(
         self, tid: int = 0, pg: pg_t = pg_t(0, 0), from_osd: int = 0,
         oid: str = "", data: bytes = b"", attrs: dict[str, bytes] | None = None,
-        delete: bool = False, epoch: int = 0,
+        delete: bool = False, epoch: int = 0, version=None,
     ):
         self.tid, self.pg, self.from_osd = tid, pg, from_osd
         self.oid, self.data = oid, data
         self.attrs = attrs or {}
         self.delete, self.epoch = delete, epoch
+        from ceph_tpu.osd.pglog import ZERO
+
+        self.version = version if version is not None else ZERO
 
     def encode_payload(self, enc):
         enc.u64(self.tid)
@@ -393,6 +407,7 @@ class MOSDRepOp(Message):
         _enc_map_str_bytes(enc, self.attrs)
         enc.bool_(self.delete)
         enc.u32(self.epoch)
+        _enc_ev(enc, self.version)
 
     @classmethod
     def decode_payload(cls, dec):
@@ -400,7 +415,7 @@ class MOSDRepOp(Message):
         pg, _ = _dec_pg(dec)
         return cls(
             tid, pg, dec.i32(), dec.str_(), dec.bytes_(),
-            _dec_map_str_bytes(dec), dec.bool_(), dec.u32(),
+            _dec_map_str_bytes(dec), dec.bool_(), dec.u32(), _dec_ev(dec),
         )
 
 
@@ -481,3 +496,197 @@ class MOSDPGPushReply(Message):
     def decode_payload(cls, dec):
         pg, shard = _dec_pg(dec)
         return cls(pg, shard, dec.i32(), dec.u32())
+
+
+# -- peering / log exchange (src/messages/MOSDPGQuery.h, MOSDPGInfo.h,
+# MOSDPGLog.h — simplified to the primary-serialized model) -----------------
+
+def _enc_ev(enc: Encoder, ev) -> None:
+    enc.u32(ev[0] if isinstance(ev, tuple) else ev.epoch)
+    enc.u64(ev[1] if isinstance(ev, tuple) else ev.version)
+
+
+def _dec_ev(dec: Decoder):
+    from ceph_tpu.osd.pglog import eversion_t
+
+    return eversion_t(dec.u32(), dec.u64())
+
+
+class MOSDPGQuery(Message):
+    """primary -> acting member: send me your pg_info (+ log entries
+    after ``since``, + your object list when ``want_objects``)."""
+
+    TYPE = 114
+
+    def __init__(
+        self, tid: int = 0, pg: pg_t = pg_t(0, 0), shard: int = -1,
+        from_osd: int = 0, since=None, want_objects: bool = False,
+        epoch: int = 0,
+    ):
+        from ceph_tpu.osd.pglog import ZERO
+
+        self.tid, self.pg, self.shard, self.from_osd = tid, pg, shard, from_osd
+        self.since = since if since is not None else ZERO
+        self.want_objects, self.epoch = want_objects, epoch
+
+    def encode_payload(self, enc):
+        enc.u64(self.tid)
+        _enc_pg(enc, self.pg, self.shard)
+        enc.i32(self.from_osd)
+        _enc_ev(enc, self.since)
+        enc.bool_(self.want_objects)
+        enc.u32(self.epoch)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        tid = dec.u64()
+        pg, shard = _dec_pg(dec)
+        return cls(
+            tid, pg, shard, dec.i32(), _dec_ev(dec), dec.bool_(), dec.u32()
+        )
+
+
+class MOSDPGInfo(Message):
+    """Reply to MOSDPGQuery: pg_info + optional log delta + objects."""
+
+    TYPE = 115
+
+    def __init__(
+        self, tid: int = 0, pg: pg_t = pg_t(0, 0), shard: int = -1,
+        from_osd: int = 0, last_update=None, log_tail=None,
+        entries: list[bytes] | None = None,
+        objects: list[tuple[str, bytes]] | None = None, epoch: int = 0,
+    ):
+        from ceph_tpu.osd.pglog import ZERO
+
+        self.tid, self.pg, self.shard, self.from_osd = tid, pg, shard, from_osd
+        self.last_update = last_update if last_update is not None else ZERO
+        self.log_tail = log_tail if log_tail is not None else ZERO
+        self.entries = entries or []
+        self.objects = objects or []
+        self.epoch = epoch
+
+    def encode_payload(self, enc):
+        enc.u64(self.tid)
+        _enc_pg(enc, self.pg, self.shard)
+        enc.i32(self.from_osd)
+        _enc_ev(enc, self.last_update)
+        _enc_ev(enc, self.log_tail)
+        enc.u32(len(self.entries))
+        for e in self.entries:
+            enc.bytes_(e)
+        enc.u32(len(self.objects))
+        for oid, v in self.objects:
+            enc.str_(oid)
+            enc.bytes_(v)
+        enc.u32(self.epoch)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        tid = dec.u64()
+        pg, shard = _dec_pg(dec)
+        from_osd = dec.i32()
+        lu = _dec_ev(dec)
+        lt = _dec_ev(dec)
+        entries = [dec.bytes_() for _ in range(dec.u32())]
+        objects = [(dec.str_(), dec.bytes_()) for _ in range(dec.u32())]
+        return cls(tid, pg, shard, from_osd, lu, lt, entries, objects, dec.u32())
+
+
+class MOSDPGLog(Message):
+    """primary -> recovered member: log entries beyond its last_update
+    so its pg_info catches up after object recovery."""
+
+    TYPE = 116
+
+    def __init__(
+        self, tid: int = 0, pg: pg_t = pg_t(0, 0), shard: int = -1,
+        from_osd: int = 0, entries: list[bytes] | None = None, epoch: int = 0,
+        tail=None,
+    ):
+        from ceph_tpu.osd.pglog import ZERO
+
+        self.tid, self.pg, self.shard, self.from_osd = tid, pg, shard, from_osd
+        self.entries = entries or []
+        self.epoch = epoch
+        # sender's log_tail: lets a backfilled peer know its own log has
+        # a gap below this point
+        self.tail = tail if tail is not None else ZERO
+
+    def encode_payload(self, enc):
+        enc.u64(self.tid)
+        _enc_pg(enc, self.pg, self.shard)
+        enc.i32(self.from_osd)
+        enc.u32(len(self.entries))
+        for e in self.entries:
+            enc.bytes_(e)
+        enc.u32(self.epoch)
+        _enc_ev(enc, self.tail)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        tid = dec.u64()
+        pg, shard = _dec_pg(dec)
+        from_osd = dec.i32()
+        entries = [dec.bytes_() for _ in range(dec.u32())]
+        return cls(tid, pg, shard, from_osd, entries, dec.u32(), _dec_ev(dec))
+
+
+class MOSDPGLogAck(Message):
+    TYPE = 117
+
+    def __init__(self, tid: int = 0, pg: pg_t = pg_t(0, 0), shard: int = -1,
+                 from_osd: int = 0, result: int = 0, epoch: int = 0):
+        self.tid, self.pg, self.shard = tid, pg, shard
+        self.from_osd, self.result, self.epoch = from_osd, result, epoch
+
+    def encode_payload(self, enc):
+        enc.u64(self.tid)
+        _enc_pg(enc, self.pg, self.shard)
+        enc.i32(self.from_osd)
+        enc.i32(self.result)
+        enc.u32(self.epoch)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        tid = dec.u64()
+        pg, shard = _dec_pg(dec)
+        return cls(tid, pg, shard, dec.i32(), dec.i32(), dec.u32())
+
+
+# -- scrub (src/messages/MOSDScrub2.h) --------------------------------------
+
+class MOSDScrub(Message):
+    """mon -> primary OSD: scrub one PG (deep compares payload crcs vs
+    the HashInfo chains)."""
+
+    TYPE = 118
+
+    def __init__(self, tid: int = 0, pool: int = 0, ps: int = 0, deep: bool = False):
+        self.tid, self.pool, self.ps, self.deep = tid, pool, ps, deep
+
+    def encode_payload(self, enc):
+        enc.u64(self.tid)
+        enc.i64(self.pool)
+        enc.u32(self.ps)
+        enc.bool_(self.deep)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        return cls(dec.u64(), dec.i64(), dec.u32(), dec.bool_())
+
+
+class MOSDScrubReply(Message):
+    TYPE = 119
+
+    def __init__(self, tid: int = 0, result: int = 0, report: bytes = b""):
+        self.tid, self.result, self.report = tid, result, report
+
+    def encode_payload(self, enc):
+        enc.u64(self.tid)
+        enc.i32(self.result)
+        enc.bytes_(self.report)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        return cls(dec.u64(), dec.i32(), dec.bytes_())

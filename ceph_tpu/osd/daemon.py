@@ -56,11 +56,28 @@ from ceph_tpu.msg.messages import (
     OP_STAT,
     OP_WRITE_FULL,
 )
+from ceph_tpu.msg.messages import (
+    MOSDPGInfo,
+    MOSDPGLog,
+    MOSDPGLogAck,
+    MOSDPGQuery,
+    MOSDScrub,
+    MOSDScrubReply,
+)
 from ceph_tpu.msg.messenger import Connection, Message, Messenger
 from ceph_tpu.ops.hashing import ceph_str_hash_rjenkins
 from ceph_tpu.osd import ecutil
 from ceph_tpu.osd.mapenc import decode_osdmap
 from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.osd.pglog import (
+    DELETE,
+    MODIFY,
+    PGMETA_OID,
+    ZERO,
+    PGLog,
+    eversion_t,
+    pg_log_entry_t,
+)
 from ceph_tpu.osd.types import PgPool, pg_t
 from ceph_tpu.store import MemStore, Transaction, coll_t, ghobject_t
 
@@ -69,9 +86,22 @@ log = logging.getLogger("ceph_tpu.osd")
 NO_SHARD = -1
 STRIPE_UNIT = 4096  # logical bytes per data chunk per stripe
 SUBOP_TIMEOUT = 30.0
+PG_LOG_KEEP = 128  # osd_min_pg_log_entries analogue
 
 SIZE_ATTR = "_size"
 HINFO_ATTR = "hinfo"
+VERSION_ATTR = "_v"  # object_info version (oi attr analogue)
+
+
+def _v_bytes(v: eversion_t) -> bytes:
+    return v.key().encode()
+
+
+def _v_parse(raw: bytes | None) -> eversion_t:
+    if not raw:
+        return ZERO
+    e, v = raw.decode().split(".")
+    return eversion_t(int(e), int(v))
 
 
 def object_to_pg(pool: PgPool, oid: str) -> pg_t:
@@ -102,6 +132,7 @@ class OSDDaemon:
         self._waiters: dict[int, asyncio.Future] = {}
         self._push_waiters: dict[tuple, asyncio.Future] = {}
         self._ec_cache: dict[str, object] = {}
+        self._pg_logs: dict[coll_t, PGLog] = {}
         self._beacon_task: asyncio.Task | None = None
         self._recovery_task: asyncio.Task | None = None
         self._map_event = asyncio.Event()
@@ -200,6 +231,24 @@ class OSDDaemon:
         _, _, acting, primary = self.osdmap.pg_to_up_acting_osds(pg)
         return acting, primary
 
+    def _pg_log(self, c: coll_t) -> PGLog:
+        lg = self._pg_logs.get(c)
+        if lg is None:
+            lg = PGLog(c)
+            lg.load(self.store)
+            self._pg_logs[c] = lg
+        return lg
+
+    def _next_version(self, c: coll_t) -> eversion_t:
+        lu = self._pg_log(c).info.last_update
+        return eversion_t(self.epoch, lu.version + 1)
+
+    def _object_version(self, c: coll_t, o: ghobject_t) -> eversion_t:
+        try:
+            return _v_parse(self.store.getattr(c, o, VERSION_ATTR))
+        except (FileNotFoundError, KeyError):
+            return ZERO
+
     # -- dispatch ------------------------------------------------------
 
     async def _dispatch(self, msg: Message) -> None:
@@ -216,9 +265,18 @@ class OSDDaemon:
                 await self._handle_rep_op(msg)
             elif isinstance(msg, MOSDPGPush):
                 await self._handle_push(msg)
+            elif isinstance(msg, MOSDPGQuery):
+                await self._handle_pg_query(msg)
+            elif isinstance(msg, MOSDPGLog):
+                await self._handle_pg_log(msg)
+            elif isinstance(msg, MOSDScrub):
+                asyncio.ensure_future(self._handle_scrub(msg))
             elif isinstance(
                 msg,
-                (MOSDECSubOpWriteReply, MOSDECSubOpReadReply, MOSDRepOpReply),
+                (
+                    MOSDECSubOpWriteReply, MOSDECSubOpReadReply,
+                    MOSDRepOpReply, MOSDPGInfo, MOSDPGLogAck,
+                ),
             ):
                 fut = self._waiters.get(msg.tid)
                 if fut and not fut.done():
@@ -302,12 +360,6 @@ class OSDDaemon:
         else:  # empty object: every shard holds an empty chunk
             empty = np.zeros(0, np.uint8)
             shards = {s: empty for s in range(ec.get_chunk_count())}
-        hinfo = ecutil.HashInfo(ec.get_chunk_count())
-        hinfo.append(0, shards)
-        attrs = {
-            HINFO_ATTR: hinfo.to_bytes(),
-            SIZE_ATTR: str(len(data)).encode(),
-        }
         live = [
             (shard, osd)
             for shard, osd in enumerate(acting)
@@ -315,19 +367,28 @@ class OSDDaemon:
         ]
         if len(live) < pool.min_size:
             return MOSDOpReply(tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
+        my_shard = next((s for s, o in live if o == self.id), live[0][0])
+        version = self._next_version(self._shard_coll(pool, pg, my_shard))
+        hinfo = ecutil.HashInfo(ec.get_chunk_count())
+        hinfo.append(0, shards)
+        attrs = {
+            HINFO_ATTR: hinfo.to_bytes(),
+            SIZE_ATTR: str(len(data)).encode(),
+            VERSION_ATTR: _v_bytes(version),
+        }
         waits = []
         for shard, osd in live:
             payload = shards[shard].tobytes()
             if osd == self.id:
                 self._apply_shard_write(
-                    pool, pg, shard, msg.oid, payload, attrs
+                    pool, pg, shard, msg.oid, payload, attrs, version=version
                 )
             else:
                 tid = next(self._tids)
                 waits.append(self._sub_op(osd, MOSDECSubOpWrite(
                     tid=tid, pg=pg, shard=shard, from_osd=self.id,
                     oid=msg.oid, off=0, data=payload, attrs=attrs,
-                    epoch=self.epoch, truncate=len(payload),
+                    epoch=self.epoch, truncate=len(payload), version=version,
                 ), tid))
         if waits:
             replies = await asyncio.gather(*waits)
@@ -339,8 +400,12 @@ class OSDDaemon:
         return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
 
     def _apply_shard_write(
-        self, pool, pg, shard, oid, payload: bytes, attrs, delete=False
+        self, pool, pg, shard, oid, payload: bytes, attrs,
+        delete=False, version: eversion_t = ZERO,
     ) -> None:
+        """Apply a shard write + (when versioned) its pg-log entry in
+        ONE transaction — the reference couples data and log the same
+        way (ECTransaction appends log entries to the shard txn)."""
         c = self._shard_coll(pool, pg, shard)
         o = ghobject_t(oid, shard=shard)
         t = Transaction()
@@ -351,6 +416,14 @@ class OSDDaemon:
         else:
             t.touch(c, o).truncate(c, o, len(payload)).write(c, o, 0, payload)
             t.setattrs(c, o, attrs)
+        if version > ZERO:
+            lg = self._pg_log(c)
+            if version > lg.info.last_update:
+                prior = self._object_version(c, o)
+                lg.append(t, pg_log_entry_t(
+                    DELETE if delete else MODIFY, oid, version, prior,
+                ))
+                lg.trim(t, PG_LOG_KEEP)
         self.store.queue_transaction(t)
 
     async def _ec_read(self, pool, pg, acting, msg, ec, sinfo) -> MOSDOpReply:
@@ -369,7 +442,7 @@ class OSDDaemon:
                 break  # not enough shards left to decode
             need_shards = set(minimum)
             chunks: dict[int, np.ndarray] = {}
-            attrs: dict[str, bytes] = {}
+            shard_attrs: dict[int, dict[str, bytes]] = {}
             failed = None
             for shard in sorted(need_shards):
                 osd = usable[shard]
@@ -383,11 +456,24 @@ class OSDDaemon:
                     failed = (shard, eno)
                     break
                 chunks[shard] = np.frombuffer(payload, np.uint8)
-                if a:
-                    attrs = a
+                shard_attrs[shard] = a or {}
             if failed is not None:
                 excluded[failed[0]] = failed[1]
                 continue
+            # a revived OSD may hold a STALE chunk from before it went
+            # down: all chunks used in one decode must carry the same
+            # object version (object_info consistency; the reference
+            # reaches this via peering/recovery before serving)
+            versions = {
+                s: _v_parse(a.get(VERSION_ATTR)) for s, a in shard_attrs.items()
+            }
+            vmax = max(versions.values(), default=ZERO)
+            stale = [s for s, v in versions.items() if v < vmax]
+            if stale:
+                for s in stale:
+                    excluded[s] = errno.ESTALE
+                continue
+            attrs = next(iter(shard_attrs.values()), {})
             if not attrs or SIZE_ATTR not in attrs:
                 return MOSDOpReply(
                     tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch
@@ -428,20 +514,25 @@ class OSDDaemon:
         return rep.data, rep.attrs, 0
 
     async def _ec_delete(self, pool, pg, acting, msg) -> MOSDOpReply:
+        my_shard = next(
+            (s for s, o in enumerate(acting) if o == self.id), 0
+        )
+        version = self._next_version(self._shard_coll(pool, pg, my_shard))
         waits = []
         for shard, osd in enumerate(acting):
             if osd == CRUSH_ITEM_NONE:
                 continue
             if osd == self.id:
                 self._apply_shard_write(
-                    pool, pg, shard, msg.oid, b"", {}, delete=True
+                    pool, pg, shard, msg.oid, b"", {}, delete=True,
+                    version=version,
                 )
             else:
                 tid = next(self._tids)
                 waits.append(self._sub_op(osd, MOSDECSubOpWrite(
                     tid=tid, pg=pg, shard=shard, from_osd=self.id,
                     oid=msg.oid, off=0, data=b"", attrs={},
-                    epoch=self.epoch, delete=True,
+                    epoch=self.epoch, delete=True, version=version,
                 ), tid))
         if waits:
             await asyncio.gather(*waits)
@@ -451,10 +542,16 @@ class OSDDaemon:
         pool = self.osdmap.get_pg_pool(msg.pg.pool)
         result = 0
         try:
-            self._apply_shard_write(
-                pool, msg.pg, msg.shard, msg.oid, msg.data, msg.attrs,
-                delete=msg.delete,
-            )
+            skip = False
+            if msg.guard > ZERO:
+                c = self._shard_coll(pool, msg.pg, msg.shard)
+                o = ghobject_t(msg.oid, shard=msg.shard)
+                skip = self._object_version(c, o) > msg.guard
+            if not skip:
+                self._apply_shard_write(
+                    pool, msg.pg, msg.shard, msg.oid, msg.data, msg.attrs,
+                    delete=msg.delete, version=msg.version,
+                )
         except OSError as e:
             result = -(e.errno or errno.EIO)
         await msg.conn.send_message(MOSDECSubOpWriteReply(
@@ -504,8 +601,12 @@ class OSDDaemon:
         if msg.op not in (OP_WRITE_FULL, OP_DELETE):
             return MOSDOpReply(tid=msg.tid, result=-errno.EOPNOTSUPP, epoch=self.epoch)
         delete = msg.op == OP_DELETE
-        attrs = {SIZE_ATTR: str(len(msg.data)).encode()}
-        self._apply_full_object(pool, pg, msg.oid, msg.data, attrs, delete)
+        version = self._next_version(self._shard_coll(pool, pg, NO_SHARD))
+        attrs = {
+            SIZE_ATTR: str(len(msg.data)).encode(),
+            VERSION_ATTR: _v_bytes(version),
+        }
+        self._apply_full_object(pool, pg, msg.oid, msg.data, attrs, delete, version)
         waits = []
         for osd in acting:
             if osd in (self.id, CRUSH_ITEM_NONE):
@@ -514,7 +615,7 @@ class OSDDaemon:
             waits.append(self._sub_op(osd, MOSDRepOp(
                 tid=tid, pg=pg, from_osd=self.id, oid=msg.oid,
                 data=b"" if delete else msg.data, attrs=attrs,
-                delete=delete, epoch=self.epoch,
+                delete=delete, epoch=self.epoch, version=version,
             ), tid))
         if waits:
             replies = await asyncio.gather(*waits)
@@ -523,25 +624,22 @@ class OSDDaemon:
                     return MOSDOpReply(tid=msg.tid, result=rep.result, epoch=self.epoch)
         return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
 
-    def _apply_full_object(self, pool, pg, oid, data, attrs, delete=False):
-        c = self._shard_coll(pool, pg, NO_SHARD)
-        o = ghobject_t(oid)
-        t = Transaction()
-        self._ensure_coll(t, c)
-        if delete:
-            if self.store.exists(c, o):
-                t.remove(c, o)
-        else:
-            t.touch(c, o).truncate(c, o, len(data)).write(c, o, 0, data)
-            t.setattrs(c, o, attrs)
-        self.store.queue_transaction(t)
+    def _apply_full_object(
+        self, pool, pg, oid, data, attrs, delete=False,
+        version: eversion_t = ZERO,
+    ):
+        self._apply_shard_write(
+            pool, pg, NO_SHARD, oid, data, attrs, delete=delete,
+            version=version,
+        )
 
     async def _handle_rep_op(self, msg: MOSDRepOp) -> None:
         pool = self.osdmap.get_pg_pool(msg.pg.pool)
         result = 0
         try:
             self._apply_full_object(
-                pool, msg.pg, msg.oid, msg.data, msg.attrs, msg.delete
+                pool, msg.pg, msg.oid, msg.data, msg.attrs, msg.delete,
+                msg.version,
             )
         except OSError as e:
             result = -(e.errno or errno.EIO)
@@ -570,10 +668,7 @@ class OSDDaemon:
                         )
                         if primary != self.id:
                             continue
-                        if pool.is_erasure():
-                            await self._recover_pg_ec(pool, pg, acting)
-                        else:
-                            await self._recover_pg_rep(pool, pg, acting)
+                        await self._recover_pg(pool, pg, acting)
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -581,70 +676,307 @@ class OSDDaemon:
                 return
 
     def _local_objects(self, pool, pg, shard) -> list[str]:
-        c = coll_t(pool.id, pg.ps, shard)
+        c = self._shard_coll(pool, pg, shard)
         if not self.store.collection_exists(c):
             return []
-        return sorted({o.name for o in self.store.collection_list(c)})
-
-    async def _recover_pg_ec(self, pool: PgPool, pg: pg_t, acting: list[int]) -> None:
-        ec = self._ec_for(pool)
-        sinfo = self._sinfo(ec)
-        my_shard = next(
-            (s for s, o in enumerate(acting) if o == self.id), None
+        return sorted(
+            {o.name for o in self.store.collection_list(c)} - {PGMETA_OID}
         )
-        if my_shard is None:
+
+    def _pg_members(
+        self, pool: PgPool, acting: list[int]
+    ) -> list[tuple[int, int]]:
+        """(shard, osd) pairs of the acting set; replicated members all
+        use NO_SHARD collections."""
+        if pool.is_erasure():
+            return [
+                (s, o) for s, o in enumerate(acting) if o != CRUSH_ITEM_NONE
+            ]
+        return [(NO_SHARD, o) for o in acting if o != CRUSH_ITEM_NONE]
+
+    async def _recover_pg(self, pool: PgPool, pg: pg_t, acting: list[int]) -> None:
+        """Peering-lite + recovery for one PG this OSD leads.
+
+        1. collect pg_info from every acting member (MOSDPGQuery);
+        2. adopt log entries from any member ahead of us (we may have
+           been the one that was down);
+        3. scope the object set: exact per-peer missing sets when the
+           log covers everyone (PGLog::proc_replica_log), full
+           backfill over the union of object lists otherwise;
+        4. reconcile each object to its newest version (reconstruct +
+           MOSDPGPush / replayed delete);
+        5. bring lagging members' logs current (MOSDPGLog).
+        """
+        pairs = self._pg_members(pool, acting)
+        if self.id not in [o for _, o in pairs]:
             return
-        names = self._local_objects(pool, pg, my_shard)
-        for oid in names:
-            # probe which acting members miss this object's shard
-            present: dict[int, int] = {}
-            missing: list[tuple[int, int]] = []
-            for shard, osd in enumerate(acting):
-                if osd == CRUSH_ITEM_NONE:
-                    continue
+        my_shard = next(s for s, o in pairs if o == self.id)
+        myc = self._shard_coll(pool, pg, my_shard)
+        lg = self._pg_log(myc)
+
+        peer_infos: dict[tuple[int, int], MOSDPGInfo] = {}
+        for s, o in pairs:
+            if o == self.id:
+                continue
+            try:
+                peer_infos[(s, o)] = await self._pg_query(
+                    pool, pg, s, o, since=lg.info.last_update
+                )
+            except (OSError, asyncio.TimeoutError, ConnectionError):
+                continue  # unreachable; next map change retries
+
+        pre_adopt_lu = lg.info.last_update
+        ahead = [
+            i for i in peer_infos.values()
+            if i.last_update > lg.info.last_update
+        ]
+        gapped = False
+        if ahead:
+            best = max(ahead, key=lambda i: i.last_update)
+            # a peer whose log_tail moved past our state means its
+            # entries_after(our lu) delta has a hole: everything in the
+            # trimmed range must come from backfill, and our own log
+            # must admit the gap (set_tail) so covers() stays truthful
+            gapped = best.log_tail > pre_adopt_lu
+            t = Transaction()
+            self._ensure_coll(t, myc)
+            if gapped:
+                lg.set_tail(t, best.log_tail)
+            for raw in best.entries:
+                e = pg_log_entry_t.decode(raw)
+                if e.version > lg.info.last_update:
+                    lg.append(t, e)
+            lg.trim(t, PG_LOG_KEEP)
+            if not t.empty():
+                self.store.queue_transaction(t)
+
+        # scope
+        scope: set[str] | None = None if gapped else set()
+        if scope is not None:
+            for info in peer_infos.values():
+                miss = lg.missing_from(info.last_update)
+                if miss is None:
+                    scope = None
+                    break
+                scope |= set(miss.items)
+        if ahead and scope is not None:
+            # entries adopted above may name objects my own shard lacks
+            for raw in max(ahead, key=lambda i: i.last_update).entries:
+                e = pg_log_entry_t.decode(raw)
+                scope.add(e.oid)
+        strays: set[str] = set()
+        if scope is None:
+            # backfill: reconcile the union of object lists, but the
+            # member with the newest pre-recovery state is authoritative
+            # for WHICH objects exist — an object only held by stale
+            # members is a stray (deleted while they were down), never
+            # resurrected (reference backfill removes strays the same
+            # way)
+            objs = set(self._local_objects(pool, pg, my_shard))
+            lists: dict[tuple[int, int], set[str]] = {
+                (my_shard, self.id): set(objs)
+            }
+            lus = {(my_shard, self.id): pre_adopt_lu}
+            for (s, o), info in list(peer_infos.items()):
                 try:
-                    payload, attrs = await self._probe_shard(
-                        pool, pg, shard, osd, oid
+                    full = await self._pg_query(
+                        pool, pg, s, o, since=lg.info.last_update,
+                        want_objects=True,
                     )
                 except (OSError, asyncio.TimeoutError, ConnectionError):
                     continue
-                if payload is None:
-                    missing.append((shard, osd))
-                else:
-                    present[shard] = osd
-            if not missing:
+                lists[(s, o)] = {oid for oid, _v in full.objects}
+                lus[(s, o)] = info.last_update
+                objs |= lists[(s, o)]
+            auth = max(lus, key=lambda k: lus[k])
+            strays = objs - lists[auth]
+        else:
+            objs = scope
+        for oid in sorted(objs):
+            try:
+                await self._reconcile_object(
+                    pool, pg, pairs, oid, stray=oid in strays
+                )
+            except (OSError, asyncio.TimeoutError, ConnectionError):
+                log.warning(
+                    "osd.%d: reconcile %s/%s interrupted", self.id, pg, oid
+                )
+                return
+        # log sync
+        for (s, o), info in peer_infos.items():
+            if info.last_update >= lg.info.last_update:
                 continue
-            log.info(
-                "osd.%d: recovering %s/%s shards %s", self.id, pg, oid,
-                [s for s, _ in missing],
-            )
-            # read enough present shards to rebuild the missing ones
-            need = {s for s, _ in missing}
-            chunks: dict[int, np.ndarray] = {}
-            attrs_src: dict[str, bytes] = {}
-            for shard, osd in present.items():
-                payload, attrs, _eno = await self._read_shard(pool, pg, shard, osd, oid)
-                if payload is not None:
-                    chunks[shard] = np.frombuffer(payload, np.uint8)
-                    if attrs:
-                        attrs_src = attrs
-            rebuilt = ecutil.decode_shards(sinfo, ec, chunks, need)
-            for shard, osd in missing:
-                payload = rebuilt[shard].tobytes()
-                await self._push(pool, pg, shard, osd, oid, payload, attrs_src)
+            entries = [
+                e.encode() for e in lg.entries_after(info.last_update)
+            ]
+            try:
+                await self._pg_log_send(pool, pg, s, o, entries, lg.info.log_tail)
+            except (OSError, asyncio.TimeoutError, ConnectionError):
+                continue
 
-    async def _recover_pg_rep(self, pool: PgPool, pg: pg_t, acting: list[int]) -> None:
-        names = self._local_objects(pool, pg, NO_SHARD)
-        c = self._shard_coll(pool, pg, NO_SHARD)
-        for oid in names:
-            data = self.store.read(c, ghobject_t(oid))
-            attrs = self.store.getattrs(c, ghobject_t(oid))
-            for osd in acting:
-                if osd in (self.id, CRUSH_ITEM_NONE):
-                    continue
-                payload, _ = await self._probe_shard(pool, pg, NO_SHARD, osd, oid)
-                if payload is None:
-                    await self._push(pool, pg, NO_SHARD, osd, oid, data, attrs)
+    async def _reconcile_object(
+        self, pool: PgPool, pg: pg_t, pairs: list[tuple[int, int]], oid: str,
+        stray: bool = False,
+    ) -> None:
+        """Bring one object to its newest version on every acting
+        member: replay deletes, remove strays, reconstruct
+        stale/missing shards from the members holding the newest
+        version."""
+        is_ec = pool.is_erasure()
+        my_shard = next(s for s, o in pairs if o == self.id)
+        lg = self._pg_log(self._shard_coll(pool, pg, my_shard))
+        latest: pg_log_entry_t | None = None
+        for v in sorted(lg.entries, reverse=True):
+            if lg.entries[v].oid == oid:
+                latest = lg.entries[v]
+                break
+
+        state: dict[tuple[int, int], tuple[bool, eversion_t, dict]] = {}
+        for s, o in pairs:
+            try:
+                payload, attrs = await self._probe_shard(pool, pg, s, o, oid)
+            except (OSError, asyncio.TimeoutError, ConnectionError):
+                continue  # unreachable: not a source nor target now
+            if payload is None:
+                state[(s, o)] = (False, ZERO, {})
+            else:
+                state[(s, o)] = (
+                    True, _v_parse((attrs or {}).get(VERSION_ATTR)), attrs or {}
+                )
+
+        delete_entry = latest is not None and latest.op == DELETE
+        if delete_entry or (stray and latest is None):
+            # logged delete replay, or a backfill stray (only stale
+            # members hold it; its DELETE entry was trimmed)
+            guard = latest.version if latest else lg.info.last_update
+            for (s, o), (present, _v, _a) in state.items():
+                if present:
+                    await self._recovery_delete(pool, pg, s, o, oid, guard)
+            return
+
+        versions = [v for (p, v, _a) in state.values() if p]
+        if not versions:
+            return  # nothing anywhere to recover from
+        vmax = max(versions)
+        sources = {
+            s: o for (s, o), (p, v, _a) in state.items() if p and v == vmax
+        }
+        targets = [
+            (s, o) for (s, o), (p, v, _a) in state.items()
+            if not p or v < vmax
+        ]
+        if not targets:
+            return
+        log.info(
+            "osd.%d: recovering %s/%s to %s on %s", self.id, pg, oid,
+            vmax, targets,
+        )
+        src_attrs = next(
+            a for (s, o), (p, v, a) in state.items() if p and v == vmax
+        )
+        if not is_ec:
+            s0, o0 = next(iter(sources.items()))
+            payload, _a, _e = await self._read_shard(pool, pg, s0, o0, oid)
+            if payload is None:
+                return
+            for s, o in targets:
+                await self._push(pool, pg, s, o, oid, payload, src_attrs)
+            return
+        ec = self._ec_for(pool)
+        sinfo = self._sinfo(ec)
+        k = ec.get_data_chunk_count()
+        if len(sources) < k:
+            log.error(
+                "osd.%d: %s/%s unrecoverable: %d/%d consistent shards",
+                self.id, pg, oid, len(sources), k,
+            )
+            return
+        chunks: dict[int, np.ndarray] = {}
+        for s, o in sources.items():
+            payload, _a, _e = await self._read_shard(pool, pg, s, o, oid)
+            if payload is not None:
+                chunks[s] = np.frombuffer(payload, np.uint8)
+        need = {s for s, _ in targets}
+        rebuilt = ecutil.decode_shards(sinfo, ec, chunks, need)
+        for s, o in targets:
+            await self._push(pool, pg, s, o, oid, rebuilt[s].tobytes(), src_attrs)
+
+    async def _recovery_delete(
+        self, pool, pg, shard, osd, oid, guard: eversion_t
+    ) -> None:
+        """Replay of a logged delete on a stale member (unlogged: the
+        log itself syncs separately).  ``guard`` protects a concurrent
+        re-create: members whose object is newer than the delete keep
+        it."""
+        if osd == self.id:
+            c = self._shard_coll(pool, pg, shard)
+            if self._object_version(c, ghobject_t(oid, shard=shard)) > guard:
+                return
+            self._apply_shard_write(pool, pg, shard, oid, b"", {}, delete=True)
+            return
+        tid = next(self._tids)
+        await self._sub_op(osd, MOSDECSubOpWrite(
+            tid=tid, pg=pg, shard=shard, from_osd=self.id, oid=oid,
+            off=0, data=b"", attrs={}, epoch=self.epoch, delete=True,
+            guard=guard,
+        ), tid)
+
+    async def _pg_query(
+        self, pool, pg, shard, osd, since, want_objects: bool = False
+    ) -> MOSDPGInfo:
+        if osd == self.id:
+            raise ValueError("query self")
+        tid = next(self._tids)
+        return await self._sub_op(osd, MOSDPGQuery(
+            tid=tid, pg=pg, shard=shard, from_osd=self.id, since=since,
+            want_objects=want_objects, epoch=self.epoch,
+        ), tid)
+
+    async def _pg_log_send(self, pool, pg, shard, osd, entries, tail) -> None:
+        tid = next(self._tids)
+        await self._sub_op(osd, MOSDPGLog(
+            tid=tid, pg=pg, shard=shard, from_osd=self.id,
+            entries=entries, epoch=self.epoch, tail=tail,
+        ), tid)
+
+    async def _handle_pg_query(self, msg: MOSDPGQuery) -> None:
+        pool = self.osdmap.get_pg_pool(msg.pg.pool)
+        c = self._shard_coll(pool, msg.pg, msg.shard)
+        lg = self._pg_log(c)
+        entries = [e.encode() for e in lg.entries_after(msg.since)]
+        objects: list[tuple[str, bytes]] = []
+        if msg.want_objects and self.store.collection_exists(c):
+            for name in self._local_objects(pool, msg.pg, msg.shard):
+                o = ghobject_t(name, shard=msg.shard)
+                try:
+                    v = self.store.getattr(c, o, VERSION_ATTR)
+                except (FileNotFoundError, KeyError):
+                    v = b""
+                objects.append((name, v))
+        await msg.conn.send_message(MOSDPGInfo(
+            tid=msg.tid, pg=msg.pg, shard=msg.shard, from_osd=self.id,
+            last_update=lg.info.last_update, log_tail=lg.info.log_tail,
+            entries=entries, objects=objects, epoch=self.epoch,
+        ))
+
+    async def _handle_pg_log(self, msg: MOSDPGLog) -> None:
+        pool = self.osdmap.get_pg_pool(msg.pg.pool)
+        c = self._shard_coll(pool, msg.pg, msg.shard)
+        lg = self._pg_log(c)
+        t = Transaction()
+        self._ensure_coll(t, c)
+        lg.set_tail(t, msg.tail)
+        for raw in msg.entries:
+            e = pg_log_entry_t.decode(raw)
+            if e.version > lg.info.last_update:
+                lg.append(t, e)
+        lg.trim(t, PG_LOG_KEEP)
+        if not t.empty():
+            self.store.queue_transaction(t)
+        await msg.conn.send_message(MOSDPGLogAck(
+            tid=msg.tid, pg=msg.pg, shard=msg.shard, from_osd=self.id,
+            result=0, epoch=self.epoch,
+        ))
 
     async def _probe_shard(self, pool, pg, shard, osd, oid):
         """Presence probe: zero-length read with attrs."""
@@ -676,9 +1008,128 @@ class OSDDaemon:
         finally:
             self._push_waiters.pop((pg, shard, osd), None)
 
+    # -- scrub (src/osd/scrubber/, simplified to one pass) -------------
+
+    async def _handle_scrub(self, msg: MOSDScrub) -> None:
+        import json
+
+        try:
+            report = await self.scrub_pg(msg.pool, msg.ps, deep=msg.deep)
+            reply = MOSDScrubReply(
+                tid=msg.tid, result=0, report=json.dumps(report).encode()
+            )
+        except Exception as e:
+            log.exception("osd.%d: scrub failed", self.id)
+            reply = MOSDScrubReply(
+                tid=msg.tid, result=-errno.EIO, report=str(e).encode()
+            )
+        try:
+            await msg.conn.send_message(reply)
+        except ConnectionError:
+            pass
+
+    async def scrub_pg(self, pool_id: int, ps: int, deep: bool = False) -> dict:
+        """Consistency check of one PG across its acting set: object
+        sets and versions must agree (shallow); with ``deep``, every
+        shard payload's crc32c must match the stored HashInfo chain
+        (reference: scrub_backend comparing shard crcs vs hinfo,
+        src/osd/scrubber/scrub_backend.cc)."""
+        from ceph_tpu.native import crc32c
+
+        pool = self.osdmap.get_pg_pool(pool_id)
+        if pool is None:
+            return {"error": f"no pool {pool_id}"}
+        pg = pg_t(pool_id, ps)
+        _, _, acting, primary = self.osdmap.pg_to_up_acting_osds(pg, folded=True)
+        if primary != self.id:
+            return {"error": f"osd.{self.id} is not primary for {pool_id}.{ps}"}
+        pairs = self._pg_members(pool, acting)
+
+        member_objects: dict[str, dict[str, bytes]] = {}
+        for s, o in pairs:
+            key = f"{s}@osd.{o}"
+            if o == self.id:
+                objs = {}
+                c = self._shard_coll(pool, pg, s)
+                for name in self._local_objects(pool, pg, s):
+                    go = ghobject_t(name, shard=s)
+                    try:
+                        objs[name] = self.store.getattr(c, go, VERSION_ATTR)
+                    except (FileNotFoundError, KeyError):
+                        objs[name] = b""
+                member_objects[key] = objs
+            else:
+                info = await self._pg_query(
+                    pool, pg, s, o, since=ZERO, want_objects=True
+                )
+                member_objects[key] = dict(info.objects)
+
+        inconsistencies: list[dict] = []
+        all_oids = sorted(set().union(*member_objects.values()) if member_objects else set())
+        for oid in all_oids:
+            versions = {
+                key: objs.get(oid) for key, objs in member_objects.items()
+            }
+            have = {k: v for k, v in versions.items() if v is not None}
+            if len(have) != len(member_objects) or len(set(have.values())) > 1:
+                inconsistencies.append({
+                    "object": oid, "kind": "shallow",
+                    "versions": {
+                        k: (v.decode() if v else None) for k, v in versions.items()
+                    },
+                })
+                continue
+            if not deep:
+                continue
+            # deep: payload crc vs the stored HashInfo chain
+            hinfo_raw = None
+            crcs: dict[str, int] = {}
+            sizes: dict[str, int] = {}
+            for s, o in pairs:
+                key = f"{s}@osd.{o}"
+                payload, attrs, _e = await self._read_shard(pool, pg, s, o, oid)
+                if payload is None:
+                    inconsistencies.append({
+                        "object": oid, "kind": "deep-missing", "member": key,
+                    })
+                    continue
+                crcs[key] = crc32c(payload)
+                sizes[key] = len(payload)
+                if attrs and HINFO_ATTR in attrs:
+                    hinfo_raw = attrs[HINFO_ATTR]
+                if pool.is_erasure() and hinfo_raw:
+                    hi = ecutil.HashInfo.from_bytes(hinfo_raw)
+                    want = hi.get_chunk_hash(s)
+                    if want != crcs[key]:
+                        inconsistencies.append({
+                            "object": oid, "kind": "deep-crc", "member": key,
+                            "stored": want, "computed": crcs[key],
+                        })
+            if not pool.is_erasure() and len(set(crcs.values())) > 1:
+                inconsistencies.append({
+                    "object": oid, "kind": "deep-replica-crc",
+                    "crcs": crcs,
+                })
+        return {
+            "pg": f"{pool_id}.{ps}",
+            "acting": [o for _, o in pairs],
+            "objects": len(all_oids),
+            "deep": deep,
+            "inconsistencies": inconsistencies,
+        }
+
     async def _handle_push(self, msg: MOSDPGPush) -> None:
         pool = self.osdmap.get_pg_pool(msg.pg.pool)
         for oid, payload, attrs in msg.pushes:
+            # never regress: a write may have landed here between the
+            # primary's probe and this push (the reference serializes
+            # this with per-object rw locks; we reconcile on the next
+            # recovery pass instead)
+            c = self._shard_coll(pool, msg.pg, msg.shard)
+            local_v = self._object_version(c, ghobject_t(oid, shard=msg.shard))
+            pushed_v = _v_parse(attrs.get(VERSION_ATTR))
+            if local_v > pushed_v:
+                continue
             if msg.shard == NO_SHARD:
                 self._apply_full_object(pool, msg.pg, oid, payload, attrs)
             else:

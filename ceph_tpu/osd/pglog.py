@@ -1,0 +1,232 @@
+"""Per-PG operation log: versions, missing sets, delta recovery.
+
+Behavioral twin of the reference's log-based consistency core
+(src/osd/PGLog.{h,cc}, src/osd/osd_types.h pg_log_entry_t /
+eversion_t / pg_missing_t; doc/dev/osd_internals/log_based_pg.rst):
+every write the primary orders gets an eversion (epoch, seq); the
+entry is persisted by every acting member in the same transaction as
+the data; after a map change peers compare ``last_update`` and the
+primary computes per-peer missing sets from the log delta — full
+backfill only when a peer's state predates the log tail.
+
+The log lives in the PG meta object's omap (reference: pg log keys in
+the pgmeta object), one key per entry, plus an ``info`` key carrying
+pg_info (last_update, log_tail).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ceph_tpu.msg.denc import Decoder, Encoder
+from ceph_tpu.store import ObjectStore, Transaction, coll_t, ghobject_t
+
+PGMETA_OID = "_pgmeta_"
+INFO_KEY = "info"
+LOG_KEY_PREFIX = "log."
+
+MODIFY = 1
+DELETE = 2
+
+
+@dataclass(frozen=True, order=True)
+class eversion_t:
+    """(epoch, version) — reference src/osd/osd_types.h eversion_t;
+    totally ordered, (0, 0) is 'nothing'."""
+
+    epoch: int = 0
+    version: int = 0
+
+    def key(self) -> str:
+        # zero-padded so omap string order == version order
+        return f"{self.epoch:010d}.{self.version:012d}"
+
+    def __str__(self) -> str:
+        return f"{self.epoch}'{self.version}"
+
+
+ZERO = eversion_t(0, 0)
+
+
+@dataclass(frozen=True)
+class pg_log_entry_t:
+    """One ordered op (reference pg_log_entry_t: op, soid, version,
+    prior_version)."""
+
+    op: int
+    oid: str
+    version: eversion_t
+    prior_version: eversion_t = ZERO
+
+    def encode(self) -> bytes:
+        enc = Encoder()
+        with enc.versioned(1, 1):
+            enc.u8(self.op)
+            enc.str_(self.oid)
+            enc.u32(self.version.epoch)
+            enc.u64(self.version.version)
+            enc.u32(self.prior_version.epoch)
+            enc.u64(self.prior_version.version)
+        return enc.bytes()
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "pg_log_entry_t":
+        dec = Decoder(raw)
+        with dec.versioned():
+            op = dec.u8()
+            oid = dec.str_()
+            v = eversion_t(dec.u32(), dec.u64())
+            pv = eversion_t(dec.u32(), dec.u64())
+        return cls(op, oid, v, pv)
+
+
+@dataclass
+class pg_info_t:
+    """The slice of reference pg_info_t peering compares."""
+
+    last_update: eversion_t = ZERO
+    log_tail: eversion_t = ZERO
+
+    def encode(self) -> bytes:
+        enc = Encoder()
+        with enc.versioned(1, 1):
+            enc.u32(self.last_update.epoch)
+            enc.u64(self.last_update.version)
+            enc.u32(self.log_tail.epoch)
+            enc.u64(self.log_tail.version)
+        return enc.bytes()
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "pg_info_t":
+        dec = Decoder(raw)
+        with dec.versioned():
+            lu = eversion_t(dec.u32(), dec.u64())
+            lt = eversion_t(dec.u32(), dec.u64())
+        return cls(lu, lt)
+
+
+@dataclass
+class MissingSet:
+    """oid -> (need, have): versions a peer must recover
+    (reference pg_missing_t)."""
+
+    items: dict[str, tuple[eversion_t, eversion_t]] = field(default_factory=dict)
+
+    def add(self, oid: str, need: eversion_t, have: eversion_t = ZERO) -> None:
+        prev = self.items.get(oid)
+        if prev is None or need > prev[0]:
+            have = prev[1] if prev is not None else have
+            self.items[oid] = (need, have)
+
+    def __bool__(self) -> bool:
+        return bool(self.items)
+
+    def __len__(self) -> int:
+        return len(self.items)
+
+
+class PGLog:
+    """In-memory log + its persistence into the pgmeta omap."""
+
+    def __init__(self, cid: coll_t):
+        self.cid = cid
+        self.meta = ghobject_t(PGMETA_OID, shard=cid.shard)
+        self.info = pg_info_t()
+        self.entries: dict[eversion_t, pg_log_entry_t] = {}
+
+    # -- mutation ------------------------------------------------------
+
+    def append(self, t: Transaction, entry: pg_log_entry_t) -> None:
+        """Record one op; caller folds ``t`` into the data transaction
+        so log and data commit atomically."""
+        assert entry.version > self.info.last_update, (
+            entry.version, self.info.last_update,
+        )
+        self.entries[entry.version] = entry
+        self.info.last_update = entry.version
+        t.touch(self.cid, self.meta)
+        t.omap_setkeys(self.cid, self.meta, {
+            LOG_KEY_PREFIX + entry.version.key(): entry.encode(),
+            INFO_KEY: self.info.encode(),
+        })
+
+    def trim(self, t: Transaction, keep: int) -> None:
+        """Drop oldest entries beyond ``keep`` (osd_min_pg_log_entries
+        semantics); log_tail advances to the oldest kept version."""
+        if len(self.entries) <= keep:
+            return
+        versions = sorted(self.entries)
+        drop = versions[: len(versions) - keep]
+        for v in drop:
+            del self.entries[v]
+        self.info.log_tail = drop[-1]
+        t.touch(self.cid, self.meta)
+        t.omap_rmkeys(
+            self.cid, self.meta, [LOG_KEY_PREFIX + v.key() for v in drop]
+        )
+        t.omap_setkeys(self.cid, self.meta, {INFO_KEY: self.info.encode()})
+
+    def set_tail(self, t: Transaction, tail: eversion_t) -> None:
+        """Adopt a sender's log_tail after backfill: entries at or below
+        it are dropped (the local log has a gap there)."""
+        if tail <= self.info.log_tail:
+            return
+        drop = [v for v in self.entries if v <= tail]
+        for v in drop:
+            del self.entries[v]
+        self.info.log_tail = tail
+        if self.info.last_update < tail:
+            self.info.last_update = tail
+        t.touch(self.cid, self.meta)
+        if drop:
+            t.omap_rmkeys(
+                self.cid, self.meta, [LOG_KEY_PREFIX + v.key() for v in drop]
+            )
+        t.omap_setkeys(self.cid, self.meta, {INFO_KEY: self.info.encode()})
+
+    # -- persistence ---------------------------------------------------
+
+    def load(self, store: ObjectStore) -> None:
+        if not store.collection_exists(self.cid) or not store.exists(
+            self.cid, self.meta
+        ):
+            return
+        omap = store.omap_get(self.cid, self.meta)
+        if INFO_KEY in omap:
+            self.info = pg_info_t.decode(omap[INFO_KEY])
+        self.entries = {}
+        for key, raw in omap.items():
+            if key.startswith(LOG_KEY_PREFIX):
+                e = pg_log_entry_t.decode(raw)
+                self.entries[e.version] = e
+
+    # -- peering math --------------------------------------------------
+
+    def entries_after(self, v: eversion_t) -> list[pg_log_entry_t]:
+        return [self.entries[k] for k in sorted(self.entries) if k > v]
+
+    def covers(self, v: eversion_t) -> bool:
+        """True when the log can produce an exact delta from state
+        ``v`` (v >= log_tail)."""
+        return v >= self.info.log_tail
+
+    def missing_from(self, peer_last_update: eversion_t) -> MissingSet | None:
+        """Missing set for a peer at ``peer_last_update``; None means
+        the log was trimmed past it and backfill is required
+        (PGLog::proc_replica_log semantics, simplified: no divergent
+        branches because the primary serializes all writes)."""
+        if peer_last_update == self.info.last_update:
+            return MissingSet()
+        if not self.covers(peer_last_update):
+            return None
+        missing = MissingSet()
+        latest: dict[str, pg_log_entry_t] = {}
+        for e in self.entries_after(peer_last_update):
+            latest[e.oid] = e
+        for oid, e in latest.items():
+            if e.op == DELETE:
+                # deletion replays as a delete during recovery
+                missing.add(oid, e.version)
+            else:
+                missing.add(oid, e.version, e.prior_version)
+        return missing

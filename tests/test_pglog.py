@@ -1,0 +1,123 @@
+"""PGLog unit tests (reference analogue: src/test/osd/TestPGLog.cc,
+simplified to the primary-serialized model)."""
+
+import pytest
+
+from ceph_tpu.osd.pglog import (
+    DELETE,
+    MODIFY,
+    ZERO,
+    MissingSet,
+    PGLog,
+    eversion_t,
+    pg_info_t,
+    pg_log_entry_t,
+)
+from ceph_tpu.store import MemStore, Transaction, coll_t
+
+
+def ev(e, v):
+    return eversion_t(e, v)
+
+
+@pytest.fixture
+def store():
+    s = MemStore()
+    s.queue_transaction(Transaction().create_collection(C))
+    return s
+
+
+C = coll_t(1, 0, 0)
+
+
+def applied(log, store, entry):
+    t = Transaction()
+    log.append(t, entry)
+    store.queue_transaction(t)
+
+
+class TestLog:
+    def test_append_advances_info(self, store):
+        log = PGLog(C)
+        applied(log, store, pg_log_entry_t(MODIFY, "a", ev(1, 1)))
+        applied(log, store, pg_log_entry_t(MODIFY, "b", ev(1, 2), ev(1, 1)))
+        assert log.info.last_update == ev(1, 2)
+        assert log.info.log_tail == ZERO
+
+    def test_append_rejects_stale_version(self, store):
+        log = PGLog(C)
+        applied(log, store, pg_log_entry_t(MODIFY, "a", ev(2, 5)))
+        with pytest.raises(AssertionError):
+            log.append(Transaction(), pg_log_entry_t(MODIFY, "b", ev(2, 5)))
+
+    def test_persistence_roundtrip(self, store):
+        log = PGLog(C)
+        applied(log, store, pg_log_entry_t(MODIFY, "a", ev(1, 1)))
+        applied(log, store, pg_log_entry_t(DELETE, "a", ev(2, 2), ev(1, 1)))
+        log2 = PGLog(C)
+        log2.load(store)
+        assert log2.info.last_update == ev(2, 2)
+        assert sorted(log2.entries) == [ev(1, 1), ev(2, 2)]
+        assert log2.entries[ev(2, 2)].op == DELETE
+        assert log2.entries[ev(2, 2)].prior_version == ev(1, 1)
+
+    def test_trim_moves_tail(self, store):
+        log = PGLog(C)
+        for i in range(1, 11):
+            applied(log, store, pg_log_entry_t(MODIFY, f"o{i}", ev(1, i)))
+        t = Transaction()
+        log.trim(t, keep=3)
+        store.queue_transaction(t)
+        assert sorted(log.entries) == [ev(1, 8), ev(1, 9), ev(1, 10)]
+        assert log.info.log_tail == ev(1, 7)
+        # persisted state agrees
+        log2 = PGLog(C)
+        log2.load(store)
+        assert sorted(log2.entries) == sorted(log.entries)
+        assert log2.info.log_tail == ev(1, 7)
+
+    def test_version_key_order_is_string_order(self):
+        vs = [ev(1, 2), ev(1, 10), ev(2, 1), ev(10, 0)]
+        keys = [v.key() for v in vs]
+        assert keys == sorted(keys)
+
+
+class TestMissing:
+    def _log_with(self, store, n=5):
+        log = PGLog(C)
+        for i in range(1, n + 1):
+            applied(log, store, pg_log_entry_t(MODIFY, f"o{i}", ev(1, i)))
+        return log
+
+    def test_up_to_date_peer_has_empty_missing(self, store):
+        log = self._log_with(store)
+        missing = log.missing_from(ev(1, 5))
+        assert missing is not None and not missing
+
+    def test_behind_peer_gets_delta(self, store):
+        log = self._log_with(store)
+        missing = log.missing_from(ev(1, 2))
+        assert missing is not None
+        assert sorted(missing.items) == ["o3", "o4", "o5"]
+        assert missing.items["o3"][0] == ev(1, 3)
+
+    def test_rewrites_collapse_to_latest(self, store):
+        log = self._log_with(store, 3)
+        applied(log, store, pg_log_entry_t(MODIFY, "o2", ev(1, 4), ev(1, 2)))
+        missing = log.missing_from(ev(1, 1))
+        assert missing.items["o2"][0] == ev(1, 4)
+
+    def test_delete_is_replayed(self, store):
+        log = self._log_with(store, 3)
+        applied(log, store, pg_log_entry_t(DELETE, "o1", ev(1, 4), ev(1, 1)))
+        missing = log.missing_from(ev(1, 3))
+        assert list(missing.items) == ["o1"]
+
+    def test_trimmed_past_peer_forces_backfill(self, store):
+        log = self._log_with(store, 10)
+        t = Transaction()
+        log.trim(t, keep=2)
+        store.queue_transaction(t)
+        assert log.missing_from(ev(1, 3)) is None     # backfill
+        assert log.missing_from(ev(1, 9)) is not None  # delta still fine
+        assert log.missing_from(ZERO) is None          # brand-new peer
