@@ -49,7 +49,9 @@ class RadosClient:
 
     def __init__(self, client_id: int | None = None):
         self.id = client_id if client_id is not None else (os.getpid() << 8) | 1
-        self.messenger = Messenger(("client", self.id), self._dispatch)
+        self.messenger = Messenger(
+            ("client", self.id), self._dispatch, on_reset=self._on_reset
+        )
         self.osdmap: OSDMap | None = None
         self._mon_conn: Connection | None = None
         self._tids = itertools.count(1)
@@ -58,18 +60,72 @@ class RadosClient:
         self._map_event = asyncio.Event()
 
     async def connect(self, mon_host: str, mon_port: int) -> None:
+        await self.connect_multi([(mon_host, mon_port)])
+
+    async def connect_multi(self, monmap: list[tuple[str, int]]) -> None:
+        """Connect against a monitor quorum: subscribe to the first
+        reachable member; commands re-target the leader on ENOTLEADER
+        redirects (the MonClient hunting/redirect behavior)."""
         from ceph_tpu.msg.messages import MMonSubscribe
 
-        self._mon_conn = await self.messenger.connect_to(
-            ("mon", 0), mon_host, mon_port
-        )
+        self._mon_addrs = list(monmap)
+        if not hasattr(self, "_monmap"):
+            self._monmap: dict[int, tuple[str, int]] = {}  # rank -> addr
+        new_conn = None
+        last: Exception | None = None
+        addr_rank = {a: r for r, a in self._monmap.items()}
+        for host, port in self._mon_addrs:
+            rank = addr_rank.get((host, port))
+            if rank is not None:
+                # reuse a live session instead of stacking new sockets
+                existing = self.messenger.get_connection(("mon", rank))
+                if existing is not None and not existing._closed:
+                    if new_conn is None:
+                        new_conn = existing
+                    continue
+            try:
+                conn = await self.messenger.connect(host, port)
+            except (ConnectionError, OSError) as e:
+                last = e
+                continue
+            # the HELLO tells us which rank answers at this address
+            self._monmap[conn.peer[1]] = (host, port)
+            if new_conn is None:
+                new_conn = conn
+        if new_conn is None:
+            raise RadosError(errno.EHOSTUNREACH, f"no monitor reachable: {last}")
+        # swap atomically: concurrent commands never see a None session
+        self._mon_conn = new_conn
         await self._mon_conn.send_message(MMonSubscribe())
         await self._wait_new_map(0, timeout=10.0)
         if self.osdmap is None:
             raise RadosError(errno.ETIMEDOUT, "no map from mon")
 
     async def shutdown(self) -> None:
+        self._stopping = True
+        t = getattr(self, "_hunt_task", None)
+        if t:
+            t.cancel()
         await self.messenger.shutdown()
+
+    async def _on_reset(self, conn) -> None:
+        """Our monitor session died: hunt for a live quorum member and
+        re-subscribe so maps keep flowing (MonClient hunting)."""
+        if conn is not self._mon_conn or getattr(self, "_stopping", False):
+            return
+
+        async def hunt():
+            for _ in range(50):
+                await asyncio.sleep(0.2)
+                if getattr(self, "_stopping", False):
+                    return
+                try:
+                    await self.connect_multi(self._mon_addrs)
+                    return
+                except (RadosError, ConnectionError, OSError):
+                    continue
+
+        self._hunt_task = asyncio.ensure_future(hunt())
 
     async def _dispatch(self, msg: Message) -> None:
         if isinstance(msg, MOSDMap):
@@ -107,15 +163,39 @@ class RadosClient:
     # -- admin commands ------------------------------------------------
 
     async def command(self, cmd: dict[str, str]) -> tuple[int, str, bytes]:
-        tid = next(self._tids)
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._cmd_waiters[tid] = fut
-        try:
-            await self._mon_conn.send_message(MMonCommand(tid=tid, cmd=cmd))
-            ack: MMonCommandAck = await asyncio.wait_for(fut, OP_TIMEOUT)
+        ack = None
+        for _redirect in range(6):
+            tid = next(self._tids)
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._cmd_waiters[tid] = fut
+            try:
+                await self._mon_conn.send_message(MMonCommand(tid=tid, cmd=cmd))
+                ack: MMonCommandAck = await asyncio.wait_for(fut, OP_TIMEOUT)
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                # our monitor died: hunt for a live one (MonClient
+                # hunting) and retry after the election settles
+                await asyncio.sleep(0.2)
+                await self.connect_multi(getattr(self, "_mon_addrs", []))
+                continue
+            finally:
+                self._cmd_waiters.pop(tid, None)
+            if ack.code == -errno.EAGAIN and ack.rs.startswith("ENOTLEADER"):
+                leader = int(ack.rs.split()[1])
+                addr = getattr(self, "_monmap", {}).get(leader)
+                if addr is not None:
+                    self._mon_conn = await self.messenger.connect_to(
+                        ("mon", leader), *addr
+                    )
+                    from ceph_tpu.msg.messages import MMonSubscribe
+
+                    await self._mon_conn.send_message(MMonSubscribe())
+                    continue
+                await asyncio.sleep(0.2)  # quorum electing; retry
+                continue
             return ack.code, ack.rs, ack.data
-        finally:
-            self._cmd_waiters.pop(tid, None)
+        if ack is None:
+            return -errno.ETIMEDOUT, "command retries exhausted", b""
+        return ack.code, ack.rs, ack.data
 
     async def pool_create(
         self, name: str, pg_num: int = 8, pool_type: str = "replicated", **kw

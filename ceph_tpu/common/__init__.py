@@ -1,0 +1,23 @@
+"""Shared daemon infrastructure (reference src/common/): typed config,
+perf counters, metrics exposition."""
+
+from ceph_tpu.common.config import OPTIONS, ConfigProxy, Option, declare
+from ceph_tpu.common.metrics import (
+    MetricsServer,
+    PerfCounters,
+    all_collections,
+    get_perf_counters,
+    prometheus_text,
+)
+
+__all__ = [
+    "OPTIONS",
+    "ConfigProxy",
+    "MetricsServer",
+    "Option",
+    "PerfCounters",
+    "all_collections",
+    "declare",
+    "get_perf_counters",
+    "prometheus_text",
+]

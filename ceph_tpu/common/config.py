@@ -1,0 +1,161 @@
+"""Typed configuration system.
+
+Behavioral twin of the reference's option framework
+(src/common/options/*.yaml.in declarations -> md_config_t,
+src/common/config.h): options are declared once with type, default,
+level, bounds and description; values merge from sources with fixed
+precedence (compiled defaults < conf file < mon store < env < cli <
+runtime override, mirroring the reference's merge order); and live
+updates notify registered observers (md_config_obs_t::handle_conf_change)
+via :meth:`ConfigProxy.apply_changes`.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+LEVEL_BASIC = "basic"
+LEVEL_ADVANCED = "advanced"
+LEVEL_DEV = "dev"
+
+# source precedence, low to high (config.h CONF_* levels)
+SOURCES = ("default", "file", "mon", "env", "cmdline", "override")
+
+
+@dataclass(frozen=True)
+class Option:
+    name: str
+    type: type
+    default: Any
+    level: str = LEVEL_ADVANCED
+    desc: str = ""
+    min: float | None = None
+    max: float | None = None
+    see_also: tuple[str, ...] = ()
+
+    def cast(self, value: Any) -> Any:
+        if self.type is bool and isinstance(value, str):
+            v = value.strip().lower()
+            if v in ("true", "1", "yes", "on"):
+                return True
+            if v in ("false", "0", "no", "off"):
+                return False
+            raise ValueError(f"{self.name}: not a bool: {value!r}")
+        out = self.type(value)
+        if self.min is not None and out < self.min:
+            raise ValueError(f"{self.name}: {out} < min {self.min}")
+        if self.max is not None and out > self.max:
+            raise ValueError(f"{self.name}: {out} > max {self.max}")
+        return out
+
+
+#: the option schema (the options/*.yaml.in analogue).  Add options
+#: here as subsystems grow; unknown names are rejected like the
+#: reference's strict mode.
+OPTIONS: dict[str, Option] = {}
+
+
+def declare(*options: Option) -> None:
+    for o in options:
+        OPTIONS[o.name] = o
+
+
+declare(
+    Option("osd_pool_default_size", int, 3, LEVEL_BASIC,
+           "default replica count for replicated pools", min=1),
+    Option("osd_pool_default_pg_num", int, 8, LEVEL_BASIC,
+           "default pg_num for new pools", min=1),
+    Option("osd_beacon_report_interval", float, 1.0, LEVEL_ADVANCED,
+           "seconds between osd->mon liveness beacons", min=0.0),
+    Option("mon_osd_beacon_grace", float, 0.0, LEVEL_ADVANCED,
+           "seconds without a beacon before an osd is marked down "
+           "(0 disables the sweep)"),
+    Option("mon_osd_down_out_interval", float, 0.0, LEVEL_ADVANCED,
+           "seconds down before an osd is marked out (0 disables)"),
+    Option("osd_min_pg_log_entries", int, 128, LEVEL_ADVANCED,
+           "pg log entries kept per shard", min=1,
+           see_also=("osd_max_pg_log_entries",)),
+    Option("osd_recovery_max_active", int, 4, LEVEL_ADVANCED,
+           "concurrent recovery reconciliations per osd", min=1),
+    Option("osd_erasure_code_plugins", str, "jax jerasure isa clay shec lrc",
+           LEVEL_ADVANCED, "plugins preloaded at osd start"),
+    Option("ms_inject_socket_failures", int, 0, LEVEL_DEV,
+           "inject a connection reset every N sent frames (0 = off); "
+           "the reference's ms_inject_socket_failures "
+           "(src/common/options/global.yaml.in:1242)"),
+    Option("debug_osd", int, 1, LEVEL_DEV, "osd log verbosity", min=0, max=5),
+    Option("debug_mon", int, 1, LEVEL_DEV, "mon log verbosity", min=0, max=5),
+)
+
+
+class ConfigProxy:
+    """Per-daemon view of the option set (md_config_t + ConfigProxy)."""
+
+    def __init__(self, overrides: dict[str, Any] | None = None):
+        self._values: dict[str, dict[str, Any]] = {}  # name -> source -> val
+        self._observers: list[tuple[tuple[str, ...], Callable]] = []
+        # env source: CEPH_TPU_<OPTION_IN_CAPS>
+        for name, opt in OPTIONS.items():
+            env = os.environ.get("CEPH_TPU_" + name.upper())
+            if env is not None:
+                self._values.setdefault(name, {})["env"] = opt.cast(env)
+        for k, v in (overrides or {}).items():
+            self.set(k, v, source="cmdline")
+
+    def get(self, name: str) -> Any:
+        opt = OPTIONS.get(name)
+        if opt is None:
+            raise KeyError(f"unknown option {name!r}")
+        layers = self._values.get(name, {})
+        for source in reversed(SOURCES):
+            if source in layers:
+                return layers[source]
+        return opt.default
+
+    def __getitem__(self, name: str) -> Any:
+        return self.get(name)
+
+    def set(self, name: str, value: Any, source: str = "override") -> None:
+        opt = OPTIONS.get(name)
+        if opt is None:
+            raise KeyError(f"unknown option {name!r}")
+        if source not in SOURCES:
+            raise ValueError(f"unknown source {source!r}")
+        self._values.setdefault(name, {})[source] = opt.cast(value)
+
+    def rm(self, name: str, source: str = "override") -> None:
+        self._values.get(name, {}).pop(source, None)
+
+    def load_file(self, kv: dict[str, Any]) -> None:
+        """Apply a conf-file dict (the ceph.conf parse result)."""
+        for k, v in kv.items():
+            self.set(k, v, source="file")
+
+    # -- observers (md_config_obs_t) -----------------------------------
+
+    def add_observer(
+        self, keys: tuple[str, ...] | list[str], cb: Callable[[dict], None]
+    ) -> None:
+        self._observers.append((tuple(keys), cb))
+
+    def apply_changes(self, changed: dict[str, Any], source: str = "override") -> None:
+        """Set + notify observers watching any changed key — the
+        reference's apply_changes/live-update path (e.g. the mClock
+        scheduler re-reading its knobs)."""
+        for k, v in changed.items():
+            self.set(k, v, source=source)
+        names = set(changed)
+        for keys, cb in self._observers:
+            hit = names & set(keys)
+            if hit:
+                cb({k: self.get(k) for k in hit})
+
+    def show(self, level: str | None = None) -> dict[str, Any]:
+        """`config show`: effective values (optionally one level)."""
+        return {
+            name: self.get(name)
+            for name, opt in sorted(OPTIONS.items())
+            if level is None or opt.level == level
+        }

@@ -1,0 +1,116 @@
+"""Perf counters + prometheus-text exposition.
+
+Behavioral twin of the reference's always-on metrics
+(src/common/perf_counters.h: typed counters/gauges/averages dumped via
+the admin socket's `perf dump`; exported to prometheus by the mgr
+module and src/exporter/).  Daemons hold a :class:`PerfCounters` per
+subsystem; :func:`prometheus_text` renders every registered collection
+in the exposition format, and :class:`MetricsServer` serves it over
+HTTP — the standalone-exporter analogue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from collections import defaultdict
+
+
+class PerfCounters:
+    """One named collection of counters/gauges (PerfCountersBuilder)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._counters: dict[str, float] = defaultdict(float)
+        self._gauges: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, key: str, by: float = 1.0) -> None:
+        with self._lock:
+            self._counters[key] += by
+
+    def set_gauge(self, key: str, value: float) -> None:
+        with self._lock:
+            self._gauges[key] = value
+
+    def dump(self) -> dict[str, float]:
+        """`perf dump` over the admin socket."""
+        with self._lock:
+            return {**self._counters, **self._gauges}
+
+
+_COLLECTIONS: dict[str, PerfCounters] = {}
+_REG_LOCK = threading.Lock()
+
+
+def get_perf_counters(name: str) -> PerfCounters:
+    with _REG_LOCK:
+        pc = _COLLECTIONS.get(name)
+        if pc is None:
+            pc = _COLLECTIONS[name] = PerfCounters(name)
+        return pc
+
+
+def all_collections() -> dict[str, PerfCounters]:
+    with _REG_LOCK:
+        return dict(_COLLECTIONS)
+
+
+def _sanitize(s: str) -> str:
+    return "".join(ch if (ch.isalnum() or ch == "_") else "_" for ch in s)
+
+
+def prometheus_text(collections: dict[str, PerfCounters] | None = None) -> str:
+    """Prometheus exposition format over every collection (the
+    mgr/prometheus + ceph-exporter output shape)."""
+    out = []
+    for cname, pc in sorted((collections or all_collections()).items()):
+        for key, val in sorted(pc.dump().items()):
+            metric = f"ceph_tpu_{_sanitize(cname)}_{_sanitize(key)}"
+            out.append(f"{metric} {val}")
+    return "\n".join(out) + "\n"
+
+
+class MetricsServer:
+    """Minimal HTTP /metrics endpoint (src/exporter/ analogue)."""
+
+    def __init__(self, collections: dict[str, PerfCounters] | None = None):
+        self._collections = collections
+        self._server: asyncio.base_events.Server | None = None
+        self.addr: tuple[str, int] | None = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._handle, host, port)
+        self.addr = self._server.sockets[0].getsockname()[:2]
+        return self.addr
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            req = await asyncio.wait_for(reader.readline(), 5)
+            while True:  # drain headers
+                line = await asyncio.wait_for(reader.readline(), 5)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            path = req.split(b" ")[1].decode() if b" " in req else "/"
+            if path == "/metrics":
+                body = prometheus_text(self._collections).encode()
+                status = b"200 OK"
+            else:
+                body = b"see /metrics\n"
+                status = b"404 Not Found"
+            writer.write(
+                b"HTTP/1.1 " + status + b"\r\n"
+                b"Content-Type: text/plain; version=0.0.4\r\n"
+                b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                b"Connection: close\r\n\r\n" + body
+            )
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError, IndexError):
+            pass
+        finally:
+            writer.close()

@@ -53,12 +53,28 @@ class Monitor:
         crush: CrushMap | None = None,
         beacon_grace: float = 0.0,
         out_interval: float = 0.0,
+        rank: int = 0,
+        n_mons: int = 1,
     ):
         """``beacon_grace``/``out_interval``: seconds without a beacon
         before an OSD is marked down / out; 0 disables the sweep (tests
-        drive failure via MOSDFailure or commands)."""
+        drive failure via MOSDFailure or commands).
+
+        Multi-monitor quorums: construct each member with its ``rank``
+        and the total ``n_mons``, ``start()`` them all, then call
+        ``open_quorum(monmap)`` with every member's address — the
+        rank-based election picks a leader and all state mutations
+        replicate through Paxos (ceph_tpu/mon/paxos.py)."""
+        from ceph_tpu.mon.paxos import Paxos
+
+        self.rank = rank
+        self.n_mons = n_mons
+        self.monmap: list[tuple[str, int]] = []
         self.osdmap = OSDMap(crush=crush or CrushMap())
-        self.messenger = Messenger(("mon", 0), self._dispatch)
+        self.messenger = Messenger(
+            ("mon", rank), self._dispatch, on_reset=self._on_reset
+        )
+        self.paxos = Paxos(rank, n_mons, self._send_mon, self._apply_committed)
         self.beacon_grace = beacon_grace
         self.out_interval = out_interval
         self._epoch_blobs: dict[int, bytes] = {}
@@ -81,10 +97,63 @@ class Monitor:
             self._tick_task = asyncio.ensure_future(self._tick())
         return self.addr
 
+    async def open_quorum(self, monmap: list[tuple[str, int]]) -> None:
+        """Join the quorum: learn everyone's address, run an election
+        (call on every member after all have start()ed)."""
+        assert len(monmap) == self.n_mons
+        self.monmap = list(monmap)
+        await self.paxos.start_election()
+
+    async def wait_stable(self, timeout: float = 10.0) -> None:
+        await asyncio.wait_for(self.paxos.stable.wait(), timeout)
+
     async def stop(self) -> None:
         if self._tick_task:
             self._tick_task.cancel()
         await self.messenger.shutdown()
+
+    # -- quorum plumbing ----------------------------------------------
+
+    async def _send_mon(self, rank: int, msg: Message) -> None:
+        if rank < len(self.monmap):
+            conn = await self.messenger.connect_to(
+                ("mon", rank), *self.monmap[rank]
+            )
+        else:
+            # a peer reached us before our own open_quorum(): reply over
+            # the connection it already established
+            conn = self.messenger.get_connection(("mon", rank))
+            if conn is None:
+                raise ConnectionError(f"mon.{rank} address unknown")
+        await conn.send_message(msg)
+
+    async def _on_reset(self, conn) -> None:
+        peer = conn.peer
+        if (
+            peer is not None
+            and peer[0] == "mon"
+            and self.paxos.leader == peer[1]
+            and self.n_mons > 1
+        ):
+            log.info("mon.%d: leader mon.%d lost; electing", self.rank, peer[1])
+            await self.paxos.start_election()
+
+    async def _apply_committed(self, version: int, value: bytes) -> None:
+        import json
+
+        op = json.loads(value.decode())
+        await self._apply_op(op)
+
+    async def _propose(self, op: dict) -> None:
+        """Replicate one state mutation through Paxos (leader only;
+        single-mon quorums commit immediately)."""
+        import json
+
+        await self.paxos.propose(json.dumps(op).encode())
+
+    @property
+    def is_leader(self) -> bool:
+        return self.paxos.is_leader
 
     # -- map publication ----------------------------------------------
 
@@ -110,10 +179,19 @@ class Monitor:
     # -- dispatch ------------------------------------------------------
 
     async def _dispatch(self, msg: Message) -> None:
-        if isinstance(msg, MOSDBoot):
+        from ceph_tpu.mon.paxos import MMonElection, MMonPaxos
+
+        if isinstance(msg, MMonElection):
+            await self.paxos.handle_election(msg, msg.src[1])
+        elif isinstance(msg, MMonPaxos):
+            await self.paxos.handle_paxos(msg, msg.src[1])
+        elif isinstance(msg, MOSDBoot):
             await self._handle_boot(msg)
         elif isinstance(msg, MOSDBeacon):
-            self._last_beacon[msg.osd] = time.monotonic()
+            if self.is_leader:
+                self._last_beacon[msg.osd] = time.monotonic()
+            else:
+                await self._forward_to_leader(msg)
         elif isinstance(msg, MOSDFailure):
             await self._handle_failure(msg)
         elif isinstance(msg, MMonSubscribe):
@@ -133,45 +211,100 @@ class Monitor:
                 MMonCommandAck(tid=msg.tid, code=code, rs=rs, data=data)
             )
 
+    async def _forward_to_leader(self, msg: Message) -> None:
+        """Peons forward state-changing daemon messages to the leader
+        (the reference's Monitor::forward_request_leader)."""
+        leader = self.paxos.leader
+        if leader is None or leader == self.rank or not self.monmap:
+            return
+        try:
+            await self._send_mon(leader, msg)
+        except (ConnectionError, OSError):
+            pass
+
     async def _handle_boot(self, m: MOSDBoot) -> None:
-        om = self.osdmap
-        om.new_osd(m.osd, weight=m.weight, up=True)
-        om.osd_addrs[m.osd] = (m.host, m.port)
+        if not self.is_leader:
+            await self._forward_to_leader(m)
+            return
+        log.info("mon: osd.%d booted at %s:%d", m.osd, m.host, m.port)
         self._last_beacon[m.osd] = time.monotonic()
         self._down_at.pop(m.osd, None)
-        log.info("mon: osd.%d booted at %s:%d", m.osd, m.host, m.port)
-        await self._new_epoch()
+        await self._propose({
+            "op": "boot", "osd": m.osd, "host": m.host, "port": m.port,
+            "weight": m.weight,
+        })
 
     async def _handle_failure(self, m: MOSDFailure) -> None:
+        if not self.is_leader:
+            await self._forward_to_leader(m)
+            return
         om = self.osdmap
         if 0 <= m.failed < om.max_osd and om.is_up(m.failed):
             log.info(
                 "mon: osd.%d reported failed by osd.%d", m.failed, m.reporter
             )
-            om.mark_down(m.failed)
             self._down_at[m.failed] = time.monotonic()
-            await self._new_epoch()
+            await self._propose({"op": "down", "osd": m.failed})
+
+    # -- the replicated state machine ----------------------------------
+
+    async def _apply_op(self, op: dict) -> None:
+        """Apply one committed mutation deterministically — runs on
+        every quorum member in paxos order."""
+        kind = op["op"]
+        om = self.osdmap
+        if kind == "boot":
+            om.new_osd(op["osd"], weight=op["weight"], up=True)
+            om.osd_addrs[op["osd"]] = (op["host"], op["port"])
+        elif kind == "down":
+            if not (0 <= op["osd"] < om.max_osd) or not om.is_up(op["osd"]):
+                return  # no-op: no epoch bump
+            om.mark_down(op["osd"])
+        elif kind == "out":
+            if not (0 <= op["osd"] < om.max_osd) or om.is_out(op["osd"]):
+                return
+            om.mark_out(op["osd"])
+        elif kind == "profile":
+            om.erasure_code_profiles[op["name"]] = dict(op["profile"])
+        elif kind == "pool_create":
+            self._apply_pool_create(op)
+        else:
+            log.error("mon.%d: unknown committed op %r", self.rank, kind)
+            return
+        await self._new_epoch()
 
     async def _tick(self) -> None:
+        was_leader = False
         while True:
             await asyncio.sleep(self.beacon_grace / 4)
+            if not self.is_leader:
+                was_leader = False
+                continue
             now = time.monotonic()
-            changed = False
             om = self.osdmap
-            for osd, last in list(self._last_beacon.items()):
-                if om.is_up(osd) and now - last > self.beacon_grace:
-                    log.info("mon: osd.%d beacon timeout -> down", osd)
-                    om.mark_down(osd)
-                    self._down_at[osd] = now
-                    changed = True
-            if self.out_interval > 0:
-                for osd, when in list(self._down_at.items()):
-                    if not om.is_out(osd) and now - when > self.out_interval:
-                        log.info("mon: osd.%d down too long -> out", osd)
-                        om.mark_out(osd)
-                        changed = True
-            if changed:
-                await self._new_epoch()
+            if not was_leader:
+                # fresh leadership: beacons were landing on the old
+                # leader, so give every up OSD one full grace period to
+                # re-home before judging it (the reference's equivalent
+                # is last_beacon reset on win_election)
+                was_leader = True
+                for osd in range(om.max_osd):
+                    if om.is_up(osd):
+                        self._last_beacon[osd] = now
+                continue
+            try:
+                for osd, last in list(self._last_beacon.items()):
+                    if om.is_up(osd) and now - last > self.beacon_grace:
+                        log.info("mon: osd.%d beacon timeout -> down", osd)
+                        self._down_at[osd] = now
+                        await self._propose({"op": "down", "osd": osd})
+                if self.out_interval > 0:
+                    for osd, when in list(self._down_at.items()):
+                        if not om.is_out(osd) and now - when > self.out_interval:
+                            log.info("mon: osd.%d down too long -> out", osd)
+                            await self._propose({"op": "out", "osd": osd})
+            except ConnectionError:
+                continue  # lost quorum mid-sweep; retry next tick
 
     # -- commands (the MonCommands.h slice) ----------------------------
 
@@ -180,6 +313,13 @@ class Monitor:
         import json
 
         prefix = cmd.get("prefix", "")
+        mutating = prefix in (
+            "osd erasure-code-profile set", "osd pool create",
+            "osd down", "osd out",
+        )
+        if mutating and not self.is_leader:
+            leader = self.paxos.leader if self.paxos.leader is not None else -1
+            return -errno.EAGAIN, f"ENOTLEADER {leader}", b""
         try:
             if prefix == "osd erasure-code-profile set":
                 name = cmd["name"]
@@ -189,22 +329,21 @@ class Monitor:
                 profile.setdefault("plugin", "jax")
                 # instantiate once to validate + fill defaults
                 ec_registry.factory(profile["plugin"], profile)
-                self.osdmap.erasure_code_profiles[name] = profile
-                await self._new_epoch()
+                await self._propose({
+                    "op": "profile", "name": name, "profile": profile,
+                })
                 return 0, f"profile {name} set", b""
             if prefix == "osd pool create":
                 return await self._pool_create(cmd)
             if prefix == "osd down":
                 osd = int(cmd["id"])
                 if self.osdmap.is_up(osd):
-                    self.osdmap.mark_down(osd)
-                    await self._new_epoch()
+                    await self._propose({"op": "down", "osd": osd})
                 return 0, f"osd.{osd} down", b""
             if prefix == "osd out":
                 osd = int(cmd["id"])
                 if not self.osdmap.is_out(osd):
-                    self.osdmap.mark_out(osd)
-                    await self._new_epoch()
+                    await self._propose({"op": "out", "osd": osd})
                 return 0, f"osd.{osd} out", b""
             if prefix in ("pg scrub", "pg deep-scrub"):
                 return await self._scrub(cmd, deep=prefix == "pg deep-scrub")
@@ -247,9 +386,12 @@ class Monitor:
         _, _, _, primary = om.pg_to_up_acting_osds(pg_t(pool_id, ps), folded=True)
         if primary < 0:
             return -errno.EAGAIN, f"pg {cmd['pgid']} has no primary", b""
+        addr = om.osd_addrs.get(primary)
         conn = self._subscribers.get(("osd", primary))
+        if conn is None and addr is not None:
+            conn = await self.messenger.connect_to(("osd", primary), *addr)
         if conn is None:
-            return -errno.EAGAIN, f"primary osd.{primary} not connected", b""
+            return -errno.EAGAIN, f"primary osd.{primary} unreachable", b""
         tid = next(self._tids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._scrub_waiters[tid] = fut
@@ -257,15 +399,20 @@ class Monitor:
             await conn.send_message(
                 MOSDScrub(tid=tid, pool=pool_id, ps=ps, deep=deep)
             )
-            reply: MOSDScrubReply = await asyncio.wait_for(fut, 60)
+            # shorter than the client command timeout (30s): a slow
+            # scrub returns an error here instead of the client
+            # resending and stacking duplicate scrubs
+            reply: MOSDScrubReply = await asyncio.wait_for(fut, 25)
+        except asyncio.TimeoutError:
+            return -errno.ETIMEDOUT, "scrub did not finish in 25s", b""
         finally:
             self._scrub_waiters.pop(tid, None)
         return reply.result, "", reply.report
 
     async def _pool_create(self, cmd: dict[str, str]) -> tuple[int, str, bytes]:
-        """OSDMonitor::prepare_new_pool (OSDMonitor.cc:7339): erasure
-        pools pull their profile, build the plugin, create the CRUSH
-        rule through it, and size the pool k+m."""
+        """OSDMonitor::prepare_new_pool (OSDMonitor.cc:7339): leader
+        validates, then the creation replicates through paxos and
+        applies deterministically on every member."""
         import errno
         import json
 
@@ -273,17 +420,43 @@ class Monitor:
         if name in self._pool_ids:
             pid = self._pool_ids[name]
             return 0, f"pool {name!r} already exists", json.dumps({"pool_id": pid}).encode()
-        pg_num = int(cmd.get("pg_num", "8"))
         pool_type = cmd.get("pool_type", "replicated")
         om = self.osdmap
-        pid = self._next_pool
         if pool_type == "erasure":
             profile_name = cmd.get("erasure_code_profile", "default")
             profile = om.erasure_code_profiles.get(profile_name)
             if profile is None:
                 return -errno.ENOENT, f"no profile {profile_name!r}", b""
+            ec_registry.factory(profile["plugin"], dict(profile))  # validate
+        elif om.crush.bucket_names.get("default") is None and (
+            cmd.get("rule", "replicated_rule") not in om.crush.rule_names
+        ):
+            return -errno.ENOENT, "no default crush root", b""
+        await self._propose({
+            "op": "pool_create", "name": name,
+            "pg_num": int(cmd.get("pg_num", "8")),
+            "pool_type": pool_type,
+            "size": int(cmd.get("size", "3")),
+            "rule": cmd.get("rule", ""),
+            "erasure_code_profile": cmd.get("erasure_code_profile", "default"),
+        })
+        pid = self._pool_ids[name]
+        return 0, f"pool {name!r} created", json.dumps({"pool_id": pid}).encode()
+
+    def _apply_pool_create(self, op: dict) -> None:
+        """Deterministic half of pool creation (same inputs + same map
+        state -> same pool id, rule id and crush mutation on every
+        quorum member)."""
+        name = op["name"]
+        if name in self._pool_ids:
+            return
+        om = self.osdmap
+        pid = self._next_pool
+        if op["pool_type"] == "erasure":
+            profile_name = op["erasure_code_profile"]
+            profile = om.erasure_code_profiles[profile_name]
             ec = ec_registry.factory(profile["plugin"], dict(profile))
-            rule_name = cmd.get("rule", name)
+            rule_name = op["rule"] or name
             if rule_name in om.crush.rule_names:
                 rule = om.crush.rule_names[rule_name]
             else:
@@ -292,20 +465,17 @@ class Monitor:
             m = ec.get_coding_chunk_count()
             pool = PgPool(
                 id=pid, type=PoolType.ERASURE, size=k + m, min_size=k,
-                crush_rule=rule, pg_num=pg_num, pgp_num=pg_num,
+                crush_rule=rule, pg_num=op["pg_num"], pgp_num=op["pg_num"],
                 erasure_code_profile=profile_name,
             )
         else:
-            size = int(cmd.get("size", "3"))
-            rule_name = cmd.get("rule", "replicated_rule")
+            rule_name = op["rule"] or "replicated_rule"
             if rule_name in om.crush.rule_names:
                 rule = om.crush.rule_names[rule_name]
             else:
                 from ceph_tpu.crush import builder
 
-                root = om.crush.bucket_names.get("default")
-                if root is None:
-                    return -errno.ENOENT, "no default crush root", b""
+                root = om.crush.bucket_names["default"]
                 try:
                     fd = om.crush.type_id("host")
                 except KeyError:
@@ -313,13 +483,11 @@ class Monitor:
                 rule = builder.add_simple_rule(om.crush, root, fd, mode="firstn")
                 om.crush.rule_names[rule_name] = rule
             pool = PgPool(
-                id=pid, type=PoolType.REPLICATED, size=size,
-                min_size=max(1, size - 1), crush_rule=rule,
-                pg_num=pg_num, pgp_num=pg_num,
+                id=pid, type=PoolType.REPLICATED, size=op["size"],
+                min_size=max(1, op["size"] - 1), crush_rule=rule,
+                pg_num=op["pg_num"], pgp_num=op["pg_num"],
             )
         om.pools[pid] = pool
         om.pool_names[pid] = name
         self._pool_ids[name] = pid
         self._next_pool += 1
-        await self._new_epoch()
-        return 0, f"pool {name!r} created", json.dumps({"pool_id": pid}).encode()

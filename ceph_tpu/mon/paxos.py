@@ -1,0 +1,337 @@
+"""Paxos + elections for the monitor quorum.
+
+Behavioral twin of the reference's monitor consensus core
+(src/mon/Paxos.h:174, src/mon/Elector.h / ElectionLogic): a rank-based
+election picks the leader (lowest rank reachable by a majority; odd
+election epochs while electing, even once stable — the reference's
+epoch parity convention), and the leader drives a single Paxos
+sequence of numbered values over the quorum:
+
+    collect(pn)  -> peons reply last(pn, last_committed [, uncommitted])
+    begin(pn, v, value) -> peons persist + accept
+    commit(v)    -> everyone applies value v
+
+Values are opaque blobs; the monitor replicates its *state-mutating
+commands* (osd boot/failure/out, pool create, profile set) and applies
+them deterministically on every member — state-machine replication,
+where the reference replicates encoded kv transactions of its store
+(same capability, simpler value encoding).  The leader re-shares
+missing commits during collect, which is how a rebooted/partitioned
+peon catches up (Paxos::share_state).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable
+
+from ceph_tpu.msg.denc import Decoder, Encoder
+from ceph_tpu.msg.messenger import Message
+
+log = logging.getLogger("ceph_tpu.mon.paxos")
+
+# election ops (MMonElection)
+PROPOSE, ACK, VICTORY = 1, 2, 3
+# paxos ops (MMonPaxos); FETCH = straggler catch-up request
+COLLECT, LAST, BEGIN, ACCEPT, COMMIT, FETCH = 1, 2, 3, 4, 5, 6
+
+
+class MMonElection(Message):
+    TYPE = 65
+
+    def __init__(self, op: int = 0, epoch: int = 0, rank: int = 0):
+        self.op, self.epoch, self.rank = op, epoch, rank
+
+    def encode_payload(self, enc: Encoder):
+        enc.u8(self.op)
+        enc.u32(self.epoch)
+        enc.i32(self.rank)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder):
+        return cls(dec.u8(), dec.u32(), dec.i32())
+
+
+class MMonPaxos(Message):
+    TYPE = 66
+
+    def __init__(
+        self, op: int = 0, pn: int = 0, version: int = 0,
+        value: bytes = b"", last_committed: int = 0,
+    ):
+        self.op, self.pn, self.version = op, pn, version
+        self.value, self.last_committed = value, last_committed
+
+    def encode_payload(self, enc: Encoder):
+        enc.u8(self.op)
+        enc.u64(self.pn)
+        enc.u64(self.version)
+        enc.bytes_(self.value)
+        enc.u64(self.last_committed)
+
+    @classmethod
+    def decode_payload(cls, dec: Decoder):
+        return cls(dec.u8(), dec.u64(), dec.u64(), dec.bytes_(), dec.u64())
+
+
+class Paxos:
+    """One monitor's consensus state.
+
+    ``send(rank, msg)`` delivers to a peer monitor; ``on_commit(v,
+    value)`` applies a committed value to the monitor's state machine.
+    The host monitor wires both.
+    """
+
+    def __init__(
+        self,
+        rank: int,
+        n_ranks: int,
+        send: Callable[[int, Message], Awaitable[None]],
+        on_commit: Callable[[int, bytes], Awaitable[None]],
+    ):
+        self.rank = rank
+        self.n_ranks = n_ranks
+        self._send = send
+        self._on_commit = on_commit
+        # election state
+        self.election_epoch = 1  # odd = electing
+        self.leader: int | None = None
+        self.quorum: set[int] = set()
+        self._election_acks: set[int] = set()
+        self._electing = False  # our own candidacy is live
+        # paxos state
+        self.last_pn = 0
+        self.accepted_pn = 0
+        self.last_committed = 0
+        self.values: dict[int, bytes] = {}     # committed log
+        self._uncommitted: tuple[int, bytes] | None = None
+        self._accepts: set[int] = set()
+        self._collect_replies: dict[int, MMonPaxos] = {}
+        self._propose_lock = asyncio.Lock()
+        self._phase_done: asyncio.Event | None = None
+        self.stable = asyncio.Event()
+        if n_ranks == 1:
+            self._become_leader({rank})
+
+    # -- election (ElectionLogic, rank-based) --------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self.leader == self.rank and self.stable.is_set()
+
+    def majority(self) -> int:
+        return self.n_ranks // 2 + 1
+
+    async def start_election(self) -> None:
+        self.stable.clear()
+        self.leader = None
+        self._electing = True
+        if self.election_epoch % 2 == 0:
+            self.election_epoch += 1
+        else:
+            self.election_epoch += 2
+        self._election_acks = {self.rank}
+        log.info("mon.%d: starting election e%d", self.rank, self.election_epoch)
+        for r in range(self.n_ranks):
+            if r != self.rank:
+                await self._maybe_send(r, MMonElection(
+                    PROPOSE, self.election_epoch, self.rank
+                ))
+        await self._check_victory()
+
+    async def _maybe_send(self, rank: int, msg: Message) -> None:
+        try:
+            await self._send(rank, msg)
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            pass  # unreachable peers simply don't vote
+
+    async def _check_victory(self) -> None:
+        if not self._electing:
+            return
+        if len(self._election_acks) >= self.majority() and not self.stable.is_set():
+            self._electing = False
+            quorum = set(self._election_acks)
+            self.election_epoch += 1  # even: stable
+            self._become_leader(quorum)
+            log.info(
+                "mon.%d: won election e%d quorum %s",
+                self.rank, self.election_epoch, sorted(quorum),
+            )
+            # VICTORY to everyone: members outside the voting quorum
+            # still follow the leader and catch up on commits
+            for r in range(self.n_ranks):
+                if r != self.rank:
+                    await self._maybe_send(r, MMonElection(
+                        VICTORY, self.election_epoch, self.rank
+                    ))
+            await self._leader_collect()
+
+    def _become_leader(self, quorum: set[int]) -> None:
+        self.leader = self.rank
+        self.quorum = quorum
+        self.stable.set()
+
+    async def handle_election(self, msg: MMonElection, from_rank: int) -> None:
+        if msg.op == PROPOSE:
+            if self.rank < msg.rank:
+                # I outrank the proposer: (re)launch my own candidacy
+                # at an epoch everyone will honor.  The proposer will
+                # defer when my PROPOSE reaches it.
+                if (
+                    self.stable.is_set()
+                    or not self._electing
+                    or msg.epoch > self.election_epoch
+                ):
+                    self.election_epoch = max(self.election_epoch, msg.epoch)
+                    await self.start_election()
+            else:
+                # defer to the lower rank: cancel any candidacy of ours
+                self.stable.clear()
+                self.leader = None
+                self._electing = False
+                self.election_epoch = max(self.election_epoch, msg.epoch)
+                await self._maybe_send(from_rank, MMonElection(
+                    ACK, msg.epoch, self.rank
+                ))
+        elif msg.op == ACK:
+            if self._electing and msg.epoch == self.election_epoch:
+                self._election_acks.add(from_rank)
+                await self._check_victory()
+        elif msg.op == VICTORY:
+            if from_rank > self.rank and self._electing:
+                # a higher rank won a race our candidacy should win:
+                # keep contesting (the reference's lowest-rank
+                # guarantee; the new leader will defer on our PROPOSE)
+                self.election_epoch = max(self.election_epoch, msg.epoch)
+                await self.start_election()
+                return
+            if msg.epoch < self.election_epoch:
+                return  # stale victory
+            self.election_epoch = msg.epoch
+            self.leader = from_rank
+            self._electing = False
+            self.quorum = set()  # peons don't track the full quorum
+            self.stable.set()
+            log.info("mon.%d: leader is mon.%d (e%d)", self.rank, from_rank, msg.epoch)
+
+    # -- paxos phases --------------------------------------------------
+
+    async def _leader_collect(self) -> None:
+        """Phase 1 after winning: learn the quorum's state, re-share
+        missing commits, recover any uncommitted value."""
+        if self.n_ranks == 1:
+            return
+        self.last_pn += 100 + self.rank + 1
+        pn = self.last_pn
+        self.accepted_pn = pn
+        self._collect_replies = {}
+        for r in self.quorum:
+            if r != self.rank:
+                await self._maybe_send(r, MMonPaxos(
+                    COLLECT, pn, 0, b"", self.last_committed
+                ))
+
+    async def _finish_collect(self) -> None:
+        # catch up anyone behind; adopt any newer uncommitted value
+        for r, rep in self._collect_replies.items():
+            if rep.last_committed > self.last_committed:
+                # we are behind the quorum?! should not happen for an
+                # elected leader with majority intersection, but be safe
+                log.warning("mon.%d: peer %d ahead in collect", self.rank, r)
+            for v in range(rep.last_committed + 1, self.last_committed + 1):
+                if v in self.values:
+                    await self._maybe_send(r, MMonPaxos(
+                        COMMIT, self.accepted_pn, v, self.values[v],
+                        self.last_committed,
+                    ))
+            if rep.version > self.last_committed and rep.value:
+                # recover an uncommitted value from a previous leader
+                await self.propose(rep.value)
+
+    async def propose(self, value: bytes) -> int:
+        """Leader-only: replicate one value; returns its version once
+        committed (majority accepted)."""
+        async with self._propose_lock:
+            if not self.is_leader:
+                raise ConnectionError("not leader")
+            version = self.last_committed + 1
+            if self.n_ranks == 1:
+                await self._commit_local(version, value)
+                return version
+            pn = self.accepted_pn
+            self._accepts = {self.rank}
+            self._phase_done = asyncio.Event()
+            self._uncommitted = (version, value)
+            for r in self.quorum:
+                if r != self.rank:
+                    await self._maybe_send(r, MMonPaxos(
+                        BEGIN, pn, version, value, self.last_committed
+                    ))
+            try:
+                await asyncio.wait_for(self._phase_done.wait(), 10)
+            except asyncio.TimeoutError:
+                raise ConnectionError("paxos begin timed out (lost quorum?)")
+            # commit: broadcast to every rank (stragglers outside the
+            # voting quorum stay consistent; gaps trigger FETCH)
+            await self._commit_local(version, value)
+            for r in range(self.n_ranks):
+                if r != self.rank:
+                    await self._maybe_send(r, MMonPaxos(
+                        COMMIT, pn, version, value, self.last_committed
+                    ))
+            return version
+
+    async def _commit_local(self, version: int, value: bytes) -> None:
+        if version <= self.last_committed:
+            return
+        self.values[version] = value
+        self.last_committed = version
+        self._uncommitted = None
+        await self._on_commit(version, value)
+
+    async def handle_paxos(self, msg: MMonPaxos, from_rank: int) -> None:
+        if msg.op == COLLECT:
+            if msg.pn >= self.accepted_pn:
+                self.accepted_pn = msg.pn
+                un_v, un_val = self._uncommitted or (0, b"")
+                await self._maybe_send(from_rank, MMonPaxos(
+                    LAST, msg.pn, un_v, un_val, self.last_committed
+                ))
+        elif msg.op == LAST:
+            if msg.pn == self.accepted_pn and self.is_leader:
+                self._collect_replies[from_rank] = msg
+                if len(self._collect_replies) >= len(self.quorum) - 1:
+                    await self._finish_collect()
+        elif msg.op == BEGIN:
+            if msg.pn >= self.accepted_pn:
+                self.accepted_pn = msg.pn
+                self._uncommitted = (msg.version, msg.value)
+                await self._maybe_send(from_rank, MMonPaxos(
+                    ACCEPT, msg.pn, msg.version, b"", self.last_committed
+                ))
+        elif msg.op == ACCEPT:
+            if self.is_leader and msg.pn == self.accepted_pn and self._phase_done:
+                self._accepts.add(from_rank)
+                if len(self._accepts) >= self.majority():
+                    self._phase_done.set()
+        elif msg.op == COMMIT:
+            # peons may receive commits out of step during catch-up;
+            # apply in order only, fetch the gap from the leader
+            if msg.version == self.last_committed + 1:
+                await self._commit_local(msg.version, msg.value)
+            elif msg.version > self.last_committed + 1:
+                log.info(
+                    "mon.%d: commit gap (have %d, got %d); fetching",
+                    self.rank, self.last_committed, msg.version,
+                )
+                await self._maybe_send(from_rank, MMonPaxos(
+                    FETCH, msg.pn, 0, b"", self.last_committed
+                ))
+        elif msg.op == FETCH:
+            for v in range(msg.last_committed + 1, self.last_committed + 1):
+                if v in self.values:
+                    await self._maybe_send(from_rank, MMonPaxos(
+                        COMMIT, self.accepted_pn, v, self.values[v],
+                        self.last_committed,
+                    ))

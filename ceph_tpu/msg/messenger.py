@@ -102,6 +102,12 @@ class Connection:
     async def send_message(self, msg: Message) -> None:
         if self._closed:
             raise ConnectionError("connection closed")
+        n = self.messenger.inject_socket_failures
+        if n > 0:
+            self.messenger._inject_counter += 1
+            if self.messenger._inject_counter % n == 0:
+                await self.close(notify=True)
+                raise ConnectionError("injected socket failure")
         async with self._send_lock:
             self._seq += 1
             segs = encode_message(msg, self.messenger.entity, self._seq)
@@ -140,9 +146,12 @@ class Connection:
             self.writer.close()
         except Exception:
             pass
-        task = self._reader_task
-        if task is not None and task is not asyncio.current_task():
-            task.cancel()
+        try:
+            task = self._reader_task
+            if task is not None and task is not asyncio.current_task():
+                task.cancel()
+        except RuntimeError:
+            return  # event loop already torn down
         if notify:
             await self.messenger._handle_reset(self)
 
@@ -161,9 +170,19 @@ class Messenger:
         self.on_reset = on_reset
         self._server: asyncio.base_events.Server | None = None
         self._conns: dict[tuple[str, int], Connection] = {}  # by entity
-        self._accepted: set[Connection] = set()
+        # every live connection needs a strong root: asyncio's
+        # StreamReaderProtocol only holds the reader WEAKLY (py3.8+), so
+        # an un-referenced Connection/reader-task cycle would be
+        # garbage-collected mid-session, silently closing the socket —
+        # which the peer misreads as a daemon failure
+        self._live: set[Connection] = set()
         self._connect_locks: dict[tuple[str, int], asyncio.Lock] = {}
         self.addr: tuple[str, int] | None = None
+        # fault injection (reference ms_inject_socket_failures,
+        # src/common/options/global.yaml.in:1242): every Nth outgoing
+        # message tears the connection down instead of sending
+        self.inject_socket_failures = 0
+        self._inject_counter = 0
 
     async def _dispatch(self, msg: Message) -> None:
         if self.dispatcher is not None:
@@ -174,7 +193,7 @@ class Messenger:
             await self.on_reset(conn)
 
     def _forget(self, conn: Connection) -> None:
-        self._accepted.discard(conn)
+        self._live.discard(conn)
         if conn.peer is not None and self._conns.get(conn.peer) is conn:
             del self._conns[conn.peer]
 
@@ -207,18 +226,22 @@ class Messenger:
             writer.close()
             return
         await self._register(conn)
-        self._accepted.add(conn)
+        self._live.add(conn)
         conn._reader_task = asyncio.ensure_future(conn._run())
 
     async def _register(self, conn: Connection) -> None:
-        """Latest connection wins per peer; a displaced predecessor is
-        closed so its socket and reader task don't leak (the reference
-        resolves the same race with connect-sequence numbers,
-        ProtocolV2 reconnect)."""
-        displaced = self._conns.get(conn.peer)
+        """Latest connection wins per peer for OUTBOUND routing, but the
+        displaced one is NEVER closed here.
+
+        Closing it would tear down a session whose in-flight sub-ops the
+        far side misreads as a daemon failure (false MOSDFailure) — so
+        cross-dials (A dials B while B dials A) simply leave both
+        sockets open, replies always travel on the connection the
+        request arrived on, and a displaced predecessor drains until its
+        own EOF.  Routing to the NEWEST connection matters when a peer
+        restarts and re-dials: the old socket may look healthy locally
+        for minutes while every send into it would stall."""
         self._conns[conn.peer] = conn
-        if displaced is not None and displaced is not conn:
-            await displaced.close()
 
     # -- client side ---------------------------------------------------
 
@@ -260,6 +283,7 @@ class Messenger:
         dec = Decoder(segs[0])
         conn.peer = (dec.str_(), dec.i64())
         await self._register(conn)
+        self._live.add(conn)
         conn._reader_task = asyncio.ensure_future(conn._run())
         return conn
 
@@ -271,10 +295,10 @@ class Messenger:
             self._server.close()
         # close connections FIRST: in py3.12 Server.wait_closed() also
         # waits for accepted transports, which our reader tasks hold open
-        for conn in list(self._conns.values()) + list(self._accepted):
+        for conn in list(self._conns.values()) + list(self._live):
             await conn.close()
         self._conns.clear()
-        self._accepted.clear()
+        self._live.clear()
         await asyncio.sleep(0)  # let cancelled reader tasks unwind
         if self._server is not None:
             try:

@@ -16,12 +16,19 @@ chunk offsets, per-shard HashInfo crc chains in the ``hinfo`` xattr
 (ECUtil.cc:164-248) and the logical size in ``_size`` (the object_info
 analogue).
 
-Differences from the reference, deliberate for this slice: peering is
-implicit (the map is the authority; the primary probes acting members
-instead of exchanging pg_info), there is no PG log yet (recovery is
-backfill-style full-object reconstruction), and a brand-new primary
-with no local data asks the first data-holding acting member for the
-object list instead of running the peering state machine.
+Consistency is log-based (ceph_tpu/osd/pglog.py): every write commits
+a pg-log entry with the data; after a map change the primary runs
+peering-lite (_recover_pg): pg_info exchange, log adoption from
+newer members, per-peer missing sets from the log delta, and full
+backfill with authoritative-list stray removal when trimmed past a
+peer.  Reads verify object versions across chunks so revived members
+with stale shards cannot corrupt results.
+
+Deliberate simplifications vs the reference: the peering state machine
+is a linear pass rather than boost::statechart, there is no
+ObjectContext rw-locking (recovery races resolve by version guards and
+the next pass), and sub-chunk (CLAY) recovery I/O goes through full
+chunk reads.
 """
 
 from __future__ import annotations
@@ -51,18 +58,16 @@ from ceph_tpu.msg.messages import (
     MOSDPGPushReply,
     MOSDRepOp,
     MOSDRepOpReply,
-    OP_DELETE,
-    OP_READ,
-    OP_STAT,
-    OP_WRITE_FULL,
-)
-from ceph_tpu.msg.messages import (
     MOSDPGInfo,
     MOSDPGLog,
     MOSDPGLogAck,
     MOSDPGQuery,
     MOSDScrub,
     MOSDScrubReply,
+    OP_DELETE,
+    OP_READ,
+    OP_STAT,
+    OP_WRITE_FULL,
 )
 from ceph_tpu.msg.messenger import Connection, Message, Messenger
 from ceph_tpu.ops.hashing import ceph_str_hash_rjenkins
@@ -86,7 +91,7 @@ log = logging.getLogger("ceph_tpu.osd")
 NO_SHARD = -1
 STRIPE_UNIT = 4096  # logical bytes per data chunk per stripe
 SUBOP_TIMEOUT = 30.0
-PG_LOG_KEEP = 128  # osd_min_pg_log_entries analogue
+PG_LOG_KEEP = 128  # osd_min_pg_log_entries default (see common.config)
 
 SIZE_ATTR = "_size"
 HINFO_ATTR = "hinfo"
@@ -116,16 +121,31 @@ class OSDDaemon:
         osd_id: int,
         mon_addr: tuple[str, int],
         store: MemStore | None = None,
-        beacon_interval: float = 0.0,
+        beacon_interval: float | None = None,
+        conf=None,
     ):
+        from ceph_tpu.common import ConfigProxy, get_perf_counters
+
         self.id = osd_id
-        self.mon_addr = mon_addr
+        # one address or a monmap; the daemon hunts for a live monitor
+        self.mon_addrs: list[tuple[str, int]] = (
+            list(mon_addr) if isinstance(mon_addr, list) else [mon_addr]
+        )
+        self.mon_addr = self.mon_addrs[0]
+        self.conf = conf if conf is not None else ConfigProxy()
         self.store = store or MemStore()
         self.messenger = Messenger(
             ("osd", osd_id), self._dispatch, on_reset=self._on_reset
         )
+        self.messenger.inject_socket_failures = self.conf[
+            "ms_inject_socket_failures"
+        ]
+        self.perf = get_perf_counters(f"osd.{osd_id}")
+        self._log_keep = self.conf["osd_min_pg_log_entries"]
         self.osdmap: OSDMap | None = None
-        self.beacon_interval = beacon_interval
+        self.beacon_interval = (
+            beacon_interval if beacon_interval is not None else 0.0
+        )
         self.addr: tuple[str, int] | None = None
         self._mon_conn: Connection | None = None
         self._tids = itertools.count(1)
@@ -142,21 +162,35 @@ class OSDDaemon:
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> None:
         self.addr = await self.messenger.bind(host, port)
-        self._mon_conn = await self.messenger.connect_to(
-            ("mon", 0), *self.mon_addr
-        )
-        await self._mon_conn.send_message(
-            MOSDBoot(osd=self.id, host=self.addr[0], port=self.addr[1])
-        )
-        await self._mon_conn.send_message(MMonSubscribe())
+        await self._mon_hunt()
         if self.beacon_interval > 0:
             self._beacon_task = asyncio.ensure_future(self._beacon())
         # wait for the first map so ops can be served
         await asyncio.wait_for(self._map_event.wait(), 10)
 
+    async def _mon_hunt(self) -> None:
+        """Find a live monitor, (re)boot and (re)subscribe — the
+        MonClient hunting behavior on monitor loss."""
+        last: Exception | None = None
+        for mhost, mport in self.mon_addrs:
+            try:
+                conn = await self.messenger.connect(mhost, mport)
+                await conn.send_message(MOSDBoot(
+                    osd=self.id, host=self.addr[0], port=self.addr[1]
+                ))
+                await conn.send_message(MMonSubscribe())
+                self._mon_conn = conn
+                return
+            except (ConnectionError, OSError) as e:
+                last = e
+        raise ConnectionError(f"osd.{self.id}: no monitor reachable: {last}")
+
     async def stop(self) -> None:
         self.stopping = True
-        for t in (self._beacon_task, self._recovery_task):
+        for t in (
+            self._beacon_task, self._recovery_task,
+            getattr(self, "_rehome_task", None),
+        ):
             if t:
                 t.cancel()
         await self.messenger.shutdown()
@@ -169,7 +203,7 @@ class OSDDaemon:
                     MOSDBeacon(osd=self.id, epoch=self.epoch)
                 )
             except ConnectionError:
-                return
+                continue  # mon died; the rehome task is hunting
 
     @property
     def epoch(self) -> int:
@@ -183,6 +217,19 @@ class OSDDaemon:
         if self.stopping or conn.peer is None:
             return
         kind, peer_id = conn.peer
+        if kind == "mon" and conn is self._mon_conn:
+            async def _rehome():
+                for _ in range(20):
+                    await asyncio.sleep(0.2)
+                    if self.stopping:
+                        return
+                    try:
+                        await self._mon_hunt()
+                        return
+                    except (ConnectionError, OSError):
+                        continue
+            self._rehome_task = asyncio.ensure_future(_rehome())
+            return
         for tid, fut in list(self._waiters.items()):
             if getattr(fut, "peer", None) == conn.peer and not fut.done():
                 fut.set_exception(ConnectionError(f"peer {conn.peer} reset"))
@@ -301,7 +348,15 @@ class OSDDaemon:
 
     async def _handle_client_op(self, msg: MOSDOp) -> None:
         try:
+            self.perf.inc("op")
+            if msg.op in (OP_WRITE_FULL,):
+                self.perf.inc("op_w")
+                self.perf.inc("op_in_bytes", len(msg.data))
+            elif msg.op in (OP_READ, OP_STAT):
+                self.perf.inc("op_r")
             reply = await self._execute_op(msg)
+            if msg.op == OP_READ and reply.result == 0:
+                self.perf.inc("op_out_bytes", len(reply.data))
         except ECConnErrors as e:
             log.warning("osd.%d: op tid %d failed: %r", self.id, msg.tid, e)
             reply = MOSDOpReply(
@@ -423,7 +478,7 @@ class OSDDaemon:
                 lg.append(t, pg_log_entry_t(
                     DELETE if delete else MODIFY, oid, version, prior,
                 ))
-                lg.trim(t, PG_LOG_KEEP)
+                lg.trim(t, self._log_keep)
         self.store.queue_transaction(t)
 
     async def _ec_read(self, pool, pg, acting, msg, ec, sinfo) -> MOSDOpReply:
@@ -746,7 +801,7 @@ class OSDDaemon:
                 e = pg_log_entry_t.decode(raw)
                 if e.version > lg.info.last_update:
                     lg.append(t, e)
-            lg.trim(t, PG_LOG_KEEP)
+            lg.trim(t, self._log_keep)
             if not t.empty():
                 self.store.queue_transaction(t)
 
@@ -871,6 +926,7 @@ class OSDDaemon:
             "osd.%d: recovering %s/%s to %s on %s", self.id, pg, oid,
             vmax, targets,
         )
+        self.perf.inc("recovery_ops")
         src_attrs = next(
             a for (s, o), (p, v, a) in state.items() if p and v == vmax
         )
@@ -970,7 +1026,7 @@ class OSDDaemon:
             e = pg_log_entry_t.decode(raw)
             if e.version > lg.info.last_update:
                 lg.append(t, e)
-        lg.trim(t, PG_LOG_KEEP)
+        lg.trim(t, self._log_keep)
         if not t.empty():
             self.store.queue_transaction(t)
         await msg.conn.send_message(MOSDPGLogAck(

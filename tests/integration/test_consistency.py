@@ -234,13 +234,13 @@ class TestTrimmedLogBackfill:
     gapped, so recovery must backfill — repairing objects whose entries
     were trimmed and removing strays without resurrecting deletes."""
 
-    def test_backfill_past_trim_window(self, monkeypatch):
-        from ceph_tpu.osd import daemon as osd_daemon
+    def test_backfill_past_trim_window(self):
+        from ceph_tpu.common import ConfigProxy
 
-        monkeypatch.setattr(osd_daemon, "PG_LOG_KEEP", 4)
+        conf = {"osd_min_pg_log_entries": 4}
 
         async def go():
-            async with Cluster(n_osds=8) as c:
+            async with Cluster(n_osds=8, osd_conf=conf) as c:
                 await c.client.ec_profile_set(
                     "p", {"plugin": "jax", "k": "2", "m": "1"}
                 )
@@ -299,7 +299,9 @@ class TestTrimmedLogBackfill:
                 assert await io.read("kept") == b"\x03" * 6000
 
         async def self_revive(c, victim, store):
-            c.osds[victim] = OSDDaemon(victim, c.mon.addr, store=store)
+            c.osds[victim] = OSDDaemon(
+                victim, c.mon.addr, store=store, conf=ConfigProxy(conf)
+            )
             epoch = c.client.osdmap.epoch
             await c.osds[victim].start()
             await c.wait_epoch(epoch + 1)

@@ -37,7 +37,8 @@ def run(coro):
 
 
 class Cluster:
-    def __init__(self, n_osds: int = N_OSDS):
+    def __init__(self, n_osds: int = N_OSDS, osd_conf: dict | None = None):
+        self.osd_conf = osd_conf
         crush = CrushMap()
         # one osd per host: failure domain host == osd for small tests
         B.build_hierarchy(crush, osds_per_host=1, n_hosts=n_osds)
@@ -47,8 +48,11 @@ class Cluster:
 
     async def __aenter__(self):
         await self.mon.start()
+        from ceph_tpu.common import ConfigProxy
+
         for i in range(len(self.osds)):
-            self.osds[i] = OSDDaemon(i, self.mon.addr)
+            conf = ConfigProxy(self.osd_conf) if self.osd_conf else None
+            self.osds[i] = OSDDaemon(i, self.mon.addr, conf=conf)
             await self.osds[i].start()
         await self.client.connect(*self.mon.addr)
         return self
@@ -280,5 +284,25 @@ class TestReplicatedRecovery:
                         break
                     await asyncio.sleep(0.1)
                 assert store.read(cl, ghobject_t("robj")) == b"r" * 5000
+
+        run(go())
+
+
+class TestFaultInjection:
+    def test_ops_survive_injected_socket_failures(self):
+        """ms_inject_socket_failures-style chaos: every Nth outgoing
+        message tears the connection down; the resend machinery must
+        still complete every op (the thrash-suite contract)."""
+
+        async def go():
+            async with Cluster(
+                n_osds=6, osd_conf={"ms_inject_socket_failures": 60}
+            ) as c:
+                await c.client.pool_create("rbd", pg_num=4, size=2)
+                io = c.client.ioctx("rbd")
+                for i in range(12):
+                    await io.write_full(f"o{i}", bytes([i]) * 3000)
+                for i in range(12):
+                    assert await io.read(f"o{i}") == bytes([i]) * 3000
 
         run(go())

@@ -1,0 +1,119 @@
+"""Tool + tester + compiler tests (reference analogues: crushtool
+--test self-checks, osdmaptool --test-map-pgs, benchmark harness)."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from ceph_tpu.crush import builder as B
+from ceph_tpu.crush.compiler import compile_text, decompile
+from ceph_tpu.crush.mapper import crush_do_rule
+from ceph_tpu.crush.tester import CrushTester
+from ceph_tpu.crush.types import CrushMap
+
+TOOLS = "tools"
+
+
+def run_tool(script, *args):
+    return subprocess.run(
+        [sys.executable, f"{TOOLS}/{script}", *args],
+        capture_output=True, text=True, timeout=300, check=False,
+    )
+
+
+@pytest.fixture(scope="module")
+def simple_map():
+    m = CrushMap()
+    root = B.build_hierarchy(m, osds_per_host=2, n_hosts=8)
+    B.add_simple_rule(m, root.id, 1, mode="firstn", rule_id=0)
+    B.add_simple_rule(m, root.id, 1, mode="indep", rule_type=3, rule_id=1)
+    return m
+
+
+class TestCompiler:
+    def test_roundtrip_preserves_placement(self, simple_map):
+        text = decompile(simple_map)
+        m2 = compile_text(text)
+        for x in range(64):
+            assert crush_do_rule(m2, 0, x, 3) == crush_do_rule(
+                simple_map, 0, x, 3
+            )
+            assert crush_do_rule(m2, 1, x, 5) == crush_do_rule(
+                simple_map, 1, x, 5
+            )
+
+    def test_bad_reference_rejected(self):
+        with pytest.raises(ValueError):
+            compile_text(json.dumps({
+                "buckets": [{"id": -1, "type": 1, "items": [{"id": -9, "weight": 1}]}],
+            }))
+
+
+class TestCrushTester:
+    def test_statistics_shape(self, simple_map):
+        res = CrushTester(simple_map).test(0, 3, 0, 511)
+        stats = res.statistics()
+        assert stats["mappings"] == 512
+        assert stats["bad_mappings"] == 0
+        assert stats["devices_used"] == 16
+        # utilization spread should be sane for straw2
+        assert stats["min"] > 0.3 * stats["expected_per_device"]
+        assert stats["max"] < 2.5 * stats["expected_per_device"]
+
+    def test_bad_mappings_detected_when_starved(self, simple_map):
+        # ask for more replicas than hosts exist -> short mappings
+        res = CrushTester(simple_map).test(0, 9, 0, 63)
+        assert len(res.bad_mappings) == 64
+
+
+class TestCrushtoolCLI:
+    def test_build_test_cycle(self, tmp_path):
+        mapfn = tmp_path / "map.json"
+        r = run_tool("crushtool.py", "--build", "12", "-o", str(mapfn))
+        assert r.returncode == 0, r.stderr
+        r = run_tool(
+            "crushtool.py", "--test", "-i", str(mapfn), "--rule", "1",
+            "--num-rep", "4", "--max-x", "255", "--show-statistics",
+        )
+        assert r.returncode == 0, r.stderr
+        stats = json.loads(r.stdout)
+        assert stats["mappings"] == 256
+        assert stats["bad_mappings"] == 0
+
+
+class TestOsdmaptoolCLI:
+    def test_createsimple_and_test_map_pgs(self, tmp_path):
+        mapfn = tmp_path / "osdmap.bin"
+        r = run_tool(
+            "osdmaptool.py", "--createsimple", "10", "--pg-num", "64",
+            "-o", str(mapfn),
+        )
+        assert r.returncode == 0, r.stderr
+        r = run_tool("osdmaptool.py", str(mapfn), "--test-map-pgs", "--print")
+        assert r.returncode == 0, r.stderr
+        out = r.stdout
+        assert '"pg_count": 64' in out
+        assert '"osds_used": 10' in out
+
+
+class TestECBenchmarkCLI:
+    def test_encode_and_exhaustive_decode(self):
+        r = run_tool(
+            "ec_benchmark.py", "--plugin", "jax", "--workload", "encode",
+            "--size", "65536", "--iterations", "4",
+            "--parameter", "k=4", "--parameter", "m=2",
+        )
+        assert r.returncode == 0, r.stderr
+        secs, kib = r.stdout.split()
+        assert float(secs) > 0 and int(kib) == 4 * 64
+        r = run_tool(
+            "ec_benchmark.py", "--plugin", "jax", "--workload", "decode",
+            "--erasures", "2", "--erasures-generation", "exhaustive",
+            "--size", "16384", "--iterations", "15",
+            "--parameter", "k=4", "--parameter", "m=2",
+        )
+        assert r.returncode == 0, r.stderr + r.stdout

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""ceph_erasure_code_benchmark: the EC plugin timing harness.
+
+CLI twin of the reference benchmark
+(src/test/erasure-code/ceph_erasure_code_benchmark.cc:49-163 flag
+surface; qa/workunits/erasure-code/bench.sh computes GiB/s from the
+"seconds<TAB>KiB" output):
+
+  ec_benchmark.py --plugin jax --workload encode \
+      --size 1048576 --iterations 64 \
+      --parameter k=8 --parameter m=3
+
+  ec_benchmark.py --plugin jerasure --workload decode --erasures 2 \
+      --erasures-generation random --size 65536 --iterations 16 \
+      --parameter k=4 --parameter m=2 --parameter technique=reed_sol_van
+
+Prints "<seconds>\t<KiB processed>" exactly like the reference, plus a
+GB/s line on stderr for humans.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+import itertools
+import random
+import time
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--plugin", "-p", default="jax")
+    ap.add_argument("--workload", "-w", default="encode",
+                    choices=("encode", "decode"))
+    ap.add_argument("--size", "-s", type=int, default=1 << 20,
+                    help="buffer size per iteration")
+    ap.add_argument("--iterations", "-i", type=int, default=16)
+    ap.add_argument("--erasures", "-e", type=int, default=1)
+    ap.add_argument("--erasures-generation", "-E", default="random",
+                    choices=("random", "exhaustive"))
+    ap.add_argument("--parameter", "-P", action="append", default=[],
+                    help="k=V / m=V / technique=V ... (repeatable)")
+    args = ap.parse_args(argv)
+
+    from ceph_tpu.ec import registry
+
+    profile = {"plugin": args.plugin}
+    for p in args.parameter:
+        k, _, v = p.partition("=")
+        profile[k] = v
+    ec = registry.factory(args.plugin, profile)
+    k = ec.get_data_chunk_count()
+    n = ec.get_chunk_count()
+
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, args.size, dtype=np.uint8).tobytes()
+
+    if args.workload == "encode":
+        total = 0
+        t0 = time.perf_counter()
+        for _ in range(args.iterations):
+            ec.encode(set(range(n)), data)
+            total += args.size
+        dt = time.perf_counter() - t0
+    else:
+        encoded = ec.encode(set(range(n)), data)
+        if args.erasures_generation == "exhaustive":
+            patterns = list(
+                itertools.combinations(range(n), args.erasures)
+            )
+        else:
+            rnd = random.Random(42)
+            patterns = [
+                tuple(rnd.sample(range(n), args.erasures))
+                for _ in range(args.iterations)
+            ]
+        total = 0
+        t0 = time.perf_counter()
+        for i in range(args.iterations):
+            lost = patterns[i % len(patterns)]
+            avail = {s: c for s, c in encoded.items() if s not in lost}
+            decoded = ec.decode(set(lost), avail)
+            total += args.size
+            if args.erasures_generation == "exhaustive":
+                for s in lost:
+                    assert np.array_equal(decoded[s], encoded[s]), (
+                        f"round-trip mismatch on {lost}"
+                    )
+        dt = time.perf_counter() - t0
+
+    print(f"{dt:.6f}\t{total // 1024}")
+    print(
+        f"# {args.plugin} {args.workload} k={k} m={n - k}: "
+        f"{total / dt / 1e9:.3f} GB/s",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
