@@ -175,21 +175,27 @@ class RadosClient:
                 # our monitor died: hunt for a live one (MonClient
                 # hunting) and retry after the election settles
                 await asyncio.sleep(0.2)
-                await self.connect_multi(getattr(self, "_mon_addrs", []))
+                try:
+                    await self.connect_multi(getattr(self, "_mon_addrs", []))
+                except (RadosError, ConnectionError, OSError):
+                    pass  # whole quorum briefly unreachable; keep trying
                 continue
             finally:
                 self._cmd_waiters.pop(tid, None)
             if ack.code == -errno.EAGAIN and ack.rs.startswith("ENOTLEADER"):
                 leader = int(ack.rs.split()[1])
                 addr = getattr(self, "_monmap", {}).get(leader)
-                if addr is not None:
-                    self._mon_conn = await self.messenger.connect_to(
-                        ("mon", leader), *addr
-                    )
-                    from ceph_tpu.msg.messages import MMonSubscribe
+                try:
+                    if addr is not None:
+                        self._mon_conn = await self.messenger.connect_to(
+                            ("mon", leader), *addr
+                        )
+                        from ceph_tpu.msg.messages import MMonSubscribe
 
-                    await self._mon_conn.send_message(MMonSubscribe())
-                    continue
+                        await self._mon_conn.send_message(MMonSubscribe())
+                        continue
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    pass  # the named leader just died; wait + retry
                 await asyncio.sleep(0.2)  # quorum electing; retry
                 continue
             return ack.code, ack.rs, ack.data

@@ -74,13 +74,21 @@ def _register_builtins() -> None:
     except ImportError:  # pragma: no cover - env without zstandard
         pass
     for missing in ("snappy", "lz4", "brotli"):
-        # the reference ships these as optional plugins; absent
-        # libraries simply stay unregistered
+        # the reference ships these as optional plugins; absent (or
+        # differently-shaped) libraries simply stay unregistered
         try:
             mod = __import__(missing)
         except ImportError:
             continue
-        register(missing, _Simple(missing, mod.compress, mod.decompress))
+        comp = getattr(mod, "compress", None)
+        decomp = getattr(mod, "decompress", None)
+        if comp is None and missing == "lz4":
+            # modern lz4 wheels expose lz4.frame, not top-level APIs
+            frame = getattr(mod, "frame", None)
+            comp = getattr(frame, "compress", None)
+            decomp = getattr(frame, "decompress", None)
+        if comp is not None and decomp is not None:
+            register(missing, _Simple(missing, comp, decomp))
 
 
 _register_builtins()

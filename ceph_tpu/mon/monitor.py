@@ -268,6 +268,13 @@ class Monitor:
             om.erasure_code_profiles[op["name"]] = dict(op["profile"])
         elif kind == "pool_create":
             self._apply_pool_create(op)
+        elif kind == "upmap":
+            from ceph_tpu.osd.types import pg_t
+
+            for pool, ps, pairs in op["items"]:
+                om.pg_upmap_items[pg_t(pool, ps)] = [
+                    (f, t) for f, t in pairs
+                ]
         else:
             log.error("mon.%d: unknown committed op %r", self.rank, kind)
             return
@@ -275,12 +282,20 @@ class Monitor:
 
     async def _tick(self) -> None:
         was_leader = False
+        last_tick = time.monotonic()
         while True:
             await asyncio.sleep(self.beacon_grace / 4)
+            now = time.monotonic()
+            starved = now - last_tick > self.beacon_grace
+            last_tick = now
             if not self.is_leader:
                 was_leader = False
                 continue
-            now = time.monotonic()
+            if starved:
+                # the event loop stalled (big computation, GC, swap):
+                # beacons queued but undelivered are not missing OSDs —
+                # re-seed rather than mass-mark the cluster down
+                was_leader = False
             om = self.osdmap
             if not was_leader:
                 # fresh leadership: beacons were landing on the old
@@ -315,7 +330,7 @@ class Monitor:
         prefix = cmd.get("prefix", "")
         mutating = prefix in (
             "osd erasure-code-profile set", "osd pool create",
-            "osd down", "osd out",
+            "osd down", "osd out", "osd balance",
         )
         if mutating and not self.is_leader:
             leader = self.paxos.leader if self.paxos.leader is not None else -1
@@ -345,6 +360,39 @@ class Monitor:
                 if not self.osdmap.is_out(osd):
                     await self._propose({"op": "out", "osd": osd})
                 return 0, f"osd.{osd} out", b""
+            if prefix == "osd balance":
+                import json
+
+                from ceph_tpu.osd.balancer import UpmapBalancer
+                from ceph_tpu.osd.mapenc import decode_osdmap, encode_osdmap
+
+                try:
+                    fd = self.osdmap.crush.type_id("host")
+                except KeyError:
+                    fd = 1
+                # the census is seconds of pure computation: run it on a
+                # SNAPSHOT in a worker thread so the event loop keeps
+                # dispatching beacons (a blocked loop looks like every
+                # OSD going silent at once)
+                snapshot = decode_osdmap(encode_osdmap(self.osdmap))
+                max_swaps = int(cmd.get("max_swaps", "64"))
+
+                def _optimize():
+                    bal = UpmapBalancer(snapshot, failure_domain_type=fd)
+                    return bal.optimize(max_swaps=max_swaps)
+
+                items = await asyncio.to_thread(_optimize)
+                if items:
+                    await self._propose({
+                        "op": "upmap",
+                        "items": [
+                            [pg.pool, pg.ps, [list(p) for p in pairs]]
+                            for pg, pairs in items.items()
+                        ],
+                    })
+                return 0, f"{len(items)} upmap items installed", json.dumps(
+                    {"swaps": len(items)}
+                ).encode()
             if prefix in ("pg scrub", "pg deep-scrub"):
                 return await self._scrub(cmd, deep=prefix == "pg deep-scrub")
             if prefix == "status":

@@ -107,10 +107,16 @@ class Paxos:
         self.values: dict[int, bytes] = {}     # committed log
         self._uncommitted: tuple[int, bytes] | None = None
         self._accepts: set[int] = set()
+        self._propose_version = 0  # version the in-flight BEGIN carries
         self._collect_replies: dict[int, MMonPaxos] = {}
         self._propose_lock = asyncio.Lock()
         self._phase_done: asyncio.Event | None = None
         self.stable = asyncio.Event()
+        # cleared while this (newly elected) leader is still fetching
+        # commits it missed; proposals wait on it
+        self.caught_up = asyncio.Event()
+        self.caught_up.set()
+        self._catchup_target = 0
         if n_ranks == 1:
             self._become_leader({rank})
 
@@ -222,7 +228,11 @@ class Paxos:
         missing commits, recover any uncommitted value."""
         if self.n_ranks == 1:
             return
-        self.last_pn += 100 + self.rank + 1
+        # collision-free by construction (Paxos::get_new_proposal_number):
+        # round up to the next multiple of 100, then add our rank
+        self.last_pn = (
+            max(self.last_pn, self.accepted_pn) // 100 + 1
+        ) * 100 + self.rank
         pn = self.last_pn
         self.accepted_pn = pn
         self._collect_replies = {}
@@ -233,12 +243,27 @@ class Paxos:
                 ))
 
     async def _finish_collect(self) -> None:
+        # if WE are behind (led a minority partition, or rebooted):
+        # fetch the quorum's commits before proposing anything, or our
+        # next version numbers would collide with committed history
+        ahead = [
+            (r, rep.last_committed)
+            for r, rep in self._collect_replies.items()
+            if rep.last_committed > self.last_committed
+        ]
+        if ahead:
+            src, target = max(ahead, key=lambda t: t[1])
+            log.info(
+                "mon.%d: behind quorum (%d < %d); fetching from mon.%d",
+                self.rank, self.last_committed, target, src,
+            )
+            self._catchup_target = target
+            self.caught_up.clear()
+            await self._maybe_send(src, MMonPaxos(
+                FETCH, self.accepted_pn, 0, b"", self.last_committed
+            ))
         # catch up anyone behind; adopt any newer uncommitted value
         for r, rep in self._collect_replies.items():
-            if rep.last_committed > self.last_committed:
-                # we are behind the quorum?! should not happen for an
-                # elected leader with majority intersection, but be safe
-                log.warning("mon.%d: peer %d ahead in collect", self.rank, r)
             for v in range(rep.last_committed + 1, self.last_committed + 1):
                 if v in self.values:
                     await self._maybe_send(r, MMonPaxos(
@@ -255,12 +280,18 @@ class Paxos:
         async with self._propose_lock:
             if not self.is_leader:
                 raise ConnectionError("not leader")
+            if self.n_ranks > 1:
+                try:
+                    await asyncio.wait_for(self.caught_up.wait(), 10)
+                except asyncio.TimeoutError:
+                    raise ConnectionError("leader still catching up")
             version = self.last_committed + 1
             if self.n_ranks == 1:
                 await self._commit_local(version, value)
                 return version
             pn = self.accepted_pn
             self._accepts = {self.rank}
+            self._propose_version = version
             self._phase_done = asyncio.Event()
             self._uncommitted = (version, value)
             for r in self.quorum:
@@ -289,6 +320,8 @@ class Paxos:
         self.last_committed = version
         self._uncommitted = None
         await self._on_commit(version, value)
+        if not self.caught_up.is_set() and version >= self._catchup_target:
+            self.caught_up.set()
 
     async def handle_paxos(self, msg: MMonPaxos, from_rank: int) -> None:
         if msg.op == COLLECT:
@@ -311,7 +344,12 @@ class Paxos:
                     ACCEPT, msg.pn, msg.version, b"", self.last_committed
                 ))
         elif msg.op == ACCEPT:
-            if self.is_leader and msg.pn == self.accepted_pn and self._phase_done:
+            if (
+                self.is_leader
+                and msg.pn == self.accepted_pn
+                and msg.version == self._propose_version
+                and self._phase_done
+            ):
                 self._accepts.add(from_rank)
                 if len(self._accepts) >= self.majority():
                     self._phase_done.set()

@@ -91,7 +91,6 @@ log = logging.getLogger("ceph_tpu.osd")
 NO_SHARD = -1
 STRIPE_UNIT = 4096  # logical bytes per data chunk per stripe
 SUBOP_TIMEOUT = 30.0
-PG_LOG_KEEP = 128  # osd_min_pg_log_entries default (see common.config)
 
 SIZE_ATTR = "_size"
 HINFO_ATTR = "hinfo"
@@ -144,7 +143,9 @@ class OSDDaemon:
         self._log_keep = self.conf["osd_min_pg_log_entries"]
         self.osdmap: OSDMap | None = None
         self.beacon_interval = (
-            beacon_interval if beacon_interval is not None else 0.0
+            beacon_interval
+            if beacon_interval is not None
+            else self.conf["osd_beacon_report_interval"]
         )
         self.addr: tuple[str, int] | None = None
         self._mon_conn: Connection | None = None

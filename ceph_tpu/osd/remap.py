@@ -128,7 +128,20 @@ class BatchedClusterMapper:
             else None
         )
         if mapper is not None:
-            raw0, cnt = mapper(pps, om.osd_weight)
+            try:
+                raw0, cnt = mapper(pps, om.osd_weight)
+            except Exception:
+                # jax backend unavailable/broken (e.g. a misconfigured
+                # JAX_PLATFORMS in a daemon environment): the placement
+                # answer must not depend on the accelerator being there
+                import logging
+
+                logging.getLogger("ceph_tpu.remap").warning(
+                    "batched remap unavailable; using scalar pipeline",
+                    exc_info=True,
+                )
+                mapper = None
+        if mapper is not None:
             cnt = cnt.astype(np.int32).copy()
             raw = np.full((b, width), _NONE, np.int32)
             raw[:, : raw0.shape[1]] = raw0

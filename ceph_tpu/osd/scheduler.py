@@ -50,7 +50,6 @@ class MClockScheduler:
 
     def __init__(self) -> None:
         self._clients: dict[str, _ClientState] = {}
-        self._anti_starve = itertools.count()
 
     def set_profile(self, client: str, profile: ClientProfile) -> None:
         st = self._clients.get(client)
@@ -147,7 +146,6 @@ class WeightedPriorityQueue:
         self.cutoff = cutoff
         self._strict: list = []           # heap of (-prio, seq, item)
         self._weighted: dict[int, list] = {}
-        self._rr: list[int] = []
         self._rr_pos = 0
         self._seq = itertools.count()
 
@@ -155,13 +153,7 @@ class WeightedPriorityQueue:
         if priority >= self.cutoff:
             heapq.heappush(self._strict, (-priority, next(self._seq), item))
         else:
-            q = self._weighted.setdefault(priority, [])
-            if not q:
-                self._rebuild_rr()
-            q.append(item)
-
-    def _rebuild_rr(self) -> None:
-        pass  # rebuilt lazily in dequeue
+            self._weighted.setdefault(priority, []).append(item)
 
     def dequeue(self):
         if self._strict:
@@ -181,7 +173,7 @@ class WeightedPriorityQueue:
             acc += p
             if pick < acc:
                 return self._weighted[p].pop(0)
-        return self._weighted[levels[-1]].pop(0)
+        raise AssertionError("pick < sum(levels) must select a level")
 
     def empty(self) -> bool:
         return not self._strict and all(

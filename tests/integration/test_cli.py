@@ -1,0 +1,80 @@
+"""Process-level CLI flow: vstart cluster + ceph CLI over real TCP —
+the closest analogue of qa/standalone's shell-driven tests (separate
+processes, nothing shared but sockets)."""
+
+from __future__ import annotations
+
+import json
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def cluster_proc():
+    proc = subprocess.Popen(
+        [sys.executable, "tools/vstart.py", "--mons", "3", "--osds", "6",
+         "--beacon", "0.5"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    spec = None
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        m = re.search(r"mons at (\S+)", line or "")
+        if m:
+            spec = m.group(1)
+            break
+    assert spec, "vstart never reported its monmap"
+    yield spec
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def ceph(spec, *args, extra_flags=()):
+    r = subprocess.run(
+        [sys.executable, "tools/ceph.py", "-m", spec, *extra_flags, *args],
+        capture_output=True, text=True, timeout=120,
+    )
+    return r
+
+
+class TestCLI:
+    def test_full_admin_flow(self, cluster_proc):
+        spec = cluster_proc
+        r = ceph(spec, "status")
+        assert r.returncode == 0, r.stderr
+        status = json.loads(r.stdout)
+        assert status["num_up_osds"] == 6
+
+        r = ceph(
+            spec, "osd", "erasure-code-profile", "set", "cliprof",
+            "k=2", "m=1", "plugin=jax",
+        )
+        assert r.returncode == 0, r.stderr
+
+        r = ceph(
+            spec, "osd", "pool", "create", "clipool",
+            extra_flags=("--pg-num", "8", "--pool-type", "erasure",
+                         "--erasure-code-profile", "cliprof"),
+        )
+        assert r.returncode == 0, r.stderr
+        assert json.loads(r.stdout)["pool_id"] >= 1
+
+        r = ceph(spec, "df")
+        assert r.returncode == 0
+        assert "clipool" in r.stdout
+
+        r = ceph(spec, "pg", "scrub", "1.0")
+        assert r.returncode == 0, r.stderr
+        assert json.loads(r.stdout)["inconsistencies"] == []
+
+        r = ceph(spec, "osd", "down", "5")
+        assert r.returncode == 0, r.stderr
+        # the beacon sweep will bring it back up (the daemon is alive);
+        # status must remain serviceable throughout
+        r = ceph(spec, "status")
+        assert r.returncode == 0

@@ -148,3 +148,28 @@ class TestQuorum:
                     assert not m.osdmap.is_up(3)
 
         run(go())
+
+
+class TestBalanceCommand:
+    def test_osd_balance_replicates_upmaps(self):
+        async def go():
+            async with QuorumCluster(n_mons=3, n_osds=8) as c:
+                await c.client.pool_create("big", pg_num=128, size=3)
+                code, rs, data = await c.client.command(
+                    {"prefix": "osd balance"}
+                )
+                assert code == 0, rs
+                import json
+
+                swaps = json.loads(data)["swaps"]
+                assert swaps > 0
+                await asyncio.sleep(0.3)
+                # upmap table replicated to every quorum member
+                tables = [len(m.osdmap.pg_upmap_items) for m in c.mons]
+                assert tables == [swaps] * 3, tables
+                # I/O still correct under the new mappings
+                io = c.client.ioctx("big")
+                await io.write_full("balanced", b"b" * 4000)
+                assert await io.read("balanced") == b"b" * 4000
+
+        run(go())

@@ -1,0 +1,84 @@
+"""Upmap balancer tests (reference analogue: TestOSDMap.cc's
+calc_pg_upmaps coverage: deviation shrinks, constraints hold)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import builder as B
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE, CrushMap
+from ceph_tpu.osd.balancer import UpmapBalancer, balance
+from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.osd.types import PgPool, PoolType, pg_t
+
+
+def make_cluster(n_hosts=8, osds_per_host=2, pg_num=256, ec=False):
+    m = CrushMap()
+    root = B.build_hierarchy(m, osds_per_host=osds_per_host, n_hosts=n_hosts)
+    om = OSDMap(crush=m)
+    for o in range(n_hosts * osds_per_host):
+        om.new_osd(o)
+    if ec:
+        rule = B.add_simple_rule(m, root.id, 1, mode="indep", rule_type=3)
+        om.pools[1] = PgPool(
+            id=1, type=PoolType.ERASURE, size=4, min_size=3,
+            crush_rule=rule, pg_num=pg_num, pgp_num=pg_num,
+        )
+    else:
+        rule = B.add_simple_rule(m, root.id, 1, mode="firstn")
+        om.pools[1] = PgPool(
+            id=1, type=PoolType.REPLICATED, size=3,
+            crush_rule=rule, pg_num=pg_num, pgp_num=pg_num,
+        )
+    return om
+
+
+def spread(counts: dict[int, int]) -> int:
+    vals = list(counts.values())
+    return max(vals) - min(vals)
+
+
+class TestBalancer:
+    @pytest.mark.parametrize("ec", [False, True])
+    def test_deviation_shrinks_and_mappings_stay_valid(self, ec):
+        om = make_cluster(ec=ec)
+        bal = UpmapBalancer(om)
+        before, _ = bal.census()
+        items = bal.optimize(max_swaps=128)
+        assert items, "balancer found nothing to do on a hashed layout?"
+        bal.apply(items)
+        bal2 = UpmapBalancer(om)
+        after, pgs = bal2.census()
+        assert spread(after) < spread(before)
+        # constraint: every pg still has size distinct osds in distinct
+        # failure domains
+        pool = om.pools[1]
+        for pg, row in pgs.items():
+            assert len(row) == len(set(row))
+            domains = [bal2._domain(o) for o in row]
+            assert len(domains) == len(set(domains)), (pg, row)
+            assert len(row) == pool.size
+
+    def test_upmapped_pipeline_matches_scalar(self):
+        """Balancer output feeds the exception tables: batched and
+        scalar pipelines must agree on the adjusted mappings."""
+        om = make_cluster(pg_num=64)
+        assert balance(om, max_swaps=32) > 0
+        from ceph_tpu.osd.remap import BatchedClusterMapper
+
+        bcm = BatchedClusterMapper(om)
+        pm = bcm.map_pool(1)
+        for ps in range(64):
+            ref = om.pg_to_up_acting_osds(pg_t(1, ps), folded=True)
+            assert pm.rows(ps) == (ref[0], ref[1], ref[2], ref[3])
+
+    def test_respects_out_osds(self):
+        om = make_cluster(pg_num=64)
+        om.mark_out(0)
+        om.mark_down(0)
+        bal = UpmapBalancer(om)
+        items = bal.optimize(max_swaps=64)
+        for pg, pairs in items.items():
+            for _frm, to in pairs:
+                assert to != 0, "moved a pg onto an out osd"

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""ceph: the cluster admin CLI.
+
+CLI twin of the reference's `ceph` command (src/ceph.in) for the
+mini-cluster's command surface:
+
+  ceph.py -m HOST:PORT status
+  ceph.py -m HOST:PORT osd pool create NAME [--pg-num N] [--size N]
+          [--pool-type erasure --erasure-code-profile P]
+  ceph.py -m HOST:PORT osd erasure-code-profile set NAME k=K m=M plugin=jax
+  ceph.py -m HOST:PORT osd down ID | osd out ID
+  ceph.py -m HOST:PORT osd balance [--max-swaps N]
+  ceph.py -m HOST:PORT pg scrub PGID | pg deep-scrub PGID
+  ceph.py -m HOST:PORT df
+
+Multiple monitors: -m accepts a comma-separated monmap.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def parse_addrs(spec: str) -> list[tuple[str, int]]:
+    out = []
+    for part in spec.split(","):
+        host, _, port = part.rpartition(":")
+        out.append((host or "127.0.0.1", int(port)))
+    return out
+
+
+async def amain(args, extra: list[str]) -> int:
+    from ceph_tpu.client import RadosClient
+
+    client = RadosClient()
+    await client.connect_multi(parse_addrs(args.mon))
+    try:
+        verb = args.cmd
+        if verb == "status":
+            code, rs, data = await client.command({"prefix": "status"})
+        elif verb == "df":
+            om = client.osdmap
+            data = json.dumps({
+                "epoch": om.epoch,
+                "pools": {
+                    om.pool_names.get(pid, str(pid)): {
+                        "id": pid, "pg_num": p.pg_num, "size": p.size,
+                        "type": "erasure" if p.is_erasure() else "replicated",
+                    }
+                    for pid, p in sorted(om.pools.items())
+                },
+            }).encode()
+            code, rs = 0, ""
+        elif verb == "osd" and extra[:1] == ["balance"]:
+            cmd = {"prefix": "osd balance"}
+            if args.max_swaps:
+                cmd["max_swaps"] = str(args.max_swaps)
+            code, rs, data = await client.command(cmd)
+        elif verb == "osd" and extra[:3][:1] == ["pool"] and extra[1:2] == ["create"]:
+            cmd = {
+                "prefix": "osd pool create", "name": extra[2],
+                "pg_num": str(args.pg_num), "size": str(args.size),
+                "pool_type": args.pool_type,
+            }
+            if args.erasure_code_profile:
+                cmd["erasure_code_profile"] = args.erasure_code_profile
+            code, rs, data = await client.command(cmd)
+        elif verb == "osd" and extra[:3][:2] == ["erasure-code-profile", "set"]:
+            profile = " ".join(extra[3:])
+            code, rs, data = await client.command({
+                "prefix": "osd erasure-code-profile set",
+                "name": extra[2], "profile": profile,
+            })
+        elif verb == "osd" and extra[:1] in (["down"], ["out"]):
+            code, rs, data = await client.command({
+                "prefix": f"osd {extra[0]}", "id": extra[1],
+            })
+        elif verb == "pg" and extra[:1] in (["scrub"], ["deep-scrub"]):
+            code, rs, data = await client.command({
+                "prefix": f"pg {extra[0]}", "pgid": extra[1],
+            })
+        else:
+            print(f"unknown command: {verb} {' '.join(extra)}", file=sys.stderr)
+            return 2
+        if data:
+            try:
+                print(json.dumps(json.loads(data), indent=2))
+            except ValueError:
+                sys.stdout.write(data.decode(errors="replace"))
+        if rs:
+            print(rs, file=sys.stderr)
+        return 0 if code == 0 else 1
+    finally:
+        await client.shutdown()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__, add_help=True)
+    ap.add_argument("-m", "--mon", required=True,
+                    help="monitor address(es), host:port[,host:port...]")
+    ap.add_argument("--pg-num", type=int, default=8)
+    ap.add_argument("--size", type=int, default=3)
+    ap.add_argument("--pool-type", default="replicated")
+    ap.add_argument("--erasure-code-profile", default="")
+    ap.add_argument("--max-swaps", type=int, default=0)
+    ap.add_argument("cmd")
+    ap.add_argument("extra", nargs="*")
+    args = ap.parse_args(argv)
+    return asyncio.run(amain(args, args.extra))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

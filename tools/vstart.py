@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""vstart: boot a dev mini-cluster (mons + OSDs) in one process.
+
+The src/vstart.sh analogue: starts a monitor quorum and N OSDs on
+localhost, prints the monmap for `ceph.py -m`, and runs until
+interrupted.
+
+  vstart.py [--mons 1] [--osds 8] [--beacon 1.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+async def amain(args) -> int:
+    from ceph_tpu.crush import builder as B
+    from ceph_tpu.crush.types import CrushMap
+    from ceph_tpu.mon import Monitor
+    from ceph_tpu.osd.daemon import OSDDaemon
+
+    crush = CrushMap()
+    B.build_hierarchy(
+        crush, osds_per_host=args.osds_per_host,
+        n_hosts=(args.osds + args.osds_per_host - 1) // args.osds_per_host,
+    )
+    mons = [
+        Monitor(
+            crush=crush.copy(), rank=r, n_mons=args.mons,
+            beacon_grace=args.beacon * 4 if args.beacon else 0.0,
+            out_interval=args.out_interval,
+        )
+        for r in range(args.mons)
+    ]
+    for m in mons:
+        await m.start()
+    monmap = [m.addr for m in mons]
+    for m in mons:
+        await m.open_quorum(monmap)
+    for m in mons:
+        await m.wait_stable()
+    osds = []
+    for i in range(args.osds):
+        osd = OSDDaemon(i, monmap, beacon_interval=args.beacon)
+        await osd.start()
+        osds.append(osd)
+    spec = ",".join(f"{h}:{p}" for h, p in monmap)
+    print(f"vstart: cluster up — mons at {spec}", flush=True)
+    print(f"vstart: try  python tools/ceph.py -m {spec} status", flush=True)
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        for o in osds:
+            await o.stop()
+        for m in mons:
+            await m.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mons", type=int, default=1)
+    ap.add_argument("--osds", type=int, default=8)
+    ap.add_argument("--osds-per-host", type=int, default=1)
+    ap.add_argument("--beacon", type=float, default=1.0)
+    ap.add_argument("--out-interval", type=float, default=0.0)
+    args = ap.parse_args(argv)
+    try:
+        return asyncio.run(amain(args))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
