@@ -83,8 +83,14 @@ def _register_builtins() -> None:
         comp = getattr(mod, "compress", None)
         decomp = getattr(mod, "decompress", None)
         if comp is None and missing == "lz4":
-            # modern lz4 wheels expose lz4.frame, not top-level APIs
-            frame = getattr(mod, "frame", None)
+            # modern lz4 wheels expose lz4.frame, not top-level APIs —
+            # and the submodule needs an explicit import
+            try:
+                import importlib
+
+                frame = importlib.import_module("lz4.frame")
+            except ImportError:
+                continue
             comp = getattr(frame, "compress", None)
             decomp = getattr(frame, "decompress", None)
         if comp is not None and decomp is not None:

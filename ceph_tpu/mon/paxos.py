@@ -243,6 +243,10 @@ class Paxos:
                 ))
 
     async def _finish_collect(self) -> None:
+        # every collect re-derives catch-up state: a previous term's
+        # unfinished fetch (source died mid-catch-up) must not wedge
+        # this term's proposals
+        self.caught_up.set()
         # if WE are behind (led a minority partition, or rebooted):
         # fetch the quorum's commits before proposing anything, or our
         # next version numbers would collide with committed history

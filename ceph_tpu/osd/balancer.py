@@ -24,27 +24,10 @@ from __future__ import annotations
 
 from collections import defaultdict
 
-from ceph_tpu.crush.types import CRUSH_ITEM_NONE, CrushMap
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
 from ceph_tpu.osd.osdmap import OSDMap
 from ceph_tpu.osd.remap import BatchedClusterMapper
 from ceph_tpu.osd.types import pg_t
-
-
-def _osd_ancestor(crush: CrushMap, osd: int, domain_type: int) -> int | None:
-    """The bucket of ``domain_type`` containing this osd (its failure
-    domain; None when the osd is not placed under one)."""
-    # build child->parent once per call site via closure cache
-    parent: dict[int, int] = {}
-    for b in crush.buckets.values():
-        for it in b.items:
-            parent[it] = b.id
-    cur = osd
-    while cur in parent:
-        cur = parent[cur]
-        b = crush.buckets.get(cur)
-        if b is not None and b.type == domain_type:
-            return cur
-    return None
 
 
 class UpmapBalancer:

@@ -153,7 +153,9 @@ class WeightedPriorityQueue:
         if priority >= self.cutoff:
             heapq.heappush(self._strict, (-priority, next(self._seq), item))
         else:
-            self._weighted.setdefault(priority, []).append(item)
+            # weight-0 levels would never win a round-robin slot (and an
+            # all-zero queue would have no slots at all): clamp to 1
+            self._weighted.setdefault(max(priority, 1), []).append(item)
 
     def dequeue(self):
         if self._strict:

@@ -21,14 +21,21 @@ def cluster_proc():
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
     spec = None
+    seen = []
     deadline = time.time() + 60
     while time.time() < deadline:
         line = proc.stdout.readline()
+        if line == "" and proc.poll() is not None:
+            break  # child died at startup
+        seen.append(line)
         m = re.search(r"mons at (\S+)", line or "")
         if m:
             spec = m.group(1)
             break
-    assert spec, "vstart never reported its monmap"
+    assert spec, (
+        f"vstart never reported its monmap (rc={proc.poll()}):\n"
+        + "".join(seen)
+    )
     yield spec
     proc.terminate()
     proc.wait(timeout=10)
