@@ -436,7 +436,7 @@ class OSDDaemon:
         for shard, osd in live:
             payload = shards[shard].tobytes()
             if osd == self.id:
-                self._apply_shard_write(
+                await self._apply_shard_write_async(
                     pool, pg, shard, msg.oid, payload, attrs, version=version
                 )
             else:
@@ -462,6 +462,30 @@ class OSDDaemon:
         """Apply a shard write + (when versioned) its pg-log entry in
         ONE transaction — the reference couples data and log the same
         way (ECTransaction appends log entries to the shard txn)."""
+        self.store.queue_transaction(
+            self._shard_write_txn(pool, pg, shard, oid, payload, attrs,
+                                  delete, version)
+        )
+
+    async def _apply_shard_write_async(
+        self, pool, pg, shard, oid, payload: bytes, attrs,
+        delete=False, version: eversion_t = ZERO,
+    ) -> None:
+        """Same, but journaling stores fsync: run their commit on a
+        worker thread so one OSD's disk flush never stalls the whole
+        event loop (the reference's journaling happens on dedicated
+        finisher threads for the same reason)."""
+        t = self._shard_write_txn(
+            pool, pg, shard, oid, payload, attrs, delete, version
+        )
+        if getattr(self.store, "blocking_commit", False):
+            await asyncio.to_thread(self.store.queue_transaction, t)
+        else:
+            self.store.queue_transaction(t)
+
+    def _shard_write_txn(
+        self, pool, pg, shard, oid, payload, attrs, delete, version
+    ) -> Transaction:
         c = self._shard_coll(pool, pg, shard)
         o = ghobject_t(oid, shard=shard)
         t = Transaction()
@@ -480,7 +504,7 @@ class OSDDaemon:
                     DELETE if delete else MODIFY, oid, version, prior,
                 ))
                 lg.trim(t, self._log_keep)
-        self.store.queue_transaction(t)
+        return t
 
     async def _ec_read(self, pool, pg, acting, msg, ec, sinfo) -> MOSDOpReply:
         k = ec.get_data_chunk_count()
@@ -579,7 +603,7 @@ class OSDDaemon:
             if osd == CRUSH_ITEM_NONE:
                 continue
             if osd == self.id:
-                self._apply_shard_write(
+                await self._apply_shard_write_async(
                     pool, pg, shard, msg.oid, b"", {}, delete=True,
                     version=version,
                 )
@@ -604,7 +628,7 @@ class OSDDaemon:
                 o = ghobject_t(msg.oid, shard=msg.shard)
                 skip = self._object_version(c, o) > msg.guard
             if not skip:
-                self._apply_shard_write(
+                await self._apply_shard_write_async(
                     pool, msg.pg, msg.shard, msg.oid, msg.data, msg.attrs,
                     delete=msg.delete, version=msg.version,
                 )
@@ -662,7 +686,7 @@ class OSDDaemon:
             SIZE_ATTR: str(len(msg.data)).encode(),
             VERSION_ATTR: _v_bytes(version),
         }
-        self._apply_full_object(pool, pg, msg.oid, msg.data, attrs, delete, version)
+        await self._apply_full_object(pool, pg, msg.oid, msg.data, attrs, delete, version)
         waits = []
         for osd in acting:
             if osd in (self.id, CRUSH_ITEM_NONE):
@@ -680,11 +704,11 @@ class OSDDaemon:
                     return MOSDOpReply(tid=msg.tid, result=rep.result, epoch=self.epoch)
         return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
 
-    def _apply_full_object(
+    async def _apply_full_object(
         self, pool, pg, oid, data, attrs, delete=False,
         version: eversion_t = ZERO,
     ):
-        self._apply_shard_write(
+        await self._apply_shard_write_async(
             pool, pg, NO_SHARD, oid, data, attrs, delete=delete,
             version=version,
         )
@@ -693,7 +717,7 @@ class OSDDaemon:
         pool = self.osdmap.get_pg_pool(msg.pg.pool)
         result = 0
         try:
-            self._apply_full_object(
+            await self._apply_full_object(
                 pool, msg.pg, msg.oid, msg.data, msg.attrs, msg.delete,
                 msg.version,
             )
@@ -969,7 +993,9 @@ class OSDDaemon:
             c = self._shard_coll(pool, pg, shard)
             if self._object_version(c, ghobject_t(oid, shard=shard)) > guard:
                 return
-            self._apply_shard_write(pool, pg, shard, oid, b"", {}, delete=True)
+            await self._apply_shard_write_async(
+                pool, pg, shard, oid, b"", {}, delete=True
+            )
             return
         tid = next(self._tids)
         await self._sub_op(osd, MOSDECSubOpWrite(
@@ -1188,9 +1214,9 @@ class OSDDaemon:
             if local_v > pushed_v:
                 continue
             if msg.shard == NO_SHARD:
-                self._apply_full_object(pool, msg.pg, oid, payload, attrs)
+                await self._apply_full_object(pool, msg.pg, oid, payload, attrs)
             else:
-                self._apply_shard_write(
+                await self._apply_shard_write_async(
                     pool, msg.pg, msg.shard, oid, payload, attrs
                 )
         await msg.conn.send_message(MOSDPGPushReply(

@@ -2,6 +2,7 @@
 transaction seam and the in-RAM MemStore used by tests and the
 mini-cluster OSD."""
 
+from ceph_tpu.store.filestore import FileStore
 from ceph_tpu.store.memstore import MemStore
 from ceph_tpu.store.objectstore import (
     META_COLL,
@@ -13,6 +14,7 @@ from ceph_tpu.store.objectstore import (
 )
 
 __all__ = [
+    "FileStore",
     "META_COLL",
     "MemStore",
     "ObjectStore",

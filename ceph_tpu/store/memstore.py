@@ -58,6 +58,12 @@ class MemStore(ObjectStore):
         for cb in txn.on_commit:
             cb()
 
+    def validate(self, txn: Transaction) -> None:
+        """Raise (mutating nothing) if the transaction cannot apply —
+        journaling backends check this before persisting."""
+        with self._lock:
+            self._validate(txn)
+
     def _validate(self, txn: Transaction) -> None:
         """Dry-run structural checks so apply can't fail halfway."""
         # simulated collection/object existence (cheap: sets of keys)
