@@ -1,0 +1,46 @@
+"""Pin the current process to a virtual n-device CPU platform.
+
+The environment's sitecustomize registers a TPU-tunnel ('axon') PJRT
+backend factory in every interpreter and sets JAX_PLATFORMS=axon; env
+vars alone cannot undo that, and initializing the tunnel backend can
+hang when the tunnel is busy.  This helper drops the tunnel factory and
+pins the platform to cpu with a forced host device count — it must run
+before any JAX backend is initialized (jax *import* is fine).
+
+Shared by tests/conftest.py and __graft_entry__.py's dryrun child.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_host_device_count_flags(flags: str, n: int) -> str:
+    """Return ``flags`` with --xla_force_host_platform_device_count=n,
+    replacing any existing value of that flag."""
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\S+", "", flags or ""
+    ).strip()
+    return f"{flags} --xla_force_host_platform_device_count={n}".strip()
+
+
+def pin_virtual_cpu(n: int) -> None:
+    """Force this process onto an n-device virtual CPU platform.
+
+    Raises if a JAX backend was already initialized (too late to pin).
+    """
+    os.environ["XLA_FLAGS"] = force_host_device_count_flags(
+        os.environ.get("XLA_FLAGS", ""), n
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    assert not _xb._backends, (
+        "a JAX backend was initialized before pin_virtual_cpu; CPU "
+        "pinning is no longer possible in-process"
+    )
+    _xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")

@@ -5,33 +5,20 @@ against ``--xla_force_host_platform_device_count=8`` as the driver's
 ``dryrun_multichip`` does.  Set CEPH_TPU_TEST_REAL_DEVICE=1 to target the
 real accelerator instead.
 
-The environment ships an ``.axon_site`` sitecustomize that imports jax
-and registers the TPU-tunnel PJRT plugin in every python process; when
-the tunnel is busy or down, *initializing* that backend hangs the
-process.  jax is therefore already imported when this conftest runs, but
-no backend is initialized yet — so we drop the tunnel-backed factories
-from the registry and pin the platform to cpu before any test touches
-jax.  (Env vars alone can't do this: sitecustomize runs first.)
+The pinning itself (dropping the tunnel-backed 'axon' factory the
+environment's sitecustomize registers, before any backend initializes)
+lives in ceph_tpu.common.cpumesh, shared with __graft_entry__.py.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 if not os.environ.get("CEPH_TPU_TEST_REAL_DEVICE"):
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8"
-        ).strip()
-    os.environ["JAX_PLATFORMS"] = "cpu"
     try:
-        import jax
-        from jax._src import xla_bridge as _xb
+        from ceph_tpu.common.cpumesh import pin_virtual_cpu
 
-        assert not _xb._backends, (
-            "a JAX backend was initialized before conftest; CPU pinning "
-            "is no longer possible in-process"
-        )
-        _xb._backend_factories.pop("axon", None)
-        jax.config.update("jax_platforms", "cpu")
+        pin_virtual_cpu(8)
     except ImportError:
         pass
