@@ -254,8 +254,15 @@ class Monitor:
         kind = op["op"]
         om = self.osdmap
         if kind == "boot":
-            om.new_osd(op["osd"], weight=op["weight"], up=True)
-            om.osd_addrs[op["osd"]] = (op["host"], op["port"])
+            osd, addr = op["osd"], (op["host"], op["port"])
+            if (
+                om.is_up(osd)
+                and om.osd_addrs.get(osd) == addr
+                and om.osd_weight[osd] == op["weight"]
+            ):
+                return  # duplicate boot replay: no epoch bump
+            om.new_osd(osd, weight=op["weight"], up=True)
+            om.osd_addrs[osd] = addr
         elif kind == "down":
             if not (0 <= op["osd"] < om.max_osd) or not om.is_up(op["osd"]):
                 return  # no-op: no epoch bump

@@ -8,8 +8,16 @@ epoch parity convention), and the leader drives a single Paxos
 sequence of numbered values over the quorum:
 
     collect(pn)  -> peons reply last(pn, last_committed [, uncommitted])
-    begin(pn, v, value) -> peons persist + accept
+    begin(pn, v, value) -> peons record the pending value + accept
     commit(v)    -> everyone applies value v
+
+Durability model: paxos state (accepted_pn, the committed ``values``
+log, last_committed) is held in RAM; the committed log is the catch-up
+source for rebooted/partitioned members, so a *majority* restart loses
+any state the hosting monitor has not persisted itself.  Durable mon
+state is the monitor layer's job (persist committed values before
+apply), mirroring the reference's MonitorDBStore split (Paxos.h:174
+writes through MonitorDBStore::Transaction).
 
 Values are opaque blobs; the monitor replicates its *state-mutating
 commands* (osd boot/failure/out, pool create, profile set) and applies
@@ -109,6 +117,7 @@ class Paxos:
         self._accepts: set[int] = set()
         self._propose_version = 0  # version the in-flight BEGIN carries
         self._collect_replies: dict[int, MMonPaxos] = {}
+        self._recover_task: asyncio.Task | None = None  # strong root
         self._propose_lock = asyncio.Lock()
         self._phase_done: asyncio.Event | None = None
         self.stable = asyncio.Event()
@@ -266,7 +275,7 @@ class Paxos:
             await self._maybe_send(src, MMonPaxos(
                 FETCH, self.accepted_pn, 0, b"", self.last_committed
             ))
-        # catch up anyone behind; adopt any newer uncommitted value
+        # catch up anyone behind
         for r, rep in self._collect_replies.items():
             for v in range(rep.last_committed + 1, self.last_committed + 1):
                 if v in self.values:
@@ -274,9 +283,43 @@ class Paxos:
                         COMMIT, self.accepted_pn, v, self.values[v],
                         self.last_committed,
                     ))
-            if rep.version > self.last_committed and rep.value:
-                # recover an uncommitted value from a previous leader
-                await self.propose(rep.value)
+        # Recover at most ONE uncommitted value from the previous
+        # leader — the newest across replies (the reference recovers
+        # only the single highest-pn uncommitted value).  Deferred to a
+        # task: re-proposal must wait for our own catch-up FETCH (which
+        # arrives on a peer connection whose reader must keep running),
+        # and the version guard must be re-checked *after* catch-up —
+        # a value the old leader already committed would otherwise be
+        # committed twice under a fresh version.
+        best: tuple[int, bytes] | None = None
+        if self._uncommitted and self._uncommitted[0] > self.last_committed:
+            best = self._uncommitted  # our own accepted-but-uncommitted value
+        for rep in self._collect_replies.values():
+            if rep.value and rep.version > self.last_committed:
+                if best is None or rep.version > best[0]:
+                    best = (rep.version, rep.value)
+        if self._recover_task is not None and not self._recover_task.done():
+            # a previous term's recovery must not race this one into a
+            # double-commit of the same value
+            self._recover_task.cancel()
+        if best is not None:
+            self._recover_task = asyncio.create_task(
+                self._propose_recovered(*best)
+            )
+
+    async def _propose_recovered(self, version: int, value: bytes) -> None:
+        """Re-propose an uncommitted value recovered during collect,
+        after catch-up, unless catch-up revealed it was committed."""
+        try:
+            await asyncio.wait_for(self.caught_up.wait(), 10)
+        except asyncio.TimeoutError:
+            return
+        if version <= self.last_committed or not self.is_leader:
+            return  # already committed (or leadership lost meanwhile)
+        try:
+            await self.propose(value)
+        except ConnectionError:
+            pass  # quorum lost; next election re-runs recovery
 
     async def propose(self, value: bytes) -> int:
         """Leader-only: replicate one value; returns its version once

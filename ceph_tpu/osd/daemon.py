@@ -423,7 +423,12 @@ class OSDDaemon:
         ]
         if len(live) < pool.min_size:
             return MOSDOpReply(tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
-        my_shard = next((s for s, o in live if o == self.id), live[0][0])
+        my_shard = next((s for s, o in live if o == self.id), None)
+        if my_shard is None:
+            # a primary that holds no shard of the live set would mint
+            # versions from a PG log it never writes, defeating the
+            # stale-shard guards — bounce the op instead
+            return MOSDOpReply(tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
         version = self._next_version(self._shard_coll(pool, pg, my_shard))
         hinfo = ecutil.HashInfo(ec.get_chunk_count())
         hinfo.append(0, shards)
@@ -595,8 +600,12 @@ class OSDDaemon:
 
     async def _ec_delete(self, pool, pg, acting, msg) -> MOSDOpReply:
         my_shard = next(
-            (s for s, o in enumerate(acting) if o == self.id), 0
+            (s for s, o in enumerate(acting) if o == self.id), None
         )
+        if my_shard is None:
+            # same guard as _ec_write_full: never mint versions from a
+            # shard log this OSD doesn't own
+            return MOSDOpReply(tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
         version = self._next_version(self._shard_coll(pool, pg, my_shard))
         waits = []
         for shard, osd in enumerate(acting):

@@ -221,12 +221,18 @@ class PGLog:
             return None
         missing = MissingSet()
         latest: dict[str, pg_log_entry_t] = {}
+        first: dict[str, pg_log_entry_t] = {}
         for e in self.entries_after(peer_last_update):
             latest[e.oid] = e
+            first.setdefault(e.oid, e)
         for oid, e in latest.items():
             if e.op == DELETE:
                 # deletion replays as a delete during recovery
                 missing.add(oid, e.version)
             else:
-                missing.add(oid, e.version, e.prior_version)
+                # ``have`` = the version the peer actually holds: the
+                # prior_version of the FIRST entry past its last_update
+                # (later entries' prior_versions are intermediates the
+                # peer never saw)
+                missing.add(oid, e.version, first[oid].prior_version)
         return missing
