@@ -81,6 +81,9 @@ class Monitor:
         self._subscribers: dict[tuple[str, int], Connection] = {}
         self._last_beacon: dict[int, float] = {}
         self._down_at: dict[int, float] = {}
+        # derived replicated state: last boot incarnation per osd
+        # (applied deterministically by every member in _apply_op)
+        self._osd_incarnation: dict[int, int] = {}
         self._pool_ids: dict[str, int] = {}
         self._next_pool = 1
         self._tids = itertools.count(1)
@@ -231,7 +234,7 @@ class Monitor:
         self._down_at.pop(m.osd, None)
         await self._propose({
             "op": "boot", "osd": m.osd, "host": m.host, "port": m.port,
-            "weight": m.weight,
+            "weight": m.weight, "incarnation": m.incarnation,
         })
 
     async def _handle_failure(self, m: MOSDFailure) -> None:
@@ -255,12 +258,19 @@ class Monitor:
         om = self.osdmap
         if kind == "boot":
             osd, addr = op["osd"], (op["host"], op["port"])
+            inc = op.get("incarnation", 0)
             if (
                 om.is_up(osd)
                 and om.osd_addrs.get(osd) == addr
                 and om.osd_weight[osd] == op["weight"]
+                and self._osd_incarnation.get(osd) == inc
             ):
-                return  # duplicate boot replay: no epoch bump
+                # paxos replay of the same boot: no epoch bump.  A
+                # genuine fast restart carries a NEW incarnation and
+                # must bump the epoch so peers re-peer/recover toward
+                # the fresh (empty) daemon.
+                return
+            self._osd_incarnation[osd] = inc
             om.new_osd(osd, weight=op["weight"], up=True)
             om.osd_addrs[osd] = addr
         elif kind == "down":

@@ -67,9 +67,13 @@ class MMonPaxos(Message):
     def __init__(
         self, op: int = 0, pn: int = 0, version: int = 0,
         value: bytes = b"", last_committed: int = 0,
+        uncommitted_pn: int = 0,
     ):
         self.op, self.pn, self.version = op, pn, version
         self.value, self.last_committed = value, last_committed
+        # LAST only: the pn under which the reported uncommitted value
+        # was accepted (the Paxos adopt-highest-pn rule needs it)
+        self.uncommitted_pn = uncommitted_pn
 
     def encode_payload(self, enc: Encoder):
         enc.u8(self.op)
@@ -77,10 +81,14 @@ class MMonPaxos(Message):
         enc.u64(self.version)
         enc.bytes_(self.value)
         enc.u64(self.last_committed)
+        enc.u64(self.uncommitted_pn)
 
     @classmethod
     def decode_payload(cls, dec: Decoder):
-        return cls(dec.u8(), dec.u64(), dec.u64(), dec.bytes_(), dec.u64())
+        return cls(
+            dec.u8(), dec.u64(), dec.u64(), dec.bytes_(), dec.u64(),
+            dec.u64(),
+        )
 
 
 class Paxos:
@@ -114,6 +122,7 @@ class Paxos:
         self.last_committed = 0
         self.values: dict[int, bytes] = {}     # committed log
         self._uncommitted: tuple[int, bytes] | None = None
+        self._uncommitted_pn = 0  # pn the uncommitted value was accepted under
         self._accepts: set[int] = set()
         self._propose_version = 0  # version the in-flight BEGIN carries
         self._collect_replies: dict[int, MMonPaxos] = {}
@@ -284,27 +293,31 @@ class Paxos:
                         self.last_committed,
                     ))
         # Recover at most ONE uncommitted value from the previous
-        # leader — the newest across replies (the reference recovers
-        # only the single highest-pn uncommitted value).  Deferred to a
-        # task: re-proposal must wait for our own catch-up FETCH (which
-        # arrives on a peer connection whose reader must keep running),
-        # and the version guard must be re-checked *after* catch-up —
-        # a value the old leader already committed would otherwise be
-        # committed twice under a fresh version.
-        best: tuple[int, bytes] | None = None
+        # leader: the one accepted under the HIGHEST pn (version as
+        # tie-break) across our own state and all replies — the Paxos
+        # adopt rule; two values at the same version from different
+        # terms must resolve toward the possibly-committed one.
+        # Deferred to a task: re-proposal must wait for our own
+        # catch-up FETCH (which arrives on a peer connection whose
+        # reader must keep running), and the version guard must be
+        # re-checked *after* catch-up — a value the old leader already
+        # committed would otherwise be committed twice under a fresh
+        # version.
+        best: tuple[int, int, bytes] | None = None  # (pn, version, value)
         if self._uncommitted and self._uncommitted[0] > self.last_committed:
-            best = self._uncommitted  # our own accepted-but-uncommitted value
+            best = (self._uncommitted_pn, *self._uncommitted)
         for rep in self._collect_replies.values():
             if rep.value and rep.version > self.last_committed:
-                if best is None or rep.version > best[0]:
-                    best = (rep.version, rep.value)
+                cand = (rep.uncommitted_pn, rep.version, rep.value)
+                if best is None or cand[:2] > best[:2]:
+                    best = cand
         if self._recover_task is not None and not self._recover_task.done():
             # a previous term's recovery must not race this one into a
             # double-commit of the same value
             self._recover_task.cancel()
         if best is not None:
             self._recover_task = asyncio.create_task(
-                self._propose_recovered(*best)
+                self._propose_recovered(best[1], best[2])
             )
 
     async def _propose_recovered(self, version: int, value: bytes) -> None:
@@ -341,6 +354,7 @@ class Paxos:
             self._propose_version = version
             self._phase_done = asyncio.Event()
             self._uncommitted = (version, value)
+            self._uncommitted_pn = pn
             for r in self.quorum:
                 if r != self.rank:
                     await self._maybe_send(r, MMonPaxos(
@@ -376,7 +390,8 @@ class Paxos:
                 self.accepted_pn = msg.pn
                 un_v, un_val = self._uncommitted or (0, b"")
                 await self._maybe_send(from_rank, MMonPaxos(
-                    LAST, msg.pn, un_v, un_val, self.last_committed
+                    LAST, msg.pn, un_v, un_val, self.last_committed,
+                    uncommitted_pn=self._uncommitted_pn if un_val else 0,
                 ))
         elif msg.op == LAST:
             if msg.pn == self.accepted_pn and self.is_leader:
@@ -387,6 +402,7 @@ class Paxos:
             if msg.pn >= self.accepted_pn:
                 self.accepted_pn = msg.pn
                 self._uncommitted = (msg.version, msg.value)
+                self._uncommitted_pn = msg.pn
                 await self._maybe_send(from_rank, MMonPaxos(
                     ACCEPT, msg.pn, msg.version, b"", self.last_committed
                 ))

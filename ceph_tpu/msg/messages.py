@@ -49,18 +49,26 @@ class MOSDBoot(Message):
 
     TYPE = 71
 
-    def __init__(self, osd: int = 0, host: str = "", port: int = 0, weight: int = 0x10000):
+    def __init__(
+        self, osd: int = 0, host: str = "", port: int = 0,
+        weight: int = 0x10000, incarnation: int = 0,
+    ):
         self.osd, self.host, self.port, self.weight = osd, host, port, weight
+        # fresh per daemon start (the reference's boot_epoch role):
+        # distinguishes a genuine fast restart from a paxos replay of
+        # the same boot command
+        self.incarnation = incarnation
 
     def encode_payload(self, enc):
         enc.i32(self.osd)
         enc.str_(self.host)
         enc.u32(self.port)
         enc.u32(self.weight)
+        enc.u64(self.incarnation)
 
     @classmethod
     def decode_payload(cls, dec):
-        return cls(dec.i32(), dec.str_(), dec.u32(), dec.u32())
+        return cls(dec.i32(), dec.str_(), dec.u32(), dec.u32(), dec.u64())
 
 
 class MOSDBeacon(Message):

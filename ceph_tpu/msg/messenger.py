@@ -108,6 +108,11 @@ class Connection:
             if self.messenger._inject_counter % n == 0:
                 await self.close(notify=True)
                 raise ConnectionError("injected socket failure")
+        # ms_inject_delay analogue (reference global.yaml.in:1242-1267):
+        # per-send latency, for testing fan-out concurrency
+        delay = self.messenger.inject_delay
+        if delay > 0:
+            await asyncio.sleep(delay)
         async with self._send_lock:
             self._seq += 1
             segs = encode_message(msg, self.messenger.entity, self._seq)
@@ -183,6 +188,9 @@ class Messenger:
         # message tears the connection down instead of sending
         self.inject_socket_failures = 0
         self._inject_counter = 0
+        # ms_inject_delay analogue: seconds of latency added to every
+        # outgoing message (0 = off)
+        self.inject_delay = 0.0
 
     async def _dispatch(self, msg: Message) -> None:
         if self.dispatcher is not None:

@@ -37,6 +37,7 @@ import asyncio
 import errno
 import itertools
 import logging
+import time
 
 import numpy as np
 
@@ -158,6 +159,10 @@ class OSDDaemon:
         self._recovery_task: asyncio.Task | None = None
         self._map_event = asyncio.Event()
         self.stopping = False
+        # fresh per daemon start: lets the mon distinguish a fast
+        # restart (new incarnation -> epoch bump, peers re-peer) from a
+        # paxos replay of the same boot (no-op)
+        self.incarnation = time.time_ns()
 
     # -- lifecycle -----------------------------------------------------
 
@@ -177,7 +182,8 @@ class OSDDaemon:
             try:
                 conn = await self.messenger.connect(mhost, mport)
                 await conn.send_message(MOSDBoot(
-                    osd=self.id, host=self.addr[0], port=self.addr[1]
+                    osd=self.id, host=self.addr[0], port=self.addr[1],
+                    incarnation=self.incarnation,
                 ))
                 await conn.send_message(MMonSubscribe())
                 self._mon_conn = conn
@@ -528,22 +534,22 @@ class OSDDaemon:
             need_shards = set(minimum)
             chunks: dict[int, np.ndarray] = {}
             shard_attrs: dict[int, dict[str, bytes]] = {}
-            failed = None
-            for shard in sorted(need_shards):
-                osd = usable[shard]
-                try:
-                    payload, a, eno = await self._read_shard(
-                        pool, pg, shard, osd, msg.oid
-                    )
-                except (OSError, asyncio.TimeoutError, ConnectionError):
-                    payload, a, eno = None, None, errno.EIO
+            # concurrent fan-out: degraded-read latency is the max
+            # shard RTT, not the sum (the reference sends ECSubRead to
+            # all shards at once, src/osd/ECCommon.cc:440-445)
+            results = await asyncio.gather(*(
+                self._read_shard_quiet(pool, pg, s, usable[s], msg.oid)
+                for s in sorted(need_shards)
+            ))
+            failed = False
+            for shard, (payload, a, eno) in zip(sorted(need_shards), results):
                 if payload is None:
-                    failed = (shard, eno)
-                    break
-                chunks[shard] = np.frombuffer(payload, np.uint8)
-                shard_attrs[shard] = a or {}
-            if failed is not None:
-                excluded[failed[0]] = failed[1]
+                    excluded[shard] = eno
+                    failed = True
+                else:
+                    chunks[shard] = np.frombuffer(payload, np.uint8)
+                    shard_attrs[shard] = a or {}
+            if failed:
                 continue
             # a revived OSD may hold a STALE chunk from before it went
             # down: all chunks used in one decode must carry the same
@@ -580,6 +586,13 @@ class OSDDaemon:
         if excluded and all(e == errno.ENOENT for e in excluded.values()):
             return MOSDOpReply(tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch)
         return MOSDOpReply(tid=msg.tid, result=-errno.EIO, epoch=self.epoch)
+
+    async def _read_shard_quiet(self, pool, pg, shard, osd, oid):
+        """_read_shard with transport failures mapped to EIO."""
+        try:
+            return await self._read_shard(pool, pg, shard, osd, oid)
+        except (OSError, asyncio.TimeoutError, ConnectionError):
+            return None, None, errno.EIO
 
     async def _read_shard(self, pool, pg, shard, osd, oid):
         """Full-chunk read of one shard: (payload, attrs, errno)."""
@@ -966,11 +979,15 @@ class OSDDaemon:
         )
         if not is_ec:
             s0, o0 = next(iter(sources.items()))
-            payload, _a, _e = await self._read_shard(pool, pg, s0, o0, oid)
+            payload, _a, _e = await self._read_shard_quiet(
+                pool, pg, s0, o0, oid
+            )
             if payload is None:
                 return
-            for s, o in targets:
-                await self._push(pool, pg, s, o, oid, payload, src_attrs)
+            await asyncio.gather(*(
+                self._push(pool, pg, s, o, oid, payload, src_attrs)
+                for s, o in targets
+            ))
             return
         ec = self._ec_for(pool)
         sinfo = self._sinfo(ec)
@@ -981,15 +998,28 @@ class OSDDaemon:
                 self.id, pg, oid, len(sources), k,
             )
             return
+        # helper-shard reads and shard pushes both fan out concurrently
+        # (the reference's ECSubRead/MOSDPGPush are fire-and-gather)
         chunks: dict[int, np.ndarray] = {}
-        for s, o in sources.items():
-            payload, _a, _e = await self._read_shard(pool, pg, s, o, oid)
+        src_items = list(sources.items())
+        payloads = await asyncio.gather(*(
+            self._read_shard_quiet(pool, pg, s, o, oid) for s, o in src_items
+        ))
+        for (s, o), (payload, _a, _e) in zip(src_items, payloads):
             if payload is not None:
                 chunks[s] = np.frombuffer(payload, np.uint8)
+        if len(chunks) < k:
+            log.error(
+                "osd.%d: %s/%s recovery aborted: %d/%d source reads "
+                "succeeded", self.id, pg, oid, len(chunks), k,
+            )
+            return
         need = {s for s, _ in targets}
         rebuilt = ecutil.decode_shards(sinfo, ec, chunks, need)
-        for s, o in targets:
-            await self._push(pool, pg, s, o, oid, rebuilt[s].tobytes(), src_attrs)
+        await asyncio.gather(*(
+            self._push(pool, pg, s, o, oid, rebuilt[s].tobytes(), src_attrs)
+            for s, o in targets
+        ))
 
     async def _recovery_delete(
         self, pool, pg, shard, osd, oid, guard: eversion_t
