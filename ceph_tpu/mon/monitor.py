@@ -259,11 +259,17 @@ class Monitor:
         if kind == "boot":
             osd, addr = op["osd"], (op["host"], op["port"])
             inc = op.get("incarnation", 0)
+            stored = self._osd_incarnation.get(osd, 0)
+            if inc and inc < stored:
+                # reordered boot from an EARLIER daemon start (e.g. a
+                # delayed peon-forwarded duplicate): drop it entirely so
+                # it can neither bump the epoch nor regress the address
+                return
             if (
                 om.is_up(osd)
                 and om.osd_addrs.get(osd) == addr
                 and om.osd_weight[osd] == op["weight"]
-                and self._osd_incarnation.get(osd) == inc
+                and inc == stored
             ):
                 # paxos replay of the same boot: no epoch bump.  A
                 # genuine fast restart carries a NEW incarnation and

@@ -987,8 +987,8 @@ class OSDDaemon:
             await asyncio.gather(*(
                 self._push(pool, pg, s, o, oid, payload, src_attrs)
                 for s, o in targets
-            ))
-            return
+            ), return_exceptions=True)  # a dead target must not abort
+            return                      # the rest of the recovery pass
         ec = self._ec_for(pool)
         sinfo = self._sinfo(ec)
         k = ec.get_data_chunk_count()
@@ -1019,7 +1019,7 @@ class OSDDaemon:
         await asyncio.gather(*(
             self._push(pool, pg, s, o, oid, rebuilt[s].tobytes(), src_attrs)
             for s, o in targets
-        ))
+        ), return_exceptions=True)  # dead targets retry on the next pass
 
     async def _recovery_delete(
         self, pool, pg, shard, osd, oid, guard: eversion_t

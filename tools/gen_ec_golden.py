@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Freeze EC known-answer vectors into tests/golden/ec_kats.json.
+
+The reference pins encoded chunk bytes per plugin/version in the
+ceph-erasure-code-corpus submodule, checked by
+ceph_erasure_code_non_regression.cc — both empty in this checkout, so
+the stand-in (VERDICT r1 #9) is: freeze the chunk bytes every plugin
+produces TODAY for fixed inputs, so any later generator-matrix or
+GF-kernel drift fails tests/test_ec_golden.py loudly.
+
+Two fixed payloads per profile: a byte-counting ramp and a seeded
+random block, both sized to exercise padding.  Stored per chunk:
+length, sha256, and the first 32 bytes (hex) for diagnosis.
+
+Run only to EXTEND the corpus (new profiles); never to regenerate
+existing entries — that would defeat the pin.  The test fails on any
+mismatch OR any missing profile.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.ec import registry  # noqa: E402
+
+# the pinned profile matrix: every (plugin, technique, k, m) family the
+# framework ships (tests/test_ec_plugins.py CODES superset)
+PROFILES = [
+    ("jerasure", {"technique": "reed_sol_van", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "reed_sol_van", "k": "7", "m": "3"}),
+    ("jerasure", {"technique": "reed_sol_van", "k": "10", "m": "4"}),
+    ("jerasure", {"technique": "reed_sol_r6_op", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "cauchy_orig", "k": "3", "m": "2", "packetsize": "8"}),
+    ("jerasure", {"technique": "cauchy_good", "k": "4", "m": "2", "packetsize": "8"}),
+    ("jerasure", {"technique": "cauchy_good", "k": "8", "m": "3", "packetsize": "32"}),
+    ("isa", {"technique": "reed_sol_van", "k": "4", "m": "2"}),
+    ("isa", {"technique": "reed_sol_van", "k": "8", "m": "3"}),
+    ("isa", {"technique": "cauchy", "k": "8", "m": "3"}),
+    ("jax", {"technique": "reed_sol_van", "k": "4", "m": "2"}),
+    ("jax", {"technique": "cauchy", "k": "8", "m": "3"}),
+    ("shec", {"technique": "single", "k": "4", "m": "3", "c": "2"}),
+    ("shec", {"technique": "multiple", "k": "4", "m": "3", "c": "2"}),
+    ("lrc", {
+        "mapping": "__DD__DD",
+        "layers": json.dumps([["_cDD_cDD", ""], ["cDDD____", ""], ["____cDDD", ""]]),
+    }),
+    ("clay", {"k": "4", "m": "2", "d": "5"}),
+    ("clay", {"k": "8", "m": "4", "d": "11"}),
+]
+
+
+def payloads() -> dict[str, bytes]:
+    ramp = bytes(range(256)) * 17 + b"\x00\x01\x02"   # 4355 B, odd tail
+    rnd = np.random.default_rng(0xCEF).integers(
+        0, 256, 8192, dtype=np.uint8
+    ).tobytes()
+    return {"ramp4355": ramp, "rand8192": rnd}
+
+
+def profile_key(plugin: str, prof: dict) -> str:
+    items = ",".join(f"{k}={v}" for k, v in sorted(prof.items()))
+    return f"{plugin}({items})"
+
+
+def encode_all(plugin: str, prof: dict) -> dict:
+    ec = registry.factory(plugin, dict(prof))
+    n = ec.get_chunk_count()
+    out: dict[str, dict] = {}
+    for pname, payload in payloads().items():
+        enc = ec.encode(set(range(n)), payload)
+        out[pname] = {
+            str(i): {
+                "len": int(len(enc[i])),
+                "sha256": hashlib.sha256(enc[i].tobytes()).hexdigest(),
+                "head": enc[i][:32].tobytes().hex(),
+            }
+            for i in sorted(enc)
+        }
+    return out
+
+
+def main() -> int:
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "golden", "ec_kats.json",
+    )
+    existing = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            existing = json.load(f)
+    corpus = dict(existing)
+    added = 0
+    for plugin, prof in PROFILES:
+        key = profile_key(plugin, prof)
+        if key in corpus:
+            continue  # pinned: never regenerate
+        corpus[key] = {"plugin": plugin, "profile": prof,
+                       "chunks": encode_all(plugin, prof)}
+        added += 1
+        print(f"pinned {key}")
+    with open(path, "w") as f:
+        json.dump(corpus, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"{added} new profiles pinned, {len(corpus)} total -> {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
