@@ -96,7 +96,9 @@ class RadosClient:
             raise RadosError(errno.EHOSTUNREACH, f"no monitor reachable: {last}")
         # swap atomically: concurrent commands never see a None session
         self._mon_conn = new_conn
-        await self._mon_conn.send_message(MMonSubscribe())
+        await self._mon_conn.send_message(MMonSubscribe(
+            start_epoch=self.osdmap.epoch if self.osdmap else 0
+        ))
         await self._wait_new_map(0, timeout=10.0)
         if self.osdmap is None:
             raise RadosError(errno.ETIMEDOUT, "no map from mon")
@@ -129,9 +131,23 @@ class RadosClient:
 
     async def _dispatch(self, msg: Message) -> None:
         if isinstance(msg, MOSDMap):
-            for epoch in sorted(msg.maps):
-                if self.osdmap is None or epoch > self.osdmap.epoch:
-                    self.osdmap = decode_osdmap(msg.maps[epoch])
+            from ceph_tpu.msg.messages import MMonSubscribe
+            from ceph_tpu.osd.mapenc import apply_map_message
+
+            # copy-on-write swap: in-flight ops' `om` snapshots stay
+            # stable, so _wait_new_map(om.epoch) wakes immediately
+            new_map, gap = apply_map_message(self.osdmap, msg.maps, msg.incs)
+            if new_map is not None:
+                self.osdmap = new_map
+            if gap:
+                # re-subscribe from our epoch (mon sends the missing
+                # incrementals, or a full map)
+                try:
+                    await self._mon_conn.send_message(MMonSubscribe(
+                        start_epoch=self.osdmap.epoch if self.osdmap else 0
+                    ))
+                except ConnectionError:
+                    pass  # hunt will re-subscribe
             ev, self._map_event = self._map_event, asyncio.Event()
             ev.set()  # wake everyone waiting for "a newer map than X"
         elif isinstance(msg, MOSDOpReply):

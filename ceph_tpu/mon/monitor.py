@@ -40,7 +40,12 @@ from ceph_tpu.msg.messages import (
     MOSDScrubReply,
 )
 from ceph_tpu.msg.messenger import Connection, Message, Messenger
-from ceph_tpu.osd.mapenc import encode_osdmap
+from ceph_tpu.osd.mapenc import (
+    decode_osdmap,
+    diff_osdmap,
+    encode_incremental,
+    encode_osdmap,
+)
 from ceph_tpu.osd.osdmap import OSDMap
 from ceph_tpu.osd.types import PgPool, PoolType
 
@@ -78,6 +83,7 @@ class Monitor:
         self.beacon_grace = beacon_grace
         self.out_interval = out_interval
         self._epoch_blobs: dict[int, bytes] = {}
+        self._epoch_incs: dict[int, bytes] = {}
         self._subscribers: dict[tuple[str, int], Connection] = {}
         self._last_beacon: dict[int, float] = {}
         self._down_at: dict[int, float] = {}
@@ -161,10 +167,19 @@ class Monitor:
     # -- map publication ----------------------------------------------
 
     def _snapshot(self) -> None:
-        self._epoch_blobs[self.osdmap.epoch] = encode_osdmap(self.osdmap)
+        epoch = self.osdmap.epoch
+        self._epoch_blobs[epoch] = encode_osdmap(self.osdmap)
+        # delta vs the previous epoch (OSDMap::Incremental): cheap
+        # publication; subscribers land bit-identical to the full map
+        prev = self._epoch_blobs.get(epoch - 1)
+        if prev is not None:
+            inc = diff_osdmap(decode_osdmap(prev), self.osdmap)
+            self._epoch_incs[epoch] = encode_incremental(inc)
         # bound history
         for e in sorted(self._epoch_blobs)[:-500]:
             del self._epoch_blobs[e]
+        for e in sorted(self._epoch_incs)[:-500]:
+            del self._epoch_incs[e]
 
     async def _new_epoch(self) -> None:
         self.osdmap.epoch += 1
@@ -172,12 +187,28 @@ class Monitor:
         await self._publish()
 
     async def _publish(self) -> None:
-        blob = {self.osdmap.epoch: self._epoch_blobs[self.osdmap.epoch]}
+        epoch = self.osdmap.epoch
+        inc = self._epoch_incs.get(epoch)
+        if inc is not None:
+            msg = MOSDMap(incs={epoch: inc})
+        else:
+            msg = MOSDMap(maps={epoch: self._epoch_blobs[epoch]})
         for peer, conn in list(self._subscribers.items()):
             try:
-                await conn.send_message(MOSDMap(maps=dict(blob)))
+                await conn.send_message(msg)
             except ConnectionError:
                 self._subscribers.pop(peer, None)
+
+    def _maps_since(self, start_epoch: int) -> "MOSDMap":
+        """Catch-up payload for a subscriber at ``start_epoch``:
+        incrementals when the whole (start, current] range is on hand,
+        else the latest full map (OSDMonitor::send_incremental)."""
+        epoch = self.osdmap.epoch
+        if 0 < start_epoch <= epoch:
+            want = range(start_epoch + 1, epoch + 1)
+            if all(e in self._epoch_incs for e in want):
+                return MOSDMap(incs={e: self._epoch_incs[e] for e in want})
+        return MOSDMap(maps={epoch: self._epoch_blobs[epoch]})
 
     # -- dispatch ------------------------------------------------------
 
@@ -199,11 +230,7 @@ class Monitor:
             await self._handle_failure(msg)
         elif isinstance(msg, MMonSubscribe):
             self._subscribers[msg.src] = msg.conn
-            await msg.conn.send_message(
-                MOSDMap(maps={
-                    self.osdmap.epoch: self._epoch_blobs[self.osdmap.epoch]
-                })
-            )
+            await msg.conn.send_message(self._maps_since(msg.start_epoch))
         elif isinstance(msg, MOSDScrubReply):
             fut = self._scrub_waiters.get(msg.tid)
             if fut and not fut.done():

@@ -124,22 +124,35 @@ class MMonSubscribe(Message):
 
 
 class MOSDMap(Message):
-    """mon -> *: encoded full maps by epoch (src/messages/MOSDMap.h)."""
+    """mon -> *: encoded maps by epoch — full and/or incremental
+    (src/messages/MOSDMap.h carries both maps and incremental_maps)."""
 
     TYPE = 41
 
-    def __init__(self, maps: dict[int, bytes] | None = None):
+    def __init__(
+        self,
+        maps: dict[int, bytes] | None = None,
+        incs: dict[int, bytes] | None = None,
+    ):
         self.maps = maps or {}
+        self.incs = incs or {}
 
     def encode_payload(self, enc):
         enc.u32(len(self.maps))
         for epoch in sorted(self.maps):
             enc.u32(epoch)
             enc.bytes_(self.maps[epoch])
+        enc.u32(len(self.incs))
+        for epoch in sorted(self.incs):
+            enc.u32(epoch)
+            enc.bytes_(self.incs[epoch])
 
     @classmethod
     def decode_payload(cls, dec):
-        return cls({dec.u32(): dec.bytes_() for _ in range(dec.u32())})
+        return cls(
+            {dec.u32(): dec.bytes_() for _ in range(dec.u32())},
+            {dec.u32(): dec.bytes_() for _ in range(dec.u32())},
+        )
 
 
 class MMonCommand(Message):

@@ -73,7 +73,7 @@ from ceph_tpu.msg.messages import (
 from ceph_tpu.msg.messenger import Connection, Message, Messenger
 from ceph_tpu.ops.hashing import ceph_str_hash_rjenkins
 from ceph_tpu.osd import ecutil
-from ceph_tpu.osd.mapenc import decode_osdmap
+from ceph_tpu.osd.mapenc import apply_map_message
 from ceph_tpu.osd.osdmap import OSDMap
 from ceph_tpu.osd.pglog import (
     DELETE,
@@ -185,7 +185,9 @@ class OSDDaemon:
                     osd=self.id, host=self.addr[0], port=self.addr[1],
                     incarnation=self.incarnation,
                 ))
-                await conn.send_message(MMonSubscribe())
+                await conn.send_message(MMonSubscribe(
+                    start_epoch=self.osdmap.epoch if self.osdmap else 0
+                ))
                 self._mon_conn = conn
                 return
             except (ConnectionError, OSError) as e:
@@ -343,13 +345,27 @@ class OSDDaemon:
             log.exception("osd.%d: dispatch failed for %r", self.id, msg)
 
     async def _handle_map(self, msg: MOSDMap) -> None:
-        for epoch in sorted(msg.maps):
-            if self.osdmap is None or epoch > self.osdmap.epoch:
-                self.osdmap = decode_osdmap(msg.maps[epoch])
+        # copy-on-write swap: code that captured self.osdmap mid-pass
+        # keeps a stable snapshot (recovery, in-flight ops)
+        new_map, gap = apply_map_message(self.osdmap, msg.maps, msg.incs)
+        if new_map is not None:
+            self.osdmap = new_map
+        if gap:
+            # ask the mon for the missing range (or a full map)
+            await self._request_map_fill()
         self._map_event.set()
         log.info("osd.%d: map epoch %d", self.id, self.epoch)
         if self._recovery_task is None or self._recovery_task.done():
             self._recovery_task = asyncio.ensure_future(self._recover_all())
+
+    async def _request_map_fill(self) -> None:
+        try:
+            if self._mon_conn is not None:
+                await self._mon_conn.send_message(MMonSubscribe(
+                    start_epoch=self.osdmap.epoch if self.osdmap else 0
+                ))
+        except ConnectionError:
+            pass  # mon hunt will re-subscribe
 
     # -- client ops (the PrimaryLogPG::do_op slice) --------------------
 
