@@ -4,10 +4,22 @@
 Clone of the reference harness semantics (ceph_erasure_code_benchmark,
 reference src/test/erasure-code/ceph_erasure_code_benchmark.cc:155-193:
 encode a buffer in a timed loop, report bytes/second;
-qa/workunits/erasure-code/bench.sh:170 computes GiB/s).  Here the encode
-runs the fused pallas TPU kernel on stripe batches resident in HBM, with
-a device-side dependency chain between iterations so host/tunnel async
-dispatch cannot fake timings.
+qa/workunits/erasure-code/bench.sh:170 computes GiB/s).  The encode
+runs the fused pallas TPU kernel over a 6 GiB stripe batch resident in
+HBM (falling back to 2 GiB / 512 MiB when HBM is short).
+
+Methodology notes (measured on the tunneled v5e):
+- Each kernel LAUNCH pays a fixed relay/queueing cost that swings from
+  ~10 ms to ~200 ms with co-tenant load, while the kernel itself
+  streams at >100 GB/s — so the benchmark uses one giant launch per
+  sample (6 GiB per dispatch) to amortize it, not a chain of small
+  ones (the previous chain harness also xor-folded the parity into the
+  input each iteration, which XLA materialized as a full HBM copy that
+  dominated the measurement).
+- Samples are spread over ~30 s and the best is reported, so a brief
+  co-tenant burst doesn't define the number.
+- Input data is generated on-device (threefry): correctness of the
+  kernel vs the host GF(2^8) reference is asserted on a slice first.
 
 Prints ONE JSON line:
   {"metric": ..., "value": GB/s, "unit": "GB/s", "vs_baseline": value/40}
@@ -24,7 +36,6 @@ import numpy as np
 def main() -> int:
     import jax
     import jax.numpy as jnp
-    from jax import lax
 
     from ceph_tpu.models import isa_cauchy_matrix
     from ceph_tpu.ops import rs_kernels as rk
@@ -32,39 +43,41 @@ def main() -> int:
     k, m = 8, 3
     codec = rk.BitmatrixCodec(isa_cauchy_matrix(k, m))
     on_tpu = jax.default_backend() not in ("cpu",)
-    # 512 MiB of data on TPU; small on CPU (CI smoke).
-    S = 64 * 2**20 if on_tpu else 2**16
-    tile = 262144 if on_tpu else 4096
+    # 6 GiB of data on TPU (falls back if HBM is short); CI smoke on CPU.
+    sizes = [768 * 2**20, 256 * 2**20, 64 * 2**20] if on_tpu else [2**16]
 
-    rng = np.random.default_rng(0)
-    data = jnp.asarray(rng.integers(0, 256, (k, S), dtype=np.uint8))
-    jax.block_until_ready(data)
+    data = out = encode = None
+    for S in sizes:
+        try:
+            gen = jax.jit(lambda key, S=S: jax.random.bits(key, (k, S), jnp.uint8))
+            data = gen(jax.random.key(0))
+            jax.block_until_ready(data)
+            encode = jax.jit(lambda d: codec.encode(d, pallas=on_tpu))
+            out = encode(data)
+            jax.block_until_ready(out)  # warm + compile
+            break
+        except Exception:  # RESOURCE_EXHAUSTED on smaller-HBM parts
+            data = out = None
+    assert data is not None, "no batch size fit in device memory"
 
-    def encode(d):
-        if on_tpu:
-            return rk.gf_bitmatmul_pallas(codec.encode_bits, d, tile_s=tile)
-        return rk.gf_bitmatmul(codec.encode_bits, d)
+    # sanity: the kernel output must match the host-reference encode
+    from ceph_tpu.ops.gf256 import gf_matmul
 
-    N = 20 if on_tpu else 2
+    head = np.asarray(out[:, :4096])
+    ref = gf_matmul(codec.C, np.asarray(data[:, :4096]))
+    assert np.array_equal(head, ref), "kernel/host encode mismatch"
 
-    @jax.jit
-    def chain(d):
-        def body(i, d):
-            p = encode(d)
-            # fold one parity row back into the data: forces each
-            # iteration to depend on the previous one
-            return d.at[0:1, :].set(d[0:1, :] ^ p[0:1, :])
-        return lax.fori_loop(0, N, body, d)
-
-    out = chain(data)
-    jax.block_until_ready(out)  # warm + compile
+    rounds = 8 if on_tpu else 2
+    pause = 4.0 if on_tpu else 0.0
     best = float("inf")
-    for _ in range(3):
+    for r in range(rounds):
         t0 = time.perf_counter()
-        out = chain(data)
+        out = encode(data)
         jax.block_until_ready(out)
         _ = np.asarray(out[0, :8])  # host round-trip barrier
-        best = min(best, (time.perf_counter() - t0) / N)
+        best = min(best, time.perf_counter() - t0)
+        if pause and r < rounds - 1:
+            time.sleep(pause)
 
     gbs = (k * S) / best / 1e9
     print(json.dumps({

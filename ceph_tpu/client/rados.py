@@ -21,17 +21,33 @@ import os
 from ceph_tpu.msg.messages import (
     MMonCommand,
     MMonCommandAck,
+    MMonSubscribe,
     MOSDMap,
     MOSDOp,
     MOSDOpReply,
+    OP_APPEND,
+    OP_CREATE,
     OP_DELETE,
+    OP_GETXATTR,
+    OP_GETXATTRS,
+    OP_OMAP_CLEAR,
+    OP_OMAP_GETKEYS,
+    OP_OMAP_GETVALS,
+    OP_OMAP_GETVALSBYKEYS,
+    OP_OMAP_RMKEYS,
+    OP_OMAP_SETKEYS,
     OP_READ,
+    OP_RMXATTR,
+    OP_SETXATTR,
     OP_STAT,
+    OP_TRUNCATE,
+    OP_WRITE,
     OP_WRITE_FULL,
+    OP_ZERO,
+    OSDOp,
 )
 from ceph_tpu.msg.messenger import Connection, Message, Messenger
 from ceph_tpu.osd.daemon import object_to_pg
-from ceph_tpu.osd.mapenc import decode_osdmap
 from ceph_tpu.osd.osdmap import OSDMap
 
 log = logging.getLogger("ceph_tpu.client")
@@ -66,8 +82,6 @@ class RadosClient:
         """Connect against a monitor quorum: subscribe to the first
         reachable member; commands re-target the leader on ENOTLEADER
         redirects (the MonClient hunting/redirect behavior)."""
-        from ceph_tpu.msg.messages import MMonSubscribe
-
         self._mon_addrs = list(monmap)
         if not hasattr(self, "_monmap"):
             self._monmap: dict[int, tuple[str, int]] = {}  # rank -> addr
@@ -131,7 +145,6 @@ class RadosClient:
 
     async def _dispatch(self, msg: Message) -> None:
         if isinstance(msg, MOSDMap):
-            from ceph_tpu.msg.messages import MMonSubscribe
             from ceph_tpu.osd.mapenc import apply_map_message
 
             # copy-on-write swap: in-flight ops' `om` snapshots stay
@@ -206,8 +219,6 @@ class RadosClient:
                         self._mon_conn = await self.messenger.connect_to(
                             ("mon", leader), *addr
                         )
-                        from ceph_tpu.msg.messages import MMonSubscribe
-
                         await self._mon_conn.send_message(MMonSubscribe())
                         continue
                 except (ConnectionError, OSError, asyncio.TimeoutError):
@@ -253,6 +264,11 @@ class RadosClient:
     async def _submit(self, pool_id: int, op: MOSDOp) -> MOSDOpReply:
         """op_submit/_calc_target/resend loop."""
         last_err = errno.EIO
+        if op.is_write() and not op.reqid:
+            # stable across resends (osd_reqid_t): the OSD deduplicates
+            # a retried non-idempotent op (append, compound vector) by
+            # this id instead of re-applying it
+            op.reqid = f"client.{self.id}:{next(self._tids)}"
         for _try in range(MAX_RETRIES):
             om = self.osdmap
             pool = om.get_pg_pool(pool_id)
@@ -291,6 +307,93 @@ class RadosClient:
         raise RadosError(last_err, f"op {op.oid!r} failed after {MAX_RETRIES} tries")
 
 
+class ObjectOperation:
+    """Batched compound op (librados::ObjectWriteOperation /
+    ObjectReadOperation): ops accumulate and ship as ONE atomic
+    MOSDOp vector via :meth:`IoCtx.operate`."""
+
+    def __init__(self):
+        self.ops: list[OSDOp] = []
+
+    # write class
+    def write_full(self, data: bytes):
+        self.ops.append(OSDOp(OP_WRITE_FULL, data=bytes(data)))
+        return self
+
+    def write(self, off: int, data: bytes):
+        self.ops.append(OSDOp(OP_WRITE, off=off, data=bytes(data)))
+        return self
+
+    def append(self, data: bytes):
+        self.ops.append(OSDOp(OP_APPEND, data=bytes(data)))
+        return self
+
+    def zero(self, off: int, length: int):
+        self.ops.append(OSDOp(OP_ZERO, off=off, length=length))
+        return self
+
+    def truncate(self, size: int):
+        self.ops.append(OSDOp(OP_TRUNCATE, off=size))
+        return self
+
+    def create(self, exclusive: bool = False):
+        self.ops.append(OSDOp(OP_CREATE, off=1 if exclusive else 0))
+        return self
+
+    def remove(self):
+        self.ops.append(OSDOp(OP_DELETE))
+        return self
+
+    def setxattr(self, name: str, value: bytes):
+        self.ops.append(OSDOp(OP_SETXATTR, name=name, data=bytes(value)))
+        return self
+
+    def rmxattr(self, name: str):
+        self.ops.append(OSDOp(OP_RMXATTR, name=name))
+        return self
+
+    def omap_set(self, kv: dict[str, bytes]):
+        self.ops.append(OSDOp(OP_OMAP_SETKEYS, kv=dict(kv)))
+        return self
+
+    def omap_rm_keys(self, keys: list[str]):
+        self.ops.append(OSDOp(OP_OMAP_RMKEYS, keys=list(keys)))
+        return self
+
+    def omap_clear(self):
+        self.ops.append(OSDOp(OP_OMAP_CLEAR))
+        return self
+
+    # read class
+    def read(self, off: int = 0, length: int = 0):
+        self.ops.append(OSDOp(OP_READ, off=off, length=length))
+        return self
+
+    def stat(self):
+        self.ops.append(OSDOp(OP_STAT))
+        return self
+
+    def getxattr(self, name: str):
+        self.ops.append(OSDOp(OP_GETXATTR, name=name))
+        return self
+
+    def getxattrs(self):
+        self.ops.append(OSDOp(OP_GETXATTRS))
+        return self
+
+    def omap_get_keys(self):
+        self.ops.append(OSDOp(OP_OMAP_GETKEYS))
+        return self
+
+    def omap_get_vals(self):
+        self.ops.append(OSDOp(OP_OMAP_GETVALS))
+        return self
+
+    def omap_get_vals_by_keys(self, keys: list[str]):
+        self.ops.append(OSDOp(OP_OMAP_GETVALSBYKEYS, keys=list(keys)))
+        return self
+
+
 class IoCtx:
     """Per-pool I/O handle (librados::IoCtx)."""
 
@@ -298,32 +401,83 @@ class IoCtx:
         self.client = client
         self.pool_id = pool_id
 
-    async def write_full(self, oid: str, data: bytes) -> None:
+    async def _op1(self, oid: str, what: str, **kw) -> MOSDOpReply:
         reply = await self.client._submit(self.pool_id, MOSDOp(
-            pool=self.pool_id, oid=oid, op=OP_WRITE_FULL, data=bytes(data),
+            pool=self.pool_id, oid=oid, **kw,
         ))
         if reply.result != 0:
-            raise RadosError(-reply.result, f"write_full {oid!r}")
+            raise RadosError(-reply.result, f"{what} {oid!r}")
+        return reply
+
+    async def operate(self, oid: str, op: ObjectOperation) -> MOSDOpReply:
+        """Submit a compound vector; per-op results in reply.outs."""
+        reply = await self.client._submit(self.pool_id, MOSDOp(
+            pool=self.pool_id, oid=oid, ops=list(op.ops),
+        ))
+        if reply.result != 0:
+            raise RadosError(-reply.result, f"operate {oid!r}")
+        return reply
+
+    async def write_full(self, oid: str, data: bytes) -> None:
+        await self._op1(oid, "write_full", op=OP_WRITE_FULL, data=bytes(data))
+
+    async def write(self, oid: str, data: bytes, off: int) -> None:
+        await self._op1(oid, "write", op=OP_WRITE, off=off, data=bytes(data))
+
+    async def append(self, oid: str, data: bytes) -> None:
+        await self._op1(oid, "append", op=OP_APPEND, data=bytes(data))
+
+    async def zero(self, oid: str, off: int, length: int) -> None:
+        await self._op1(oid, "zero", op=OP_ZERO, off=off, length=length)
+
+    async def truncate(self, oid: str, size: int) -> None:
+        await self._op1(oid, "truncate", op=OP_TRUNCATE, off=size)
+
+    async def create(self, oid: str, exclusive: bool = False) -> None:
+        await self._op1(oid, "create", op=OP_CREATE, off=1 if exclusive else 0)
 
     async def read(self, oid: str, off: int = 0, length: int = 0) -> bytes:
-        reply = await self.client._submit(self.pool_id, MOSDOp(
-            pool=self.pool_id, oid=oid, op=OP_READ, off=off, length=length,
-        ))
-        if reply.result != 0:
-            raise RadosError(-reply.result, f"read {oid!r}")
+        reply = await self._op1(oid, "read", op=OP_READ, off=off, length=length)
         return reply.data
 
     async def stat(self, oid: str) -> int:
-        reply = await self.client._submit(self.pool_id, MOSDOp(
-            pool=self.pool_id, oid=oid, op=OP_STAT,
-        ))
-        if reply.result != 0:
-            raise RadosError(-reply.result, f"stat {oid!r}")
-        return reply.size
+        return (await self._op1(oid, "stat", op=OP_STAT)).size
 
     async def remove(self, oid: str) -> None:
-        reply = await self.client._submit(self.pool_id, MOSDOp(
-            pool=self.pool_id, oid=oid, op=OP_DELETE,
-        ))
-        if reply.result != 0:
-            raise RadosError(-reply.result, f"remove {oid!r}")
+        await self._op1(oid, "remove", op=OP_DELETE)
+
+    async def setxattr(self, oid: str, name: str, value: bytes) -> None:
+        await self.operate(oid, ObjectOperation().setxattr(name, value))
+
+    async def getxattr(self, oid: str, name: str) -> bytes:
+        reply = await self.operate(oid, ObjectOperation().getxattr(name))
+        return reply.outs[0][1]
+
+    async def getxattrs(self, oid: str) -> dict[str, bytes]:
+        reply = await self.operate(oid, ObjectOperation().getxattrs())
+        return reply.outs[0][2]
+
+    async def rmxattr(self, oid: str, name: str) -> None:
+        await self.operate(oid, ObjectOperation().rmxattr(name))
+
+    async def omap_set(self, oid: str, kv: dict[str, bytes]) -> None:
+        await self.operate(oid, ObjectOperation().omap_set(kv))
+
+    async def omap_get(self, oid: str) -> dict[str, bytes]:
+        reply = await self.operate(oid, ObjectOperation().omap_get_vals())
+        return reply.outs[0][2]
+
+    async def omap_get_keys(self, oid: str) -> list[str]:
+        reply = await self.operate(oid, ObjectOperation().omap_get_keys())
+        return sorted(reply.outs[0][2])
+
+    async def omap_get_vals_by_keys(
+        self, oid: str, keys: list[str]
+    ) -> dict[str, bytes]:
+        reply = await self.operate(
+            oid, ObjectOperation().omap_get_vals_by_keys(keys)
+        )
+        return reply.outs[0][2]
+
+    async def omap_rm_keys(self, oid: str, keys: list[str]) -> None:
+        await self.operate(oid, ObjectOperation().omap_rm_keys(keys))

@@ -167,14 +167,24 @@ class Monitor:
     # -- map publication ----------------------------------------------
 
     def _snapshot(self) -> None:
+        from ceph_tpu.osd.mapenc import crush_sections
+
         epoch = self.osdmap.epoch
-        self._epoch_blobs[epoch] = encode_osdmap(self.osdmap)
+        blob = self._epoch_blobs[epoch] = encode_osdmap(self.osdmap)
         # delta vs the previous epoch (OSDMap::Incremental): cheap
-        # publication; subscribers land bit-identical to the full map
-        prev = self._epoch_blobs.get(epoch - 1)
-        if prev is not None:
-            inc = diff_osdmap(decode_osdmap(prev), self.osdmap)
+        # publication; subscribers land bit-identical to the full map.
+        # The previous epoch's decoded map and crush encodes are cached
+        # so an epoch tick costs one diff, not two decodes + four
+        # crush encodes.
+        sections = crush_sections(self.osdmap)
+        prev = getattr(self, "_prev_snapshot", None)
+        if prev is not None and prev[0] == epoch - 1:
+            inc = diff_osdmap(
+                prev[1], self.osdmap,
+                old_sections=prev[2], new_sections=sections,
+            )
             self._epoch_incs[epoch] = encode_incremental(inc)
+        self._prev_snapshot = (epoch, decode_osdmap(blob), sections)
         # bound history
         for e in sorted(self._epoch_blobs)[:-500]:
             del self._epoch_blobs[e]

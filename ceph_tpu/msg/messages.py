@@ -196,56 +196,156 @@ class MMonCommandAck(Message):
 
 # -- client ops -------------------------------------------------------------
 
+# Read class
 OP_READ = 1
+OP_STAT = 4
+OP_GETXATTR = 11
+OP_GETXATTRS = 13
+OP_OMAP_GETKEYS = 15
+OP_OMAP_GETVALS = 16
+OP_OMAP_GETVALSBYKEYS = 19
+# Write class
 OP_WRITE_FULL = 2
 OP_DELETE = 3
-OP_STAT = 4
+OP_WRITE = 5
+OP_APPEND = 6
+OP_ZERO = 7
+OP_TRUNCATE = 8
+OP_CREATE = 9        # exclusive create: EEXIST when the object exists
+OP_SETXATTR = 10
+OP_RMXATTR = 12
+OP_OMAP_SETKEYS = 14
+OP_OMAP_RMKEYS = 17
+OP_OMAP_CLEAR = 18
+# Watch/notify (PrimaryLogPG::do_osd_ops CEPH_OSD_OP_WATCH/NOTIFY)
+OP_WATCH = 20
+OP_UNWATCH = 21
+OP_NOTIFY = 22
+# Object-class call (cls dispatch, src/objclass/)
+OP_CALL = 23
+
+WRITE_OPS = frozenset({
+    OP_WRITE_FULL, OP_DELETE, OP_WRITE, OP_APPEND, OP_ZERO, OP_TRUNCATE,
+    OP_CREATE, OP_SETXATTR, OP_RMXATTR, OP_OMAP_SETKEYS, OP_OMAP_RMKEYS,
+    OP_OMAP_CLEAR,
+})
+
+
+class OSDOp:
+    """One op of an MOSDOp vector (reference OSDOp, src/osd/osd_types.h:
+    op code + extent + name + indata; compound client operations are a
+    vector of these applied atomically, PrimaryLogPG::do_osd_ops)."""
+
+    __slots__ = ("op", "off", "length", "name", "data", "kv", "keys")
+
+    def __init__(
+        self, op: int, off: int = 0, length: int = 0, name: str = "",
+        data: bytes = b"", kv: dict[str, bytes] | None = None,
+        keys: list[str] | None = None,
+    ):
+        self.op, self.off, self.length, self.name = op, off, length, name
+        self.data = data
+        self.kv = kv or {}
+        self.keys = keys or []
+
+    def __repr__(self):
+        return (f"OSDOp(op={self.op}, off={self.off}, len={self.length}, "
+                f"name={self.name!r}, data={len(self.data)}B)")
+
+    def encode(self, enc: Encoder) -> None:
+        enc.u8(self.op)
+        enc.u64(self.off)
+        enc.u64(self.length)
+        enc.str_(self.name)
+        enc.bytes_(self.data)
+        _enc_map_str_bytes(enc, self.kv)
+        enc.u32(len(self.keys))
+        for k in self.keys:
+            enc.str_(k)
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "OSDOp":
+        return cls(
+            dec.u8(), dec.u64(), dec.u64(), dec.str_(), dec.bytes_(),
+            _dec_map_str_bytes(dec), [dec.str_() for _ in range(dec.u32())],
+        )
+
+    def is_write(self) -> bool:
+        return self.op in WRITE_OPS
 
 
 class MOSDOp(Message):
-    """client -> primary OSD (src/messages/MOSDOp.h): one object op.
-    The op set is the slice the mini-cluster serves (read /
-    write-full / delete / stat); the reference's full CEPH_OSD_OP_*
-    switch lives in do_osd_ops (PrimaryLogPG.cc:5979)."""
+    """client -> primary OSD (src/messages/MOSDOp.h): a vector of ops
+    on one object, applied atomically — the reference's compound-op
+    envelope dispatched by PrimaryLogPG::do_osd_ops
+    (PrimaryLogPG.cc:5979)."""
 
     TYPE = 42
 
     def __init__(
         self, tid: int = 0, pool: int = 0, oid: str = "",
-        op: int = OP_READ, off: int = 0, length: int = 0,
+        op: int | None = None, off: int = 0, length: int = 0,
         data: bytes = b"", epoch: int = 0,
+        ops: list[OSDOp] | None = None, reqid: str = "",
     ):
         self.tid, self.pool, self.oid = tid, pool, oid
-        self.op, self.off, self.length = op, off, length
-        self.data, self.epoch = data, epoch
+        self.epoch = epoch
+        # stable across client resends (osd_reqid_t): the OSD's pg-log
+        # dup detection answers a retried non-idempotent op instead of
+        # re-applying it
+        self.reqid = reqid
+        if ops is not None:
+            self.ops = ops
+        elif op is not None:  # single-op convenience form
+            self.ops = [OSDOp(op, off=off, length=length, data=data)]
+        else:
+            self.ops = []
+
+    @property
+    def op(self) -> int:
+        """First op code (single-op convenience accessor)."""
+        return self.ops[0].op if self.ops else 0
+
+    @property
+    def data(self) -> bytes:
+        return self.ops[0].data if self.ops else b""
+
+    def is_write(self) -> bool:
+        return any(o.is_write() for o in self.ops)
 
     def encode_payload(self, enc):
         enc.u64(self.tid)
         enc.i64(self.pool)
         enc.str_(self.oid)
-        enc.u8(self.op)
-        enc.u64(self.off)
-        enc.u64(self.length)
-        enc.bytes_(self.data)
+        enc.u32(len(self.ops))
+        for o in self.ops:
+            o.encode(enc)
         enc.u32(self.epoch)
+        enc.str_(self.reqid)
 
     @classmethod
     def decode_payload(cls, dec):
-        return cls(
-            dec.u64(), dec.i64(), dec.str_(), dec.u8(),
-            dec.u64(), dec.u64(), dec.bytes_(), dec.u32(),
-        )
+        tid, pool, oid = dec.u64(), dec.i64(), dec.str_()
+        ops = [OSDOp.decode(dec) for _ in range(dec.u32())]
+        return cls(tid, pool, oid, epoch=dec.u32(), ops=ops, reqid=dec.str_())
 
 
 class MOSDOpReply(Message):
+    """Per-op results mirror the reference's ops-vector echo with
+    outdata; ``result``/``data``/``size`` summarize op 0 for the
+    single-op common case."""
+
     TYPE = 43
 
     def __init__(
         self, tid: int = 0, result: int = 0, data: bytes = b"",
         epoch: int = 0, size: int = 0,
+        outs: list[tuple[int, bytes, dict[str, bytes]]] | None = None,
     ):
         self.tid, self.result, self.data = tid, result, data
         self.epoch, self.size = epoch, size
+        # one (result, outdata, out_kv) per request op
+        self.outs = outs or []
 
     def encode_payload(self, enc):
         enc.u64(self.tid)
@@ -253,10 +353,22 @@ class MOSDOpReply(Message):
         enc.bytes_(self.data)
         enc.u32(self.epoch)
         enc.u64(self.size)
+        enc.u32(len(self.outs))
+        for r, d, kv in self.outs:
+            enc.i32(r)
+            enc.bytes_(d)
+            _enc_map_str_bytes(enc, kv)
 
     @classmethod
     def decode_payload(cls, dec):
-        return cls(dec.u64(), dec.i32(), dec.bytes_(), dec.u32(), dec.u64())
+        tid, result, data, epoch, size = (
+            dec.u64(), dec.i32(), dec.bytes_(), dec.u32(), dec.u64()
+        )
+        outs = [
+            (dec.i32(), dec.bytes_(), _dec_map_str_bytes(dec))
+            for _ in range(dec.u32())
+        ]
+        return cls(tid, result, data, epoch, size, outs)
 
 
 # -- EC sub ops (src/messages/MOSDECSubOpWrite.h / MOSDECSubOpRead.h) -------
@@ -271,12 +383,17 @@ class MOSDECSubOpWrite(Message):
         from_osd: int = 0, oid: str = "", off: int = 0,
         data: bytes = b"", attrs: dict[str, bytes] | None = None,
         epoch: int = 0, truncate: int = -1, delete: bool = False,
-        version=None, guard=None,
+        version=None, guard=None, rmattrs: list[str] | None = None,
+        reqid: str = "",
     ):
         self.tid, self.pg, self.shard, self.from_osd = tid, pg, shard, from_osd
         self.oid, self.off, self.data = oid, off, data
         self.attrs = attrs or {}
         self.epoch, self.truncate, self.delete = epoch, truncate, delete
+        # attr names to remove (rmxattr; e.g. hinfo drop on RMW)
+        self.rmattrs = rmattrs or []
+        # client reqid carried into the shard's pg-log entry
+        self.reqid = reqid
         from ceph_tpu.osd.pglog import ZERO
 
         # the pg-log eversion this write commits at (ZERO = unlogged,
@@ -299,16 +416,23 @@ class MOSDECSubOpWrite(Message):
         enc.bool_(self.delete)
         _enc_ev(enc, self.version)
         _enc_ev(enc, self.guard)
+        enc.u32(len(self.rmattrs))
+        for n in self.rmattrs:
+            enc.str_(n)
+        enc.str_(self.reqid)
 
     @classmethod
     def decode_payload(cls, dec):
         tid = dec.u64()
         pg, shard = _dec_pg(dec)
-        return cls(
+        msg = cls(
             tid, pg, shard, dec.i32(), dec.str_(), dec.u64(),
             dec.bytes_(), _dec_map_str_bytes(dec), dec.u32(),
             dec.i64(), dec.bool_(), _dec_ev(dec), _dec_ev(dec),
         )
+        msg.rmattrs = [dec.str_() for _ in range(dec.u32())]
+        msg.reqid = dec.str_()
+        return msg
 
 
 class MOSDECSubOpWriteReply(Message):
@@ -404,17 +528,27 @@ class MOSDECSubOpReadReply(Message):
 # -- replicated sub op (src/messages/MOSDRepOp.h) ---------------------------
 
 class MOSDRepOp(Message):
+    """primary -> replica: the deterministic effect of one client write
+    vector (the reference ships the encoded ObjectStore::Transaction in
+    MOSDRepOp; here the primary resolves context-dependent ops like
+    append into deterministic ones and ships those)."""
+
     TYPE = 112
 
     def __init__(
         self, tid: int = 0, pg: pg_t = pg_t(0, 0), from_osd: int = 0,
         oid: str = "", data: bytes = b"", attrs: dict[str, bytes] | None = None,
         delete: bool = False, epoch: int = 0, version=None,
+        ops: list[OSDOp] | None = None, reqid: str = "",
     ):
         self.tid, self.pg, self.from_osd = tid, pg, from_osd
         self.oid, self.data = oid, data
         self.attrs = attrs or {}
         self.delete, self.epoch = delete, epoch
+        # effect vector (deterministic write ops); empty = legacy
+        # full-object payload in ``data``
+        self.ops = ops or []
+        self.reqid = reqid
         from ceph_tpu.osd.pglog import ZERO
 
         self.version = version if version is not None else ZERO
@@ -429,15 +563,22 @@ class MOSDRepOp(Message):
         enc.bool_(self.delete)
         enc.u32(self.epoch)
         _enc_ev(enc, self.version)
+        enc.u32(len(self.ops))
+        for o in self.ops:
+            o.encode(enc)
+        enc.str_(self.reqid)
 
     @classmethod
     def decode_payload(cls, dec):
         tid = dec.u64()
         pg, _ = _dec_pg(dec)
-        return cls(
+        msg = cls(
             tid, pg, dec.i32(), dec.str_(), dec.bytes_(),
             _dec_map_str_bytes(dec), dec.bool_(), dec.u32(), _dec_ev(dec),
         )
+        msg.ops = [OSDOp.decode(dec) for _ in range(dec.u32())]
+        msg.reqid = dec.str_()
+        return msg
 
 
 class MOSDRepOpReply(Message):

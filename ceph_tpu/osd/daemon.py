@@ -65,10 +65,25 @@ from ceph_tpu.msg.messages import (
     MOSDPGQuery,
     MOSDScrub,
     MOSDScrubReply,
+    OP_APPEND,
+    OP_CREATE,
     OP_DELETE,
+    OP_GETXATTR,
+    OP_GETXATTRS,
+    OP_OMAP_CLEAR,
+    OP_OMAP_GETKEYS,
+    OP_OMAP_GETVALS,
+    OP_OMAP_GETVALSBYKEYS,
+    OP_OMAP_RMKEYS,
+    OP_OMAP_SETKEYS,
     OP_READ,
+    OP_RMXATTR,
+    OP_SETXATTR,
     OP_STAT,
+    OP_TRUNCATE,
+    OP_WRITE,
     OP_WRITE_FULL,
+    OP_ZERO,
 )
 from ceph_tpu.msg.messenger import Connection, Message, Messenger
 from ceph_tpu.ops.hashing import ceph_str_hash_rjenkins
@@ -96,6 +111,15 @@ SUBOP_TIMEOUT = 30.0
 SIZE_ATTR = "_size"
 HINFO_ATTR = "hinfo"
 VERSION_ATTR = "_v"  # object_info version (oi attr analogue)
+USER_XATTR_PREFIX = "u_"  # client xattrs, namespaced off internal attrs
+
+
+class ECFetchError(Exception):
+    """A version-consistent EC fetch could not complete."""
+
+    def __init__(self, eno: int):
+        super().__init__(errno.errorcode.get(eno, str(eno)))
+        self.errno = eno
 
 
 def _v_bytes(v: eversion_t) -> bytes:
@@ -153,6 +177,10 @@ class OSDDaemon:
         self._tids = itertools.count(1)
         self._waiters: dict[int, asyncio.Future] = {}
         self._push_waiters: dict[tuple, asyncio.Future] = {}
+        # per-object write serialization (the ObjectContext rw-lock
+        # analogue): RMW read/encode/fan-out must not interleave with
+        # another write to the same object
+        self._obj_locks: dict[tuple[int, str], asyncio.Lock] = {}
         self._ec_cache: dict[str, object] = {}
         self._pg_logs: dict[coll_t, PGLog] = {}
         self._beacon_task: asyncio.Task | None = None
@@ -305,6 +333,25 @@ class OSDDaemon:
         except (FileNotFoundError, KeyError):
             return ZERO
 
+    def _obj_lock(self, pool_id: int, oid: str) -> asyncio.Lock:
+        key = (pool_id, oid)
+        lk = self._obj_locks.get(key)
+        if lk is None:
+            if len(self._obj_locks) > 4096:  # prune idle locks
+                # a lock is only disposable when nothing holds it AND
+                # nothing waits on it: between release and a waiter's
+                # wakeup, locked() is False while the waiter still
+                # references the old Lock object — pruning then would
+                # hand the next writer a fresh lock and break mutual
+                # exclusion
+                for k in [
+                    k for k, v in self._obj_locks.items()
+                    if not v.locked() and not getattr(v, "_waiters", None)
+                ]:
+                    del self._obj_locks[k]
+            lk = self._obj_locks[key] = asyncio.Lock()
+        return lk
+
     # -- dispatch ------------------------------------------------------
 
     async def _dispatch(self, msg: Message) -> None:
@@ -372,13 +419,15 @@ class OSDDaemon:
     async def _handle_client_op(self, msg: MOSDOp) -> None:
         try:
             self.perf.inc("op")
-            if msg.op in (OP_WRITE_FULL,):
+            if msg.is_write():
                 self.perf.inc("op_w")
-                self.perf.inc("op_in_bytes", len(msg.data))
-            elif msg.op in (OP_READ, OP_STAT):
+                self.perf.inc(
+                    "op_in_bytes", sum(len(o.data) for o in msg.ops)
+                )
+            else:
                 self.perf.inc("op_r")
             reply = await self._execute_op(msg)
-            if msg.op == OP_READ and reply.result == 0:
+            if reply.result == 0 and reply.data:
                 self.perf.inc("op_out_bytes", len(reply.data))
         except ECConnErrors as e:
             log.warning("osd.%d: op tid %d failed: %r", self.id, msg.tid, e)
@@ -394,17 +443,33 @@ class OSDDaemon:
             pass
 
     async def _execute_op(self, msg: MOSDOp) -> MOSDOpReply:
+        """do_op/do_osd_ops dispatch: route the op vector to the pool's
+        backend; write vectors serialize per object (the reference's
+        ObjectContext write lock, PrimaryLogPG::find_object_context)."""
         pool = self.osdmap.get_pg_pool(msg.pool) if self.osdmap else None
         if pool is None:
             return MOSDOpReply(tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch)
+        if not msg.ops:
+            return MOSDOpReply(tid=msg.tid, result=-errno.EINVAL, epoch=self.epoch)
         pg = object_to_pg(pool, msg.oid)
         acting, primary = self._acting(pool, pg)
         if primary != self.id:
             # client raced a map change; tell it to retry on a newer map
             return MOSDOpReply(tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
+        if msg.is_write():
+            async with self._obj_lock(pool.id, msg.oid):
+                if pool.is_erasure():
+                    ec = self._ec_for(pool)
+                    return await self._ec_write_vector(
+                        pool, pg, acting, msg, ec, self._sinfo(ec)
+                    )
+                return await self._rep_write_vector(pool, pg, acting, msg)
         if pool.is_erasure():
-            return await self._ec_op(pool, pg, acting, msg)
-        return await self._rep_op(pool, pg, acting, msg)
+            ec = self._ec_for(pool)
+            return await self._ec_read_vector(
+                pool, pg, acting, msg, ec, self._sinfo(ec)
+            )
+        return await self._rep_read_vector(pool, pg, acting, msg)
 
     # -- EC backend ----------------------------------------------------
 
@@ -415,95 +480,282 @@ class OSDDaemon:
         if not self.store.collection_exists(c):
             t.create_collection(c)
 
-    async def _ec_op(
-        self, pool: PgPool, pg: pg_t, acting: list[int], msg: MOSDOp
-    ) -> MOSDOpReply:
-        ec = self._ec_for(pool)
-        sinfo = self._sinfo(ec)
-        if msg.op == OP_WRITE_FULL:
-            return await self._ec_write_full(pool, pg, acting, msg, ec, sinfo)
-        if msg.op in (OP_READ, OP_STAT):
-            return await self._ec_read(pool, pg, acting, msg, ec, sinfo)
-        if msg.op == OP_DELETE:
-            return await self._ec_delete(pool, pg, acting, msg)
-        return MOSDOpReply(tid=msg.tid, result=-errno.EOPNOTSUPP, epoch=self.epoch)
-
-    async def _ec_write_full(self, pool, pg, acting, msg, ec, sinfo) -> MOSDOpReply:
-        data = np.frombuffer(msg.data, dtype=np.uint8)
-        padded_len = sinfo.logical_to_next_stripe_offset(len(data))
-        padded = np.zeros(padded_len, np.uint8)
-        padded[: len(data)] = data
-        if padded_len:
-            shards = ecutil.encode(sinfo, ec, padded)
-        else:  # empty object: every shard holds an empty chunk
-            empty = np.zeros(0, np.uint8)
-            shards = {s: empty for s in range(ec.get_chunk_count())}
+    def _ec_live(self, pool, acting) -> tuple[list, int | None] | None:
+        """(live shard pairs, my_shard) or None when the op must bounce."""
         live = [
             (shard, osd)
             for shard, osd in enumerate(acting)
             if osd != CRUSH_ITEM_NONE
         ]
         if len(live) < pool.min_size:
-            return MOSDOpReply(tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
+            return None
         my_shard = next((s for s, o in live if o == self.id), None)
         if my_shard is None:
             # a primary that holds no shard of the live set would mint
             # versions from a PG log it never writes, defeating the
             # stale-shard guards — bounce the op instead
-            return MOSDOpReply(tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
-        version = self._next_version(self._shard_coll(pool, pg, my_shard))
-        hinfo = ecutil.HashInfo(ec.get_chunk_count())
-        hinfo.append(0, shards)
-        attrs = {
-            HINFO_ATTR: hinfo.to_bytes(),
-            SIZE_ATTR: str(len(data)).encode(),
-            VERSION_ATTR: _v_bytes(version),
-        }
+            return None
+        return live, my_shard
+
+    async def _ec_fan_out_write(
+        self, pool, pg, live, oid, shard_payloads, attrs, version,
+        *, off: int = 0, truncate: int = -1, rmattrs: list[str] | None = None,
+        reqid: str = "",
+    ) -> int:
+        """Fan one versioned shard write out to the live set; returns 0
+        or the first failing shard's errno (the ECBackend ECSubWrite
+        fan-out, src/osd/ECBackend.cc:943)."""
         waits = []
         for shard, osd in live:
-            payload = shards[shard].tobytes()
+            payload = shard_payloads.get(shard, b"")
+            if not isinstance(payload, bytes):
+                payload = payload.tobytes()
             if osd == self.id:
                 await self._apply_shard_write_async(
-                    pool, pg, shard, msg.oid, payload, attrs, version=version
+                    pool, pg, shard, oid, payload, attrs, version=version,
+                    off=off, truncate=truncate, rmattrs=rmattrs,
+                    reqid=reqid,
                 )
             else:
                 tid = next(self._tids)
                 waits.append(self._sub_op(osd, MOSDECSubOpWrite(
                     tid=tid, pg=pg, shard=shard, from_osd=self.id,
-                    oid=msg.oid, off=0, data=payload, attrs=attrs,
-                    epoch=self.epoch, truncate=len(payload), version=version,
+                    oid=oid, off=off, data=payload, attrs=attrs,
+                    epoch=self.epoch, truncate=truncate, version=version,
+                    rmattrs=rmattrs or [], reqid=reqid,
                 ), tid))
         if waits:
-            replies = await asyncio.gather(*waits)
-            for rep in replies:
+            for rep in await asyncio.gather(*waits):
                 if rep.result != 0:
-                    return MOSDOpReply(
-                        tid=msg.tid, result=rep.result, epoch=self.epoch
-                    )
-        return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
+                    return rep.result
+        return 0
+
+    async def _ec_write_vector(
+        self, pool, pg, acting, msg, ec, sinfo
+    ) -> MOSDOpReply:
+        """EC write-class op vector: full writes encode directly; partial
+        writes (write/append/zero/truncate) run the read-modify-write
+        pipeline over the dirty stripe range — the ECCommon RMW pipeline
+        (reference src/osd/ECCommon.cc:623-707 start_rmw/try_state_to_reads
+        + ExtentCache) re-designed as a single batched read → mutate →
+        re-encode → fan-out pass."""
+        ops = msg.ops
+        if any(o.op == OP_DELETE for o in ops):
+            if len(ops) != 1:
+                return MOSDOpReply(tid=msg.tid, result=-errno.EINVAL, epoch=self.epoch)
+            return await self._ec_delete(pool, pg, acting, msg)
+        lv = self._ec_live(pool, acting)
+        if lv is None:
+            return MOSDOpReply(tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
+        live, my_shard = lv
+        # duplicate-op detection: a resend of an already-applied
+        # non-idempotent vector is answered, not re-applied (reference:
+        # pg-log reqid dup lookup in PrimaryLogPG::do_op)
+        lg = self._pg_log(self._shard_coll(pool, pg, my_shard))
+        if msg.reqid and msg.reqid in lg.reqids:
+            return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
+        for o in ops:
+            if o.op in (OP_OMAP_SETKEYS, OP_OMAP_RMKEYS, OP_OMAP_CLEAR):
+                # EC pools have no omap (reference restriction:
+                # pool_requires_alignment / MODE_EC forbids omap ops)
+                return MOSDOpReply(tid=msg.tid, result=-errno.EOPNOTSUPP, epoch=self.epoch)
+
+        # -- current object state (skipped for a leading WRITE_FULL) ----
+        exists, cur_size = False, 0
+        if ops[0].op != OP_WRITE_FULL:
+            try:
+                cur_size, _attrs, _ = await self._ec_fetch(
+                    pool, pg, acting, msg.oid, ec, want_data=False
+                )
+                exists = True
+            except ECFetchError as e:
+                if e.errno != errno.ENOENT:
+                    return MOSDOpReply(tid=msg.tid, result=-e.errno, epoch=self.epoch)
+
+        # -- fold the vector into (full | edits) + size + attr deltas ---
+        full: np.ndarray | None = None
+        edits: list[tuple] = []   # (off, np.ndarray) | ("zfill", off)
+        size = cur_size
+        attr_sets: dict[str, bytes] = {}
+        attr_rms: list[str] = []
+        touched = False
+        for o in ops:
+            if o.op == OP_CREATE:
+                if o.off and exists:  # off=1 -> exclusive
+                    return MOSDOpReply(tid=msg.tid, result=-errno.EEXIST, epoch=self.epoch)
+                touched = True
+            elif o.op == OP_WRITE_FULL:
+                full = np.frombuffer(o.data, np.uint8)
+                edits, size = [], len(o.data)
+                touched = exists = True
+            elif o.op == OP_WRITE:
+                edits.append((o.off, np.frombuffer(o.data, np.uint8)))
+                size = max(size, o.off + len(o.data))
+                touched = exists = True
+            elif o.op == OP_APPEND:
+                edits.append((size, np.frombuffer(o.data, np.uint8)))
+                size += len(o.data)
+                touched = exists = True
+            elif o.op == OP_ZERO:
+                end = min(size, o.off + o.length)
+                if o.off < end:
+                    edits.append((o.off, np.zeros(end - o.off, np.uint8)))
+                touched = exists = True
+            elif o.op == OP_TRUNCATE:
+                if o.off < size:
+                    # bytes past the cut must read as zero if the object
+                    # regrows later in this vector
+                    edits.append(("zfill", o.off))
+                size = o.off
+                touched = exists = True
+            elif o.op == OP_SETXATTR:
+                attr_sets[USER_XATTR_PREFIX + o.name] = bytes(o.data)
+            elif o.op == OP_RMXATTR:
+                attr_rms.append(USER_XATTR_PREFIX + o.name)
+            else:
+                return MOSDOpReply(tid=msg.tid, result=-errno.EOPNOTSUPP, epoch=self.epoch)
+
+        version = self._next_version(self._shard_coll(pool, pg, my_shard))
+        base_attrs = {
+            SIZE_ATTR: str(size).encode(),
+            VERSION_ATTR: _v_bytes(version),
+            **attr_sets,
+        }
+
+        # -- xattr-only vector: metadata write, no data churn -----------
+        if not touched and full is None and not edits:
+            if not exists:
+                base_attrs[SIZE_ATTR] = b"0"
+            r = await self._ec_fan_out_write(
+                pool, pg, live, msg.oid, {}, base_attrs, version,
+                rmattrs=attr_rms, reqid=msg.reqid,
+            )
+            return MOSDOpReply(tid=msg.tid, result=r, epoch=self.epoch)
+
+        cs, sw = sinfo.chunk_size, sinfo.stripe_width
+        new_shard_len = sinfo.logical_to_next_chunk_offset(size)
+
+        if full is not None:
+            # whole-object replace: no read needed; edits (if any) land
+            # on the known content
+            padded = np.zeros(sinfo.logical_to_next_stripe_offset(size), np.uint8)
+            padded[: len(full)] = full
+            for e in edits:
+                if e[0] == "zfill":
+                    padded[e[1]:] = 0
+                else:
+                    off, buf = e
+                    padded[off : off + len(buf)] = buf
+            if len(padded):
+                shards = ecutil.encode(sinfo, ec, padded)
+            else:
+                shards = {s: np.zeros(0, np.uint8) for s in range(ec.get_chunk_count())}
+            hinfo = ecutil.HashInfo(ec.get_chunk_count())
+            hinfo.append(0, shards)
+            base_attrs[HINFO_ATTR] = hinfo.to_bytes()
+            r = await self._ec_fan_out_write(
+                pool, pg, live, msg.oid, shards, base_attrs, version,
+                off=0, truncate=new_shard_len, rmattrs=attr_rms,
+                reqid=msg.reqid,
+            )
+            return MOSDOpReply(tid=msg.tid, result=r, epoch=self.epoch)
+
+        # -- RMW over the dirty stripe range ----------------------------
+        real_edits: list[tuple[int, np.ndarray]] = []
+        for e in edits:
+            if e[0] == "zfill":
+                # zero through the stripe boundary, not just to the
+                # final size: a truncate-down must scrub the stale tail
+                # of its last stripe, or a later extension (which relies
+                # on the "bytes past size are zero" invariant) would
+                # resurrect old bytes
+                hi = max(size, sinfo.logical_to_next_stripe_offset(e[1]))
+                if e[1] < hi:
+                    real_edits.append((e[1], np.zeros(hi - e[1], np.uint8)))
+            else:
+                real_edits.append(e)
+        # truncate/create never dirty stripes by themselves: shard-level
+        # truncate keeps whole stripes, and store gap/extend writes
+        # zero-fill — the parity of all-zero data is all zeros, so holes
+        # stay consistent without re-encoding
+        dirty = [
+            (sinfo.logical_to_prev_stripe_offset(off),
+             sinfo.logical_to_next_stripe_offset(off + len(buf)))
+            for off, buf in real_edits if len(buf)
+        ]
+        if not dirty:
+            # pure truncate / create / zero-beyond-end
+            r = await self._ec_fan_out_write(
+                pool, pg, live, msg.oid, {}, base_attrs, version,
+                truncate=new_shard_len,
+                rmattrs=attr_rms + (
+                    [HINFO_ATTR] if exists and size != cur_size else []
+                ),
+                reqid=msg.reqid,
+            )
+            return MOSDOpReply(tid=msg.tid, result=r, epoch=self.epoch)
+        d_lo = min(d[0] for d in dirty)
+        d_hi = max(d[1] for d in dirty)
+        old_end = sinfo.logical_to_next_stripe_offset(cur_size) if exists else 0
+        buf = np.zeros(d_hi - d_lo, np.uint8)
+        read_hi = min(d_hi, old_end)
+        if exists and d_lo < read_hi:
+            c_lo = sinfo.logical_to_prev_chunk_offset(d_lo)
+            c_len = sinfo.logical_to_prev_chunk_offset(read_hi) - c_lo
+            try:
+                _sz, _a, chunks = await self._ec_fetch(
+                    pool, pg, acting, msg.oid, ec,
+                    chunk_off=c_lo, chunk_len=c_len,
+                )
+            except ECFetchError as e:
+                return MOSDOpReply(tid=msg.tid, result=-e.errno, epoch=self.epoch)
+            old_logical = ecutil.decode_concat(sinfo, ec, chunks)
+            buf[: len(old_logical)] = old_logical
+        for off, data in real_edits:
+            lo = max(off, d_lo)
+            hi = min(off + len(data), d_hi)
+            if lo < hi:
+                buf[lo - d_lo : hi - d_lo] = data[lo - off : hi - off]
+        shards = ecutil.encode(sinfo, ec, buf)
+        # the cumulative-append crc chain cannot survive an overwrite;
+        # deep scrub falls back to the parity-equation check (the
+        # reference's ec_overwrites pools drop hinfo the same way)
+        r = await self._ec_fan_out_write(
+            pool, pg, live, msg.oid, shards, base_attrs, version,
+            off=sinfo.logical_to_prev_chunk_offset(d_lo),
+            truncate=new_shard_len,
+            rmattrs=attr_rms + [HINFO_ATTR], reqid=msg.reqid,
+        )
+        return MOSDOpReply(tid=msg.tid, result=r, epoch=self.epoch)
 
     def _apply_shard_write(
         self, pool, pg, shard, oid, payload: bytes, attrs,
         delete=False, version: eversion_t = ZERO,
+        off: int = 0, truncate: int | None = None,
+        rmattrs: list[str] | None = None, reqid: str = "",
     ) -> None:
         """Apply a shard write + (when versioned) its pg-log entry in
         ONE transaction — the reference couples data and log the same
         way (ECTransaction appends log entries to the shard txn)."""
         self.store.queue_transaction(
             self._shard_write_txn(pool, pg, shard, oid, payload, attrs,
-                                  delete, version)
+                                  delete, version, off, truncate, rmattrs,
+                                  reqid)
         )
 
     async def _apply_shard_write_async(
         self, pool, pg, shard, oid, payload: bytes, attrs,
         delete=False, version: eversion_t = ZERO,
+        off: int = 0, truncate: int | None = None,
+        rmattrs: list[str] | None = None, reqid: str = "",
     ) -> None:
         """Same, but journaling stores fsync: run their commit on a
         worker thread so one OSD's disk flush never stalls the whole
         event loop (the reference's journaling happens on dedicated
         finisher threads for the same reason)."""
         t = self._shard_write_txn(
-            pool, pg, shard, oid, payload, attrs, delete, version
+            pool, pg, shard, oid, payload, attrs, delete, version,
+            off, truncate, rmattrs, reqid,
         )
         if getattr(self.store, "blocking_commit", False):
             await asyncio.to_thread(self.store.queue_transaction, t)
@@ -511,8 +763,14 @@ class OSDDaemon:
             self.store.queue_transaction(t)
 
     def _shard_write_txn(
-        self, pool, pg, shard, oid, payload, attrs, delete, version
+        self, pool, pg, shard, oid, payload, attrs, delete, version,
+        off: int = 0, truncate: int | None = None,
+        rmattrs: list[str] | None = None, reqid: str = "",
     ) -> Transaction:
+        """``truncate`` semantics: None keeps legacy whole-replace
+        (truncate to len(payload)); -1 leaves the length alone (ranged
+        RMW writes and metadata-only writes); >= 0 sets the exact shard
+        length after the write (store truncate zero-fills on extend)."""
         c = self._shard_coll(pool, pg, shard)
         o = ghobject_t(oid, shard=shard)
         t = Transaction()
@@ -521,19 +779,44 @@ class OSDDaemon:
             if self.store.exists(c, o):
                 t.remove(c, o)
         else:
-            t.touch(c, o).truncate(c, o, len(payload)).write(c, o, 0, payload)
-            t.setattrs(c, o, attrs)
+            t.touch(c, o)
+            if payload:
+                t.write(c, o, off, payload)
+            if truncate is None:
+                if off == 0:
+                    t.truncate(c, o, len(payload))
+            elif truncate >= 0:
+                t.truncate(c, o, truncate)
+            if attrs:
+                t.setattrs(c, o, attrs)
+            for name in rmattrs or ():
+                t.rmattr(c, o, name)
         if version > ZERO:
             lg = self._pg_log(c)
             if version > lg.info.last_update:
                 prior = self._object_version(c, o)
                 lg.append(t, pg_log_entry_t(
                     DELETE if delete else MODIFY, oid, version, prior,
+                    reqid,
                 ))
                 lg.trim(t, self._log_keep)
         return t
 
-    async def _ec_read(self, pool, pg, acting, msg, ec, sinfo) -> MOSDOpReply:
+    async def _ec_fetch(
+        self, pool, pg, acting, oid, ec, *,
+        chunk_off: int = 0, chunk_len: int = 0, want_data: bool = True,
+    ):
+        """Version-consistent EC shard fetch — the ECCommon read
+        pipeline (reference src/osd/ECCommon.cc:440-445 fans ECSubRead
+        to all shards concurrently; stale shards are excluded and the
+        read retried with a different shard set).
+
+        Returns ``(size, attrs, chunks)``; ``chunks`` maps shard id to
+        the requested chunk byte range (empty when ``want_data`` is
+        False — a probe).  ``chunk_len == 0`` reads to the shard end.
+        Raises :class:`ECFetchError` with ENOENT for a fully-absent
+        object, EIO otherwise.
+        """
         k = ec.get_data_chunk_count()
         avail = {
             shard: osd for shard, osd in enumerate(acting)
@@ -547,18 +830,27 @@ class OSDDaemon:
                 minimum = ec.minimum_to_decode(want, set(usable))
             except Exception:
                 break  # not enough shards left to decode
-            need_shards = set(minimum)
+            need_shards = sorted(set(minimum))
+            if want_data:
+                reads = (
+                    self._read_shard_quiet(
+                        pool, pg, s, usable[s], oid,
+                        off=chunk_off, length=chunk_len,
+                    )
+                    for s in need_shards
+                )
+            else:
+                reads = (
+                    self._read_shard_quiet(
+                        pool, pg, s, usable[s], oid, off=0, length=1
+                    )
+                    for s in need_shards
+                )
+            results = await asyncio.gather(*reads)
             chunks: dict[int, np.ndarray] = {}
             shard_attrs: dict[int, dict[str, bytes]] = {}
-            # concurrent fan-out: degraded-read latency is the max
-            # shard RTT, not the sum (the reference sends ECSubRead to
-            # all shards at once, src/osd/ECCommon.cc:440-445)
-            results = await asyncio.gather(*(
-                self._read_shard_quiet(pool, pg, s, usable[s], msg.oid)
-                for s in sorted(need_shards)
-            ))
             failed = False
-            for shard, (payload, a, eno) in zip(sorted(need_shards), results):
+            for shard, (payload, a, eno) in zip(need_shards, results):
                 if payload is None:
                     excluded[shard] = eno
                     failed = True
@@ -582,46 +874,101 @@ class OSDDaemon:
                 continue
             attrs = next(iter(shard_attrs.values()), {})
             if not attrs or SIZE_ATTR not in attrs:
-                return MOSDOpReply(
-                    tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch
-                )
-            size = int(attrs[SIZE_ATTR])
-            if msg.op == OP_STAT:
-                return MOSDOpReply(
-                    tid=msg.tid, result=0, epoch=self.epoch, size=size
-                )
-            logical = ecutil.decode_concat(sinfo, ec, chunks)[:size]
-            off = msg.off
-            end = size if msg.length == 0 else min(off + msg.length, size)
-            return MOSDOpReply(
-                tid=msg.tid, result=0, epoch=self.epoch, size=size,
-                data=logical[off:end].tobytes(),
-            )
-        # decode never succeeded: a fully-absent object reports ENOENT,
-        # anything else is a real I/O failure
+                raise ECFetchError(errno.ENOENT)
+            return int(attrs[SIZE_ATTR]), attrs, (chunks if want_data else {})
         if excluded and all(e == errno.ENOENT for e in excluded.values()):
-            return MOSDOpReply(tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch)
-        return MOSDOpReply(tid=msg.tid, result=-errno.EIO, epoch=self.epoch)
+            raise ECFetchError(errno.ENOENT)
+        raise ECFetchError(errno.EIO)
 
-    async def _read_shard_quiet(self, pool, pg, shard, osd, oid):
+    async def _ec_read_vector(
+        self, pool, pg, acting, msg, ec, sinfo
+    ) -> MOSDOpReply:
+        """EC read-class op vector served from ONE version-consistent
+        shard snapshot: ranged reads fetch only the covering stripes
+        (objecter-style extent math) and xattrs ride the same attrs."""
+        ops = msg.ops
+        reads = [o for o in ops if o.op == OP_READ]
+        chunk_off = chunk_len = 0
+        if reads:
+            lo = min(o.off for o in reads)
+            chunk_off = sinfo.logical_to_prev_chunk_offset(lo)
+            if not any(o.length == 0 for o in reads):
+                hi = max(o.off + o.length for o in reads)
+                chunk_len = sinfo.logical_to_next_chunk_offset(hi) - chunk_off
+        try:
+            size, attrs, chunks = await self._ec_fetch(
+                pool, pg, acting, msg.oid, ec,
+                chunk_off=chunk_off, chunk_len=chunk_len,
+                want_data=bool(reads),
+            )
+        except ECFetchError as e:
+            return MOSDOpReply(tid=msg.tid, result=-e.errno, epoch=self.epoch)
+        logical = None
+        base = 0
+        if reads and chunks and any(len(v) for v in chunks.values()):
+            logical = ecutil.decode_concat(sinfo, ec, chunks)
+            base = sinfo.aligned_chunk_offset_to_logical_offset(chunk_off)
+        outs: list[tuple[int, bytes, dict[str, bytes]]] = []
+        first_read: bytes | None = None
+        for o in ops:
+            r, d, kv = 0, b"", {}
+            if o.op == OP_READ:
+                end = size if o.length == 0 else min(o.off + o.length, size)
+                if logical is not None and o.off < end:
+                    d = logical[o.off - base : end - base].tobytes()
+                if first_read is None:  # summarize the FIRST read op,
+                    first_read = d      # even when it returned 0 bytes
+            elif o.op == OP_STAT:
+                pass
+            elif o.op == OP_GETXATTR:
+                v = attrs.get(USER_XATTR_PREFIX + o.name)
+                if v is None:
+                    r = -errno.ENODATA
+                else:
+                    d = v
+            elif o.op == OP_GETXATTRS:
+                kv = {
+                    name[len(USER_XATTR_PREFIX):]: v
+                    for name, v in attrs.items()
+                    if name.startswith(USER_XATTR_PREFIX)
+                }
+            else:
+                # omap reads: EC pools have no omap (reference restriction)
+                r = -errno.EOPNOTSUPP
+            outs.append((r, d, kv))
+        result = next((r for r, _d, _kv in outs if r != 0), 0)
+        return MOSDOpReply(
+            tid=msg.tid, result=result, epoch=self.epoch, size=size,
+            data=first_read or b"", outs=outs,
+        )
+
+    async def _read_shard_quiet(
+        self, pool, pg, shard, osd, oid, *, off: int = 0, length: int = 0
+    ):
         """_read_shard with transport failures mapped to EIO."""
         try:
-            return await self._read_shard(pool, pg, shard, osd, oid)
+            return await self._read_shard(
+                pool, pg, shard, osd, oid, off=off, length=length
+            )
         except (OSError, asyncio.TimeoutError, ConnectionError):
             return None, None, errno.EIO
 
-    async def _read_shard(self, pool, pg, shard, osd, oid):
-        """Full-chunk read of one shard: (payload, attrs, errno)."""
+    async def _read_shard(
+        self, pool, pg, shard, osd, oid, *, off: int = 0, length: int = 0
+    ):
+        """Ranged chunk read of one shard: (payload, attrs, errno).
+        ``length == 0`` reads to the shard end."""
         if osd == self.id:
             c = self._shard_coll(pool, pg, shard)
             o = ghobject_t(oid, shard=shard)
             if not self.store.exists(c, o):
                 return None, None, errno.ENOENT
-            return self.store.read(c, o), self.store.getattrs(c, o), 0
+            data = self.store.read(c, o, off, None if length == 0 else length)
+            return data, self.store.getattrs(c, o), 0
         tid = next(self._tids)
         rep = await self._sub_op(osd, MOSDECSubOpRead(
             tid=tid, pg=pg, shard=shard, from_osd=self.id, oid=oid,
-            off=0, length=0, want_attrs=True, epoch=self.epoch,
+            off=off, length=length, want_attrs=True, epoch=self.epoch,
         ), tid)
         if rep.result != 0:
             return None, None, -rep.result
@@ -635,6 +982,9 @@ class OSDDaemon:
             # same guard as _ec_write_full: never mint versions from a
             # shard log this OSD doesn't own
             return MOSDOpReply(tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
+        lg = self._pg_log(self._shard_coll(pool, pg, my_shard))
+        if msg.reqid and msg.reqid in lg.reqids:
+            return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
         version = self._next_version(self._shard_coll(pool, pg, my_shard))
         waits = []
         for shard, osd in enumerate(acting):
@@ -643,7 +993,7 @@ class OSDDaemon:
             if osd == self.id:
                 await self._apply_shard_write_async(
                     pool, pg, shard, msg.oid, b"", {}, delete=True,
-                    version=version,
+                    version=version, reqid=msg.reqid,
                 )
             else:
                 tid = next(self._tids)
@@ -651,6 +1001,7 @@ class OSDDaemon:
                     tid=tid, pg=pg, shard=shard, from_osd=self.id,
                     oid=msg.oid, off=0, data=b"", attrs={},
                     epoch=self.epoch, delete=True, version=version,
+                    reqid=msg.reqid,
                 ), tid))
         if waits:
             await asyncio.gather(*waits)
@@ -669,6 +1020,8 @@ class OSDDaemon:
                 await self._apply_shard_write_async(
                     pool, msg.pg, msg.shard, msg.oid, msg.data, msg.attrs,
                     delete=msg.delete, version=msg.version,
+                    off=msg.off, truncate=msg.truncate,
+                    rmattrs=msg.rmattrs, reqid=msg.reqid,
                 )
         except OSError as e:
             result = -(e.errno or errno.EIO)
@@ -699,32 +1052,185 @@ class OSDDaemon:
 
     # -- replicated backend -------------------------------------------
 
-    async def _rep_op(self, pool, pg, acting, msg) -> MOSDOpReply:
+    async def _rep_read_vector(self, pool, pg, acting, msg) -> MOSDOpReply:
         c = self._shard_coll(pool, pg, NO_SHARD)
         o = ghobject_t(msg.oid)
-        if msg.op == OP_READ:
-            if not self.store.exists(c, o):
-                return MOSDOpReply(tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch)
-            data = self.store.read(c, o, msg.off, msg.length or None)
-            return MOSDOpReply(
-                tid=msg.tid, result=0, data=data, epoch=self.epoch,
-                size=self.store.stat(c, o),
-            )
-        if msg.op == OP_STAT:
-            if not self.store.exists(c, o):
-                return MOSDOpReply(tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch)
-            return MOSDOpReply(
-                tid=msg.tid, result=0, epoch=self.epoch, size=self.store.stat(c, o)
-            )
-        if msg.op not in (OP_WRITE_FULL, OP_DELETE):
-            return MOSDOpReply(tid=msg.tid, result=-errno.EOPNOTSUPP, epoch=self.epoch)
-        delete = msg.op == OP_DELETE
-        version = self._next_version(self._shard_coll(pool, pg, NO_SHARD))
+        if not self.store.exists(c, o):
+            return MOSDOpReply(tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch)
+        size = self.store.stat(c, o)
+        outs: list[tuple[int, bytes, dict[str, bytes]]] = []
+        first_read: bytes | None = None
+        for op in msg.ops:
+            r, d, kv = 0, b"", {}
+            if op.op == OP_READ:
+                d = self.store.read(c, o, op.off, op.length or None)
+                if first_read is None:
+                    first_read = d
+            elif op.op == OP_STAT:
+                pass
+            elif op.op == OP_GETXATTR:
+                try:
+                    d = self.store.getattr(c, o, USER_XATTR_PREFIX + op.name)
+                except KeyError:
+                    r = -errno.ENODATA
+            elif op.op == OP_GETXATTRS:
+                kv = {
+                    name[len(USER_XATTR_PREFIX):]: v
+                    for name, v in self.store.getattrs(c, o).items()
+                    if name.startswith(USER_XATTR_PREFIX)
+                }
+            elif op.op == OP_OMAP_GETKEYS:
+                kv = {k: b"" for k in self.store.omap_get(c, o)}
+            elif op.op == OP_OMAP_GETVALS:
+                kv = self.store.omap_get(c, o)
+            elif op.op == OP_OMAP_GETVALSBYKEYS:
+                kv = self.store.omap_get_values(c, o, op.keys)
+            else:
+                r = -errno.EOPNOTSUPP
+            outs.append((r, d, kv))
+        result = next((r for r, _d, _kv in outs if r != 0), 0)
+        return MOSDOpReply(
+            tid=msg.tid, result=result, epoch=self.epoch, size=size,
+            data=first_read or b"", outs=outs,
+        )
+
+    def _rep_effects(
+        self, c: coll_t, o: ghobject_t, ops
+    ) -> tuple[list, int, bool] | int:
+        """Resolve a client write vector into a deterministic effect
+        vector + final size (the primary's role before MOSDRepOp ships
+        the transaction in the reference).  Returns an errno on guard
+        failure."""
+        from ceph_tpu.msg.messages import OSDOp
+
+        exists = self.store.exists(c, o)
+        size = self.store.stat(c, o) if exists else 0
+        effects: list[OSDOp] = []
+        for op in ops:
+            if op.op == OP_CREATE:
+                if op.off and exists:
+                    return errno.EEXIST
+                exists = True
+                effects.append(OSDOp(OP_CREATE))
+            elif op.op == OP_WRITE_FULL:
+                effects.append(OSDOp(OP_WRITE_FULL, data=op.data))
+                size, exists = len(op.data), True
+            elif op.op == OP_WRITE:
+                effects.append(OSDOp(OP_WRITE, off=op.off, data=op.data))
+                size, exists = max(size, op.off + len(op.data)), True
+            elif op.op == OP_APPEND:
+                effects.append(OSDOp(OP_WRITE, off=size, data=op.data))
+                size, exists = size + len(op.data), True
+            elif op.op == OP_ZERO:
+                end = min(size, op.off + op.length)
+                if op.off < end:
+                    effects.append(OSDOp(OP_ZERO, off=op.off, length=end - op.off))
+                exists = True
+            elif op.op == OP_TRUNCATE:
+                effects.append(OSDOp(OP_TRUNCATE, off=op.off))
+                size, exists = op.off, True
+            elif op.op == OP_SETXATTR:
+                effects.append(OSDOp(OP_SETXATTR, name=op.name, data=op.data))
+                exists = True
+            elif op.op == OP_RMXATTR:
+                effects.append(OSDOp(OP_RMXATTR, name=op.name))
+                exists = True
+            elif op.op == OP_OMAP_SETKEYS:
+                effects.append(OSDOp(OP_OMAP_SETKEYS, kv=op.kv))
+                exists = True
+            elif op.op == OP_OMAP_RMKEYS:
+                effects.append(OSDOp(OP_OMAP_RMKEYS, keys=op.keys))
+                exists = True
+            elif op.op == OP_OMAP_CLEAR:
+                effects.append(OSDOp(OP_OMAP_CLEAR))
+                exists = True
+            elif op.op == OP_DELETE:
+                effects.append(OSDOp(OP_DELETE))
+                exists, size = False, 0
+            else:
+                return errno.EOPNOTSUPP
+        # an object deleted mid-vector and rewritten afterwards is not a
+        # delete; only the final state counts for the log entry
+        return effects, size, not exists
+
+    def _rep_effect_txn(
+        self, pool, pg, oid, effects, attrs, version: eversion_t,
+        delete_final: bool, reqid: str = "",
+    ) -> Transaction:
+        """Build the store transaction for an effect vector + its
+        pg-log entry (primary and replicas run the identical code)."""
+        c = self._shard_coll(pool, pg, NO_SHARD)
+        o = ghobject_t(oid)
+        t = Transaction()
+        self._ensure_coll(t, c)
+        # track existence through the vector: an earlier op in this SAME
+        # transaction may create the object, so a build-time store.exists
+        # check alone would drop a later remove
+        obj_exists = self.store.exists(c, o)
+        for op in effects:
+            if op.op in (OP_CREATE,):
+                t.touch(c, o)
+            elif op.op == OP_WRITE_FULL:
+                t.touch(c, o).truncate(c, o, len(op.data)).write(c, o, 0, op.data)
+            elif op.op == OP_WRITE:
+                t.touch(c, o).write(c, o, op.off, op.data)
+            elif op.op == OP_ZERO:
+                t.zero(c, o, op.off, op.length)
+            elif op.op == OP_TRUNCATE:
+                t.touch(c, o).truncate(c, o, op.off)
+            elif op.op == OP_SETXATTR:
+                t.setattrs(c, o, {USER_XATTR_PREFIX + op.name: op.data})
+            elif op.op == OP_RMXATTR:
+                t.touch(c, o).rmattr(c, o, USER_XATTR_PREFIX + op.name)
+            elif op.op == OP_OMAP_SETKEYS:
+                t.omap_setkeys(c, o, op.kv)
+            elif op.op == OP_OMAP_RMKEYS:
+                t.omap_rmkeys(c, o, op.keys)
+            elif op.op == OP_OMAP_CLEAR:
+                t.omap_clear(c, o)
+            elif op.op == OP_DELETE:
+                if obj_exists:
+                    t.remove(c, o)
+                obj_exists = False
+                continue
+            obj_exists = True
+        if not delete_final:
+            t.setattrs(c, o, attrs)
+        if version > ZERO:
+            lg = self._pg_log(c)
+            if version > lg.info.last_update:
+                prior = self._object_version(c, o)
+                lg.append(t, pg_log_entry_t(
+                    DELETE if delete_final else MODIFY, oid, version, prior,
+                    reqid,
+                ))
+                lg.trim(t, self._log_keep)
+        return t
+
+    async def _rep_write_vector(self, pool, pg, acting, msg) -> MOSDOpReply:
+        c = self._shard_coll(pool, pg, NO_SHARD)
+        o = ghobject_t(msg.oid)
+        lg = self._pg_log(c)
+        if msg.reqid and msg.reqid in lg.reqids:
+            # duplicate of an applied op: answer without re-applying
+            return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
+        resolved = self._rep_effects(c, o, msg.ops)
+        if isinstance(resolved, int):
+            return MOSDOpReply(tid=msg.tid, result=-resolved, epoch=self.epoch)
+        effects, size, delete = resolved
+        version = self._next_version(c)
         attrs = {
-            SIZE_ATTR: str(len(msg.data)).encode(),
+            SIZE_ATTR: str(size).encode(),
             VERSION_ATTR: _v_bytes(version),
         }
-        await self._apply_full_object(pool, pg, msg.oid, msg.data, attrs, delete, version)
+        t = self._rep_effect_txn(
+            pool, pg, msg.oid, effects, attrs, version, delete,
+            reqid=msg.reqid,
+        )
+        if getattr(self.store, "blocking_commit", False):
+            await asyncio.to_thread(self.store.queue_transaction, t)
+        else:
+            self.store.queue_transaction(t)
         waits = []
         for osd in acting:
             if osd in (self.id, CRUSH_ITEM_NONE):
@@ -732,8 +1238,8 @@ class OSDDaemon:
             tid = next(self._tids)
             waits.append(self._sub_op(osd, MOSDRepOp(
                 tid=tid, pg=pg, from_osd=self.id, oid=msg.oid,
-                data=b"" if delete else msg.data, attrs=attrs,
-                delete=delete, epoch=self.epoch, version=version,
+                attrs=attrs, delete=delete, epoch=self.epoch,
+                version=version, ops=effects, reqid=msg.reqid,
             ), tid))
         if waits:
             replies = await asyncio.gather(*waits)
@@ -755,10 +1261,21 @@ class OSDDaemon:
         pool = self.osdmap.get_pg_pool(msg.pg.pool)
         result = 0
         try:
-            await self._apply_full_object(
-                pool, msg.pg, msg.oid, msg.data, msg.attrs, msg.delete,
-                msg.version,
-            )
+            if msg.ops:
+                t = self._rep_effect_txn(
+                    pool, msg.pg, msg.oid, msg.ops, msg.attrs, msg.version,
+                    msg.delete, reqid=msg.reqid,
+                )
+                if getattr(self.store, "blocking_commit", False):
+                    await asyncio.to_thread(self.store.queue_transaction, t)
+                else:
+                    self.store.queue_transaction(t)
+            else:
+                # legacy full-object payload (recovery pushes reuse this)
+                await self._apply_full_object(
+                    pool, msg.pg, msg.oid, msg.data, msg.attrs, msg.delete,
+                    msg.version,
+                )
         except OSError as e:
             result = -(e.errno or errno.EIO)
         await msg.conn.send_message(MOSDRepOpReply(
@@ -1219,10 +1736,15 @@ class OSDDaemon:
                 continue
             if not deep:
                 continue
-            # deep: payload crc vs the stored HashInfo chain
-            hinfo_raw = None
+            # deep: payload crc vs the stored HashInfo chain; RMW'd
+            # objects have no hinfo (the overwrite broke the append
+            # chain) — verify the parity equations instead by
+            # re-encoding the data shards (stronger than a crc chain:
+            # it proves decode(data)==stored parity, the ec_overwrites
+            # scrub gap the reference papers over with CRC omission)
             crcs: dict[str, int] = {}
-            sizes: dict[str, int] = {}
+            payloads: dict[int, bytes] = {}
+            hinfos: dict[int, bytes | None] = {}
             for s, o in pairs:
                 key = f"{s}@osd.{o}"
                 payload, attrs, _e = await self._read_shard(pool, pg, s, o, oid)
@@ -1232,17 +1754,55 @@ class OSDDaemon:
                     })
                     continue
                 crcs[key] = crc32c(payload)
-                sizes[key] = len(payload)
-                if attrs and HINFO_ATTR in attrs:
-                    hinfo_raw = attrs[HINFO_ATTR]
-                if pool.is_erasure() and hinfo_raw:
+                payloads[s] = payload
+                hinfos[s] = (attrs or {}).get(HINFO_ATTR)
+            hinfo_raw = None
+            if pool.is_erasure() and hinfos:
+                present = {h for h in hinfos.values() if h is not None}
+                if len(present) == 1 and all(
+                    h is not None for h in hinfos.values()
+                ):
+                    hinfo_raw = present.pop()
                     hi = ecutil.HashInfo.from_bytes(hinfo_raw)
-                    want = hi.get_chunk_hash(s)
-                    if want != crcs[key]:
-                        inconsistencies.append({
-                            "object": oid, "kind": "deep-crc", "member": key,
-                            "stored": want, "computed": crcs[key],
-                        })
+                    for s, o in pairs:
+                        key = f"{s}@osd.{o}"
+                        if key not in crcs:
+                            continue
+                        want = hi.get_chunk_hash(s)
+                        if want != crcs[key]:
+                            inconsistencies.append({
+                                "object": oid, "kind": "deep-crc",
+                                "member": key,
+                                "stored": want, "computed": crcs[key],
+                            })
+                elif present:
+                    # mixed presence/content: someone kept a chain the
+                    # others dropped (or chains disagree)
+                    inconsistencies.append({
+                        "object": oid, "kind": "deep-hinfo-mismatch",
+                        "members": sorted(
+                            f"{s}" for s, h in hinfos.items() if h is not None
+                        ),
+                    })
+            if pool.is_erasure() and hinfo_raw is None and payloads:
+                ec = self._ec_for(pool)
+                sinfo = self._sinfo(ec)
+                k = ec.get_data_chunk_count()
+                import numpy as _np
+
+                if all(s in payloads for s in range(k)) and len(payloads[0]):
+                    chunks = {
+                        s: _np.frombuffer(payloads[s], _np.uint8)
+                        for s in range(k)
+                    }
+                    logical = ecutil.decode_concat(sinfo, ec, chunks)
+                    expect = ecutil.encode(sinfo, ec, logical)
+                    for s, payload in payloads.items():
+                        if s in expect and expect[s].tobytes() != payload:
+                            inconsistencies.append({
+                                "object": oid, "kind": "deep-parity",
+                                "member": f"{s}",
+                            })
             if not pool.is_erasure() and len(set(crcs.values())) > 1:
                 inconsistencies.append({
                     "object": oid, "kind": "deep-replica-crc",
@@ -1264,16 +1824,23 @@ class OSDDaemon:
             # this with per-object rw locks; we reconcile on the next
             # recovery pass instead)
             c = self._shard_coll(pool, msg.pg, msg.shard)
-            local_v = self._object_version(c, ghobject_t(oid, shard=msg.shard))
+            o = ghobject_t(oid, shard=msg.shard)
+            local_v = self._object_version(c, o)
             pushed_v = _v_parse(attrs.get(VERSION_ATTR))
             if local_v > pushed_v:
                 continue
-            if msg.shard == NO_SHARD:
-                await self._apply_full_object(pool, msg.pg, oid, payload, attrs)
-            else:
-                await self._apply_shard_write_async(
-                    pool, msg.pg, msg.shard, oid, payload, attrs
-                )
+            # a push REPLACES the object: stale local attrs the source
+            # doesn't carry (e.g. a hinfo dropped by an RMW this member
+            # missed) must go, or deep scrub sees a phantom crc chain
+            stale_attrs = []
+            if self.store.exists(c, o):
+                stale_attrs = [
+                    n for n in self.store.getattrs(c, o) if n not in attrs
+                ]
+            await self._apply_shard_write_async(
+                pool, msg.pg, msg.shard, oid, payload, attrs,
+                rmattrs=stale_attrs,
+            )
         await msg.conn.send_message(MOSDPGPushReply(
             pg=msg.pg, shard=msg.shard, from_osd=self.id, epoch=self.epoch,
         ))

@@ -549,9 +549,15 @@ def _diff_dict(old: dict, new: dict, added: dict, removed: list) -> None:
     removed.extend(k for k in old if k not in new)
 
 
-def diff_osdmap(old: OSDMap, new: OSDMap) -> Incremental:
+def diff_osdmap(
+    old: OSDMap,
+    new: OSDMap,
+    old_sections: tuple[bytes | None, bytes] | None = None,
+    new_sections: tuple[bytes | None, bytes] | None = None,
+) -> Incremental:
     """Delta such that apply_incremental(old, delta) == new, verified
-    bit-identical through encode_osdmap."""
+    bit-identical through encode_osdmap.  ``*_sections`` are optional
+    pre-computed :func:`crush_sections` results."""
     inc = Incremental(epoch=new.epoch)
     if new.max_osd != old.max_osd:
         inc.new_max_osd = new.max_osd
@@ -595,26 +601,27 @@ def diff_osdmap(old: OSDMap, new: OSDMap) -> Incremental:
         inc.new_primary_temp, inc.removed_primary_temp,
     )
 
-    def _enc_ca(m: OSDMap) -> bytes | None:
-        if m.choose_args is None:
-            return None
-        e = Encoder()
-        _enc_choose_args(e, m.choose_args)
-        return e.bytes()
-
-    oca, nca = _enc_ca(old), _enc_ca(new)
+    oca, ocr = old_sections if old_sections is not None else crush_sections(old)
+    nca, ncr = new_sections if new_sections is not None else crush_sections(new)
     if oca != nca:
         inc.new_choose_args = nca if nca is not None else b""
-
-    def _enc_crush(m: OSDMap) -> bytes:
-        e = Encoder()
-        encode_crush(e, m.crush)
-        return e.bytes()
-
-    ncr = _enc_crush(new)
-    if _enc_crush(old) != ncr:
+    if ocr != ncr:
         inc.new_crush = ncr
     return inc
+
+
+def crush_sections(m: OSDMap) -> tuple[bytes | None, bytes]:
+    """(choose_args blob | None, crush blob) — the two expensive
+    encodes of diff_osdmap, exposed so a publisher that diffs every
+    epoch can cache them instead of re-encoding both sides each time."""
+    ca = None
+    if m.choose_args is not None:
+        e = Encoder()
+        _enc_choose_args(e, m.choose_args)
+        ca = e.bytes()
+    e = Encoder()
+    encode_crush(e, m.crush)
+    return ca, e.bytes()
 
 
 def apply_incremental(m: OSDMap, inc: Incremental) -> None:

@@ -16,6 +16,7 @@ pg_info (last_update, log_tail).
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
 from ceph_tpu.msg.denc import Decoder, Encoder
@@ -51,33 +52,37 @@ ZERO = eversion_t(0, 0)
 @dataclass(frozen=True)
 class pg_log_entry_t:
     """One ordered op (reference pg_log_entry_t: op, soid, version,
-    prior_version)."""
+    prior_version, reqid — the reqid feeds duplicate-op detection so a
+    client resend of a non-idempotent op is answered, not re-applied)."""
 
     op: int
     oid: str
     version: eversion_t
     prior_version: eversion_t = ZERO
+    reqid: str = ""
 
     def encode(self) -> bytes:
         enc = Encoder()
-        with enc.versioned(1, 1):
+        with enc.versioned(2, 1):
             enc.u8(self.op)
             enc.str_(self.oid)
             enc.u32(self.version.epoch)
             enc.u64(self.version.version)
             enc.u32(self.prior_version.epoch)
             enc.u64(self.prior_version.version)
+            enc.str_(self.reqid)
         return enc.bytes()
 
     @classmethod
     def decode(cls, raw: bytes) -> "pg_log_entry_t":
         dec = Decoder(raw)
-        with dec.versioned():
+        with dec.versioned() as v:
             op = dec.u8()
             oid = dec.str_()
-            v = eversion_t(dec.u32(), dec.u64())
+            ver = eversion_t(dec.u32(), dec.u64())
             pv = eversion_t(dec.u32(), dec.u64())
-        return cls(op, oid, v, pv)
+            reqid = dec.str_() if v >= 2 else ""
+        return cls(op, oid, ver, pv, reqid)
 
 
 @dataclass
@@ -128,13 +133,29 @@ class MissingSet:
 class PGLog:
     """In-memory log + its persistence into the pgmeta omap."""
 
+    #: duplicate-detection window kept past trim (the reference's
+    #: osd_pg_log_dups_tracked analogue)
+    REQID_WINDOW = 2000
+
     def __init__(self, cid: coll_t):
         self.cid = cid
         self.meta = ghobject_t(PGMETA_OID, shard=cid.shard)
         self.info = pg_info_t()
         self.entries: dict[eversion_t, pg_log_entry_t] = {}
+        # reqid -> version of already-applied client ops; survives log
+        # trim in RAM (rebuilt from surviving entries on load, so the
+        # window shrinks to the log length across a restart — the same
+        # bounded-dup contract the reference's dups list provides)
+        self.reqids: "OrderedDict[str, eversion_t]" = OrderedDict()
 
     # -- mutation ------------------------------------------------------
+
+    def _track_reqid(self, entry: pg_log_entry_t) -> None:
+        if entry.reqid:
+            self.reqids[entry.reqid] = entry.version
+            self.reqids.move_to_end(entry.reqid)
+            while len(self.reqids) > self.REQID_WINDOW:
+                self.reqids.popitem(last=False)
 
     def append(self, t: Transaction, entry: pg_log_entry_t) -> None:
         """Record one op; caller folds ``t`` into the data transaction
@@ -144,6 +165,7 @@ class PGLog:
         )
         self.entries[entry.version] = entry
         self.info.last_update = entry.version
+        self._track_reqid(entry)
         t.touch(self.cid, self.meta)
         t.omap_setkeys(self.cid, self.meta, {
             LOG_KEY_PREFIX + entry.version.key(): entry.encode(),
@@ -199,6 +221,8 @@ class PGLog:
             if key.startswith(LOG_KEY_PREFIX):
                 e = pg_log_entry_t.decode(raw)
                 self.entries[e.version] = e
+        for v in sorted(self.entries):
+            self._track_reqid(self.entries[v])
 
     # -- peering math --------------------------------------------------
 

@@ -26,10 +26,26 @@ class Oracle:
     """The model: what a correct cluster must serve."""
 
     def __init__(self):
-        self.objects: dict[str, bytes] = {}
+        self.objects: dict[str, bytearray] = {}
 
     def write(self, oid, data):
-        self.objects[oid] = data
+        self.objects[oid] = bytearray(data)
+
+    def write_at(self, oid, off, data):
+        cur = self.objects.setdefault(oid, bytearray())
+        if len(cur) < off + len(data):
+            cur.extend(b"\0" * (off + len(data) - len(cur)))
+        cur[off : off + len(data)] = data
+
+    def append(self, oid, data):
+        self.objects.setdefault(oid, bytearray()).extend(data)
+
+    def truncate(self, oid, size):
+        cur = self.objects.setdefault(oid, bytearray())
+        if size <= len(cur):
+            del cur[size:]
+        else:
+            cur.extend(b"\0" * (size - len(cur)))
 
     def delete(self, oid):
         self.objects.pop(oid, None)
@@ -40,17 +56,31 @@ async def model_run(c: Cluster, io, rng: random.Random, n_ops: int, oracle: Orac
     for opno in range(n_ops):
         oid = rng.choice(oids)
         op = rng.random()
-        if op < 0.45:
+        if op < 0.30:
             data = bytes([rng.randrange(256)]) * rng.randrange(1, 30000)
             await io.write_full(oid, data)
             oracle.write(oid, data)
+        elif op < 0.42:
+            # partial overwrite at arbitrary offset (the EC RMW path)
+            off = rng.randrange(0, 30000)
+            data = bytes([rng.randrange(256)]) * rng.randrange(1, 15000)
+            await io.write(oid, data, off=off)
+            oracle.write_at(oid, off, data)
+        elif op < 0.50:
+            data = bytes([rng.randrange(256)]) * rng.randrange(1, 10000)
+            await io.append(oid, data)
+            oracle.append(oid, data)
         elif op < 0.55 and oid in oracle.objects:
+            size = rng.randrange(0, 30000)
+            await io.truncate(oid, size)
+            oracle.truncate(oid, size)
+        elif op < 0.62 and oid in oracle.objects:
             await io.remove(oid)
             oracle.delete(oid)
-        elif op < 0.85:
+        elif op < 0.88:
             if oid in oracle.objects:
                 got = await io.read(oid)
-                assert got == oracle.objects[oid], (
+                assert got == bytes(oracle.objects[oid]), (
                     f"op {opno}: read {oid!r}: {len(got)}B != "
                     f"{len(oracle.objects[oid])}B expected"
                 )
@@ -114,7 +144,7 @@ class TestRadosModel:
                 # settle: recovery converges, then every object checks out
                 await asyncio.sleep(1.5)
                 for oid, data in oracle.objects.items():
-                    assert await io.read(oid) == data
+                    assert await io.read(oid) == bytes(data)
                 # deep scrub every pg: no inconsistencies survive churn
                 import json
 

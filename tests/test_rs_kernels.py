@@ -74,6 +74,42 @@ def test_pallas_path_interpret_mode(rng):
     assert np.array_equal(np.asarray(got), want)
 
 
+@pytest.mark.parametrize("k,m,g", [(8, 3, 2), (4, 2, 2), (4, 2, 4), (6, 4, 2)])
+def test_grouped_pallas_interpret_mode(rng, k, m, g):
+    """The block-diagonal grouped kernel (the auto-selected TPU path for
+    large S) is bit-exact vs the host reference, encode and decode."""
+    import jax
+
+    C = mx.isa_cauchy_matrix(k, m)
+    codec = rk.BitmatrixCodec(C)
+    D = rng.integers(0, 256, (k, 4096), dtype=np.uint8)
+    want = gf.gf_matmul(C, D)
+    got = rk.gf_bitmatmul_pallas_grouped(
+        codec.encode_bits, jax.numpy.asarray(D), tile_s=512, groups=g,
+        interpret=True,
+    )
+    assert np.array_equal(np.asarray(got), want)
+    # decode through the grouped kernel too (erasure of one data, one
+    # parity chunk)
+    P = np.asarray(codec.encode(D))
+    chunks = np.concatenate([D, P], axis=0)
+    survivors, dbits = codec.decode_bits((0, k))
+    rec = rk.gf_bitmatmul_pallas_grouped(
+        dbits, jax.numpy.asarray(chunks[survivors]), tile_s=512, groups=g,
+        interpret=True,
+    )
+    assert np.array_equal(np.asarray(rec), chunks[[0, k]])
+
+
+def test_grouped_autoselect_bounds():
+    """_pick_groups caps at full MXU width and even tiling."""
+    assert rk._pick_groups(8, 3, 2**20, 2**14) == 2
+    assert rk._pick_groups(4, 2, 2**20, 2**14) == 4
+    assert rk._pick_groups(16, 4, 2**20, 2**14) == 1
+    # odd tile count: g must divide the grid
+    assert rk._pick_groups(8, 3, 3 * 2**14, 2**14) == 1
+
+
 def test_decode_unsorted_erasures_row_order():
     rng = np.random.default_rng(9)
     codec = rk.BitmatrixCodec(mx.isa_cauchy_matrix(8, 3))

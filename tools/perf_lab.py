@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Kernel perf lab: isolate where RS-encode time goes on the chip.
+
+Run on the real chip:  python tools/perf_lab.py
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+from ceph_tpu.models import isa_cauchy_matrix
+from ceph_tpu.ops import rs_kernels as rk
+
+K, M = 8, 3
+S = 64 * 2**20
+TILE = 262144
+
+
+def timed_calls(name, fn, data, n=10, reps=3):
+    """Time fn(data) dispatched n times back-to-back (no dependency)."""
+    out = fn(data)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        outs = [fn(data) for _ in range(n)]
+        jax.block_until_ready(outs)
+        best = min(best, (time.perf_counter() - t0) / n)
+    gbs = (K * S) / best / 1e9
+    print(f"{name:44s} {best*1e3:8.2f} ms  {gbs:8.2f} GB/s", flush=True)
+    return gbs
+
+
+def timed_chain(name, body_fn, data, n=10, reps=3):
+    """Time a fori_loop whose body is body_fn(d) -> d (dependency chain)."""
+    @jax.jit
+    def chain(d):
+        return lax.fori_loop(0, n, lambda i, d: body_fn(d), d)
+
+    out = chain(data)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = chain(data)
+        jax.block_until_ready(out)
+        _ = np.asarray(out[0, :8])
+        best = min(best, (time.perf_counter() - t0) / n)
+    gbs = (K * S) / best / 1e9
+    print(f"{name:44s} {best*1e3:8.2f} ms  {gbs:8.2f} GB/s", flush=True)
+    return gbs
+
+
+def copy_fn(d, tile=TILE):
+    def kern(d_ref, o_ref):
+        o_ref[:] = d_ref[0:M, :]
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((M, d.shape[1]), jnp.uint8),
+        grid=(d.shape[1] // tile,),
+        in_specs=[pl.BlockSpec((K, tile), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((M, tile), lambda i: (0, i)),
+    )(d)
+
+
+def main():
+    codec = rk.BitmatrixCodec(isa_cauchy_matrix(K, M))
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.integers(0, 256, (K, S), dtype=np.uint8))
+    big = jnp.asarray(rng.integers(0, 256, (1024, 2**19), dtype=np.uint8))  # 512MB fat
+    jax.block_until_ready((data, big))
+
+    enc = jax.jit(lambda d: rk.gf_bitmatmul_pallas(codec.encode_bits, d, tile_s=TILE))
+    enc_xla = jax.jit(lambda d: rk.gf_bitmatmul(codec.encode_bits, d))
+
+    # 1. chain-overhead only: xor-fold with a slice of d itself (no kernel)
+    timed_chain("chain xor-fold only (no kernel)",
+                lambda d: d.at[0:1, :].set(d[0:1, :] ^ d[1:2, :]), data)
+    # 2. bare copy kernel, independent dispatches
+    timed_calls("copy kernel, no chain", copy_fn, data)
+    # 3. bare encode kernel, independent dispatches
+    timed_calls("encode pallas, no chain", enc, data)
+    # 4. encode + chain (bench.py config)
+    timed_chain("encode pallas + xor-fold chain (bench.py)",
+                lambda d: d.at[0:1, :].set(d[0:1, :] ^ enc(d)[0:1, :]), data)
+    # 5. cheap chain: fold only 128 lanes
+    timed_chain("encode pallas + 128-lane fold chain",
+                lambda d: d.at[0:1, 0:128].set(d[0:1, 0:128] ^ enc(d)[0:1, 0:128]),
+                data)
+    # 6. XLA (non-pallas) encode
+    timed_calls("encode XLA path, no chain", enc_xla, data, n=3)
+    # 7. fat-shape copy roofline: (1024, 512Ki) u8 copy of first 384 rows
+    def fat_copy(d):
+        def kern(d_ref, o_ref):
+            o_ref[:] = d_ref[:]
+        t = 2048
+        return pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct((384, d.shape[1]), jnp.uint8),
+            grid=(d.shape[1] // t,),
+            in_specs=[pl.BlockSpec((384, t), lambda i: (0, i))],
+            out_specs=pl.BlockSpec((384, t), lambda i: (0, i)),
+        )(d)
+    out = fat_copy(big)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        outs = [fat_copy(big) for _ in range(10)]
+        jax.block_until_ready(outs)
+        best = min(best, (time.perf_counter() - t0) / 10)
+    traf = (384 + 384) * 2**19 / best / 1e9
+    print(f"{'fat copy (384x512Ki r+w traffic GB/s)':44s} {best*1e3:8.2f} ms  {traf:8.2f} GB/s", flush=True)
+    # 8. tile sweep on encode
+    for tile in (65536, 131072, 262144):
+        e = jax.jit(lambda d, t=tile: rk.gf_bitmatmul_pallas(codec.encode_bits, d, tile_s=t))
+        timed_calls(f"encode pallas tile={tile}", e, data, n=5)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
