@@ -74,6 +74,16 @@ declare(
            "(0 disables the sweep)"),
     Option("mon_osd_down_out_interval", float, 0.0, LEVEL_ADVANCED,
            "seconds down before an osd is marked out (0 disables)"),
+    Option("osd_heartbeat_interval", float, 1.0, LEVEL_ADVANCED,
+           "seconds between osd<->osd liveness pings (0 disables; "
+           "the reference's osd_heartbeat_interval, OSD.cc:5735)",
+           min=0.0),
+    Option("osd_heartbeat_grace", float, 20.0, LEVEL_ADVANCED,
+           "seconds without a ping reply before a peer is reported "
+           "failed to the mon", min=0.1),
+    Option("mon_osd_min_down_reporters", int, 1, LEVEL_ADVANCED,
+           "distinct failure reporters required before the mon marks "
+           "an osd down", min=1),
     Option("osd_min_pg_log_entries", int, 128, LEVEL_ADVANCED,
            "pg log entries kept per shard", min=1,
            see_also=("osd_max_pg_log_entries",)),

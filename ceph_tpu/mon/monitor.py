@@ -60,10 +60,20 @@ class Monitor:
         out_interval: float = 0.0,
         rank: int = 0,
         n_mons: int = 1,
+        store=None,
+        min_down_reporters: int | None = None,
+        paxos_trim_max: int = 500,
+        paxos_trim_keep: int = 250,
+        conf=None,
     ):
         """``beacon_grace``/``out_interval``: seconds without a beacon
         before an OSD is marked down / out; 0 disables the sweep (tests
         drive failure via MOSDFailure or commands).
+
+        ``store``: an ObjectStore giving the monitor MonitorDBStore-like
+        durability — paxos promises/commits persist there and a restart
+        replays snapshot + committed tail (pass a FileStore for a
+        monitor that survives kill -9).  None = volatile.
 
         Multi-monitor quorums: construct each member with its ``rank``
         and the total ``n_mons``, ``start()`` them all, then call
@@ -71,6 +81,7 @@ class Monitor:
         rank-based election picks a leader and all state mutations
         replicate through Paxos (ceph_tpu/mon/paxos.py)."""
         from ceph_tpu.mon.paxos import Paxos
+        from ceph_tpu.mon.store import MonStore
 
         self.rank = rank
         self.n_mons = n_mons
@@ -79,7 +90,27 @@ class Monitor:
         self.messenger = Messenger(
             ("mon", rank), self._dispatch, on_reset=self._on_reset
         )
-        self.paxos = Paxos(rank, n_mons, self._send_mon, self._apply_committed)
+        self.store = MonStore(store) if store is not None else None
+        self.paxos = Paxos(
+            rank, n_mons, self._send_mon, self._apply_committed,
+            store=self.store,
+            get_snapshot=self._state_snapshot,
+            install_snapshot=self._install_snapshot,
+        )
+        self._state_version = 0
+        if conf is None:
+            from ceph_tpu.common import ConfigProxy
+
+            conf = ConfigProxy()
+        self.conf = conf
+        self.min_down_reporters = (
+            min_down_reporters if min_down_reporters is not None
+            else conf["mon_osd_min_down_reporters"]
+        )
+        self.paxos_trim_max = paxos_trim_max
+        self.paxos_trim_keep = paxos_trim_keep
+        # failed osd -> {reporter: report time} (OSDMonitor failure_info)
+        self._failure_reports: dict[int, dict[int, float]] = {}
         self.beacon_grace = beacon_grace
         self.out_interval = out_interval
         self._epoch_blobs: dict[int, bytes] = {}
@@ -90,6 +121,9 @@ class Monitor:
         # derived replicated state: last boot incarnation per osd
         # (applied deterministically by every member in _apply_op)
         self._osd_incarnation: dict[int, int] = {}
+        # epoch at which each osd was last marked up (up_from): failure
+        # reports older than this are from before the reboot
+        self._up_from: dict[int, int] = {}
         self._pool_ids: dict[str, int] = {}
         self._next_pool = 1
         self._tids = itertools.count(1)
@@ -102,9 +136,98 @@ class Monitor:
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
         self.addr = await self.messenger.bind(host, port)
+        await self._replay()
         if self.beacon_grace > 0:
             self._tick_task = asyncio.ensure_future(self._tick())
         return self.addr
+
+    async def _replay(self) -> None:
+        """Restart recovery: install the persisted snapshot (if any),
+        then re-apply the committed tail in paxos order — the
+        MonitorDBStore replay that makes a mon restart lossless."""
+        if self.store is None:
+            return
+        st = self.store.load()
+        self._replaying = True
+        try:
+            if st["snapshot"] is not None and st["snapshot"][0] > 0:
+                await self._install_snapshot(*st["snapshot"], publish=False)
+            for v in sorted(self.paxos.values):
+                if v > self._state_version and self.paxos.values[v]:
+                    await self._apply_committed(v, self.paxos.values[v])
+        finally:
+            self._replaying = False
+        await self._maybe_trim()
+
+    # -- state-machine snapshots (trim / full-sync / restart) ----------
+
+    def _state_snapshot(self) -> bytes:
+        """Everything _apply_op derives, at _state_version."""
+        import json
+
+        from ceph_tpu.msg.denc import Encoder
+
+        enc = Encoder()
+        enc.u64(self._state_version)
+        enc.bytes_(encode_osdmap(self.osdmap))
+        enc.str_(json.dumps({
+            "pool_ids": self._pool_ids,
+            "next_pool": self._next_pool,
+            "incarnations": {
+                str(k): v for k, v in self._osd_incarnation.items()
+            },
+            "up_from": {str(k): v for k, v in self._up_from.items()},
+        }))
+        return enc.bytes()
+
+    async def _install_snapshot(
+        self, version: int, blob: bytes, publish: bool = True
+    ) -> None:
+        import json
+
+        from ceph_tpu.msg.denc import Decoder
+
+        dec = Decoder(blob)
+        snap_version = dec.u64()
+        self.osdmap = decode_osdmap(dec.bytes_())
+        aux = json.loads(dec.str_())
+        self._pool_ids = dict(aux["pool_ids"])
+        self._next_pool = aux["next_pool"]
+        self._osd_incarnation = {
+            int(k): v for k, v in aux["incarnations"].items()
+        }
+        self._up_from = {
+            int(k): v for k, v in aux.get("up_from", {}).items()
+        }
+        self._state_version = max(version, snap_version)
+        self._epoch_blobs = {}
+        self._epoch_incs = {}
+        self._prev_snapshot = None
+        self._snapshot()
+        if publish:
+            await self._publish()
+
+    async def _maybe_trim(self) -> None:
+        """Bound the committed log: snapshot the state machine, then
+        drop values older than the keep window (Paxos::trim)."""
+        if getattr(self, "_replaying", False):
+            # NEVER trim mid-replay: ``below`` derives from the final
+            # last_committed, so trimming here would delete committed
+            # ops the replay loop has not applied yet — both from RAM
+            # (KeyError on the next iteration) and, worse, durably
+            return
+        px = self.paxos
+        if len(px.values) <= self.paxos_trim_max:
+            return
+        below = px.last_committed - self.paxos_trim_keep + 1
+        if self.store is not None:
+            await self.store.put_snapshot(
+                self._state_version, self._state_snapshot()
+            )
+        px.values = {v: b for v, b in px.values.items() if v >= below}
+        px.first_committed = below
+        if self.store is not None:
+            await self.store.trim_values(below)
 
     async def open_quorum(self, monmap: list[tuple[str, int]]) -> None:
         """Join the quorum: learn everyone's address, run an election
@@ -141,10 +264,32 @@ class Monitor:
         if (
             peer is not None
             and peer[0] == "mon"
-            and self.paxos.leader == peer[1]
             and self.n_mons > 1
+            and (
+                self.paxos.leader == peer[1]
+                # a leader losing ANY voting-quorum member must re-form
+                # the quorum, or BEGINs starve waiting on the dead vote
+                or (self.paxos.is_leader and peer[1] in self.paxos.quorum)
+            )
         ):
-            log.info("mon.%d: leader mon.%d lost; electing", self.rank, peer[1])
+            if not self.paxos.stable.is_set():
+                return  # already electing: don't stack another round
+            # both sides dial each other, so duplicate-connection
+            # teardown is routine — only elect if the leader is truly
+            # unreachable (a false election churns accepted_pn under
+            # in-flight BEGINs and stalls proposes for their timeout)
+            try:
+                if peer[1] < len(self.monmap):
+                    await self.messenger.connect_to(
+                        ("mon", peer[1]), *self.monmap[peer[1]]
+                    )
+                    return  # reconnected: not a leader loss
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                pass
+            log.info(
+                "mon.%d: quorum peer mon.%d lost; electing",
+                self.rank, peer[1],
+            )
             await self.paxos.start_election()
 
     async def _apply_committed(self, version: int, value: bytes) -> None:
@@ -152,13 +297,32 @@ class Monitor:
 
         op = json.loads(value.decode())
         await self._apply_op(op)
+        self._state_version = version
+        await self._maybe_trim()
 
     async def _propose(self, op: dict) -> None:
         """Replicate one state mutation through Paxos (leader only;
-        single-mon quorums commit immediately)."""
+        single-mon quorums commit immediately).  One retry after a
+        mid-propose election (quorum-member loss): every replicated op
+        is replay-idempotent, so a rare double-commit is harmless."""
         import json
 
-        await self.paxos.propose(json.dumps(op).encode())
+        value = json.dumps(op).encode()
+        last: Exception | None = None
+        for _attempt in range(5):
+            try:
+                await self.paxos.propose(value)
+                return
+            except ConnectionError as e:
+                last = e
+                try:
+                    await asyncio.wait_for(self.paxos.stable.wait(), 10)
+                except asyncio.TimeoutError:
+                    raise e
+                if not self.is_leader:
+                    raise
+                await asyncio.sleep(0.05)
+        raise last
 
     @property
     def is_leader(self) -> bool:
@@ -269,6 +433,7 @@ class Monitor:
         log.info("mon: osd.%d booted at %s:%d", m.osd, m.host, m.port)
         self._last_beacon[m.osd] = time.monotonic()
         self._down_at.pop(m.osd, None)
+        self._failure_reports.pop(m.osd, None)
         await self._propose({
             "op": "boot", "osd": m.osd, "host": m.host, "port": m.port,
             "weight": m.weight, "incarnation": m.incarnation,
@@ -280,10 +445,32 @@ class Monitor:
             return
         om = self.osdmap
         if 0 <= m.failed < om.max_osd and om.is_up(m.failed):
+            if m.epoch < self._up_from.get(m.failed, 0):
+                # the report predates the target's latest boot: a
+                # straggler from before the reboot, not fresh evidence
+                # (OSDMonitor::check_failure vs up_from)
+                return
+            now = time.monotonic()
+            reporters = self._failure_reports.setdefault(m.failed, {})
+            reporters[m.reporter] = now
+            # expire stale reports (the reference ages failure_info by
+            # grace; 60 s here)
+            for r, t0 in list(reporters.items()):
+                if now - t0 > 60.0:
+                    del reporters[r]
+            if len(reporters) < self.min_down_reporters:
+                log.info(
+                    "mon: osd.%d failure report %d/%d (from osd.%d)",
+                    m.failed, len(reporters), self.min_down_reporters,
+                    m.reporter,
+                )
+                return
             log.info(
-                "mon: osd.%d reported failed by osd.%d", m.failed, m.reporter
+                "mon: osd.%d reported failed by %s", m.failed,
+                sorted(reporters),
             )
-            self._down_at[m.failed] = time.monotonic()
+            self._failure_reports.pop(m.failed, None)
+            self._down_at[m.failed] = now
             await self._propose({"op": "down", "osd": m.failed})
 
     # -- the replicated state machine ----------------------------------
@@ -316,6 +503,7 @@ class Monitor:
             self._osd_incarnation[osd] = inc
             om.new_osd(osd, weight=op["weight"], up=True)
             om.osd_addrs[osd] = addr
+            self._up_from[osd] = om.epoch + 1  # the epoch this op creates
         elif kind == "down":
             if not (0 <= op["osd"] < om.max_osd) or not om.is_up(op["osd"]):
                 return  # no-op: no epoch bump

@@ -41,8 +41,10 @@ log = logging.getLogger("ceph_tpu.mon.paxos")
 
 # election ops (MMonElection)
 PROPOSE, ACK, VICTORY = 1, 2, 3
-# paxos ops (MMonPaxos); FETCH = straggler catch-up request
-COLLECT, LAST, BEGIN, ACCEPT, COMMIT, FETCH = 1, 2, 3, 4, 5, 6
+# paxos ops (MMonPaxos); FETCH = straggler catch-up request; SYNC = a
+# state-machine snapshot for peers older than the trimmed log tail (the
+# reference's store full-sync, src/mon/Monitor.cc sync_start)
+COLLECT, LAST, BEGIN, ACCEPT, COMMIT, FETCH, SYNC, NACK = 1, 2, 3, 4, 5, 6, 7, 8
 
 
 class MMonElection(Message):
@@ -105,11 +107,19 @@ class Paxos:
         n_ranks: int,
         send: Callable[[int, Message], Awaitable[None]],
         on_commit: Callable[[int, bytes], Awaitable[None]],
+        store=None,
+        get_snapshot: Callable[[], bytes] | None = None,
+        install_snapshot: Callable[[int, bytes], Awaitable[None]] | None = None,
     ):
         self.rank = rank
         self.n_ranks = n_ranks
         self._send = send
         self._on_commit = on_commit
+        # durable backing (MonStore) + state-machine snapshot hooks for
+        # trim/full-sync; None = volatile (tests)
+        self.store = store
+        self._get_snapshot = get_snapshot
+        self._install_snapshot = install_snapshot
         # election state
         self.election_epoch = 1  # odd = electing
         self.leader: int | None = None
@@ -120,9 +130,27 @@ class Paxos:
         self.last_pn = 0
         self.accepted_pn = 0
         self.last_committed = 0
+        self.first_committed = 1  # log tail (values below were trimmed)
         self.values: dict[int, bytes] = {}     # committed log
         self._uncommitted: tuple[int, bytes] | None = None
         self._uncommitted_pn = 0  # pn the uncommitted value was accepted under
+        if self.store is not None:
+            st = self.store.load()
+            self.accepted_pn = st["accepted_pn"]
+            self.last_pn = st["last_pn"]
+            # rejoin near the quorum's election epoch instead of from 1
+            # (the reference Elector persists its epoch the same way);
+            # stale-epoch PROPOSEs from a rebooted member churn every
+            # peer through a useless election round otherwise
+            self.election_epoch = max(1, st.get("election_epoch", 1))
+            self.last_committed = st["last_committed"]
+            self.first_committed = max(1, st["first_committed"])
+            self.values = st["values"]
+            if st["uncommitted"] is not None:
+                uv, upn, ublob = st["uncommitted"]
+                if uv > self.last_committed:
+                    self._uncommitted = (uv, ublob)
+                    self._uncommitted_pn = upn
         self._accepts: set[int] = set()
         self._propose_version = 0  # version the in-flight BEGIN carries
         self._collect_replies: dict[int, MMonPaxos] = {}
@@ -156,6 +184,8 @@ class Paxos:
         else:
             self.election_epoch += 2
         self._election_acks = {self.rank}
+        if self.store is not None:
+            await self.store.put_election_epoch(self.election_epoch)
         log.info("mon.%d: starting election e%d", self.rank, self.election_epoch)
         for r in range(self.n_ranks):
             if r != self.rank:
@@ -215,6 +245,8 @@ class Paxos:
                 self.leader = None
                 self._electing = False
                 self.election_epoch = max(self.election_epoch, msg.epoch)
+                if self.store is not None:
+                    await self.store.put_election_epoch(self.election_epoch)
                 await self._maybe_send(from_rank, MMonElection(
                     ACK, msg.epoch, self.rank
                 ))
@@ -233,6 +265,8 @@ class Paxos:
             if msg.epoch < self.election_epoch:
                 return  # stale victory
             self.election_epoch = msg.epoch
+            if self.store is not None:
+                await self.store.put_election_epoch(self.election_epoch)
             self.leader = from_rank
             self._electing = False
             self.quorum = set()  # peons don't track the full quorum
@@ -253,6 +287,8 @@ class Paxos:
         ) * 100 + self.rank
         pn = self.last_pn
         self.accepted_pn = pn
+        if self.store is not None:
+            await self.store.put_pns(self.accepted_pn, self.last_pn)
         self._collect_replies = {}
         for r in self.quorum:
             if r != self.rank:
@@ -355,15 +391,30 @@ class Paxos:
             self._phase_done = asyncio.Event()
             self._uncommitted = (version, value)
             self._uncommitted_pn = pn
+            if self.store is not None:
+                await self.store.put_uncommitted(version, pn, value)
             for r in self.quorum:
                 if r != self.rank:
                     await self._maybe_send(r, MMonPaxos(
                         BEGIN, pn, version, value, self.last_committed
                     ))
-            try:
-                await asyncio.wait_for(self._phase_done.wait(), 10)
-            except asyncio.TimeoutError:
-                raise ConnectionError("paxos begin timed out (lost quorum?)")
+            deadline = asyncio.get_running_loop().time() + 10
+            while not self._phase_done.is_set():
+                if not self.is_leader or self.accepted_pn != pn:
+                    # a re-election raced this BEGIN: its pn is dead and
+                    # no peon will accept it — fail fast so the caller
+                    # retries under the new term instead of burning the
+                    # full timeout
+                    raise ConnectionError("paxos term changed mid-propose")
+                remaining = deadline - asyncio.get_running_loop().time()
+                if remaining <= 0:
+                    raise ConnectionError("paxos begin timed out (lost quorum?)")
+                try:
+                    await asyncio.wait_for(
+                        self._phase_done.wait(), min(0.1, remaining)
+                    )
+                except asyncio.TimeoutError:
+                    continue
             # commit: broadcast to every rank (stragglers outside the
             # voting quorum stay consistent; gaps trigger FETCH)
             await self._commit_local(version, value)
@@ -380,14 +431,30 @@ class Paxos:
         self.values[version] = value
         self.last_committed = version
         self._uncommitted = None
+        if self.store is not None:
+            # durable before applied: a crash between the two replays
+            # the value on restart (apply is idempotent/deterministic)
+            await self.store.put_commit(version, value)
         await self._on_commit(version, value)
         if not self.caught_up.is_set() and version >= self._catchup_target:
             self.caught_up.set()
 
     async def handle_paxos(self, msg: MMonPaxos, from_rank: int) -> None:
         if msg.op == COLLECT:
+            if msg.pn < self.accepted_pn:
+                # we promised a higher pn (e.g. to a transient leader
+                # that lost the next election): silence would starve
+                # this leader's term — tell it to re-collect higher
+                await self._maybe_send(from_rank, MMonPaxos(
+                    NACK, self.accepted_pn, 0, b"", self.last_committed
+                ))
+                return
             if msg.pn >= self.accepted_pn:
                 self.accepted_pn = msg.pn
+                if self.store is not None:
+                    # promise durably: a restarted peon must not accept
+                    # an older pn it already promised against
+                    await self.store.put_pns(self.accepted_pn, self.last_pn)
                 un_v, un_val = self._uncommitted or (0, b"")
                 await self._maybe_send(from_rank, MMonPaxos(
                     LAST, msg.pn, un_v, un_val, self.last_committed,
@@ -399,10 +466,20 @@ class Paxos:
                 if len(self._collect_replies) >= len(self.quorum) - 1:
                     await self._finish_collect()
         elif msg.op == BEGIN:
+            if msg.pn < self.accepted_pn:
+                await self._maybe_send(from_rank, MMonPaxos(
+                    NACK, self.accepted_pn, 0, b"", self.last_committed
+                ))
+                return
             if msg.pn >= self.accepted_pn:
                 self.accepted_pn = msg.pn
                 self._uncommitted = (msg.version, msg.value)
                 self._uncommitted_pn = msg.pn
+                if self.store is not None:
+                    # persist BEFORE the accept leaves this process:
+                    # the leader counts us toward majority on it
+                    await self.store.put_pns(self.accepted_pn, self.last_pn)
+                    await self.store.put_uncommitted(msg.version, msg.pn, msg.value)
                 await self._maybe_send(from_rank, MMonPaxos(
                     ACCEPT, msg.pn, msg.version, b"", self.last_committed
                 ))
@@ -429,10 +506,47 @@ class Paxos:
                 await self._maybe_send(from_rank, MMonPaxos(
                     FETCH, msg.pn, 0, b"", self.last_committed
                 ))
+        elif msg.op == NACK:
+            if self.is_leader and msg.pn > self.accepted_pn:
+                # a quorum member promised someone a higher pn: restart
+                # phase 1 above it (Paxos::handle_collect/begin NAK ->
+                # collect(oldpn+1) in the reference)
+                log.info(
+                    "mon.%d: pn %d NACKed (peer at %d); re-collecting",
+                    self.rank, self.accepted_pn, msg.pn,
+                )
+                self.last_pn = max(self.last_pn, msg.pn)
+                await self._leader_collect()
         elif msg.op == FETCH:
+            if (
+                msg.last_committed + 1 < self.first_committed
+                and self._get_snapshot is not None
+            ):
+                # the peer predates our trimmed tail: ship a state
+                # snapshot at our last_committed (store full-sync)
+                await self._maybe_send(from_rank, MMonPaxos(
+                    SYNC, self.accepted_pn, self.last_committed,
+                    self._get_snapshot(), self.last_committed,
+                ))
+                return
             for v in range(msg.last_committed + 1, self.last_committed + 1):
                 if v in self.values:
                     await self._maybe_send(from_rank, MMonPaxos(
                         COMMIT, self.accepted_pn, v, self.values[v],
                         self.last_committed,
                     ))
+        elif msg.op == SYNC:
+            if msg.version > self.last_committed and self._install_snapshot:
+                await self._install_snapshot(msg.version, msg.value)
+                self.last_committed = msg.version
+                self.first_committed = msg.version + 1
+                self.values = {
+                    v: b for v, b in self.values.items() if v > msg.version
+                }
+                self._uncommitted = None
+                if self.store is not None:
+                    await self.store.put_snapshot(msg.version, msg.value)
+                    await self.store.put_commit(msg.version, b"")
+                    await self.store.trim_values(msg.version + 1)
+                if not self.caught_up.is_set() and msg.version >= self._catchup_target:
+                    self.caught_up.set()

@@ -816,6 +816,38 @@ class MOSDPGLogAck(Message):
         return cls(tid, pg, shard, dec.i32(), dec.i32(), dec.u32())
 
 
+# -- heartbeats (src/messages/MOSDPing.h) -----------------------------------
+
+PING = 1
+PING_REPLY = 2
+
+
+class MOSDPing(Message):
+    """osd <-> osd liveness ping (reference MOSDPing over the front/back
+    heartbeat messengers, OSD::handle_osd_ping src/osd/OSD.cc:5735).
+    ``stamp`` echoes back so the sender can compute RTT."""
+
+    TYPE = 70
+
+    def __init__(
+        self, op: int = PING, from_osd: int = 0, epoch: int = 0,
+        stamp: int = 0,
+    ):
+        self.op, self.from_osd, self.epoch, self.stamp = (
+            op, from_osd, epoch, stamp,
+        )
+
+    def encode_payload(self, enc):
+        enc.u8(self.op)
+        enc.i32(self.from_osd)
+        enc.u32(self.epoch)
+        enc.u64(self.stamp)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        return cls(dec.u8(), dec.i32(), dec.u32(), dec.u64())
+
+
 # -- scrub (src/messages/MOSDScrub2.h) --------------------------------------
 
 class MOSDScrub(Message):

@@ -44,6 +44,8 @@ import numpy as np
 from ceph_tpu.crush.types import CRUSH_ITEM_NONE
 from ceph_tpu.ec import registry as ec_registry
 from ceph_tpu.msg.messages import (
+    PING,
+    PING_REPLY,
     MMonSubscribe,
     MOSDBeacon,
     MOSDBoot,
@@ -53,6 +55,7 @@ from ceph_tpu.msg.messages import (
     MOSDECSubOpWriteReply,
     MOSDFailure,
     MOSDMap,
+    MOSDPing,
     MOSDOp,
     MOSDOpReply,
     MOSDPGPush,
@@ -184,6 +187,12 @@ class OSDDaemon:
         self._ec_cache: dict[str, object] = {}
         self._pg_logs: dict[coll_t, PGLog] = {}
         self._beacon_task: asyncio.Task | None = None
+        self._hb_task: asyncio.Task | None = None
+        # peer heartbeat state (handle_osd_ping analogue)
+        self._hb_last_reply: dict[int, float] = {}
+        self._hb_first_ping: dict[int, float] = {}
+        self._hb_reported: dict[int, float] = {}
+        self.drop_pings = False  # test hook: simulate a silent partition
         self._recovery_task: asyncio.Task | None = None
         self._map_event = asyncio.Event()
         self.stopping = False
@@ -199,6 +208,8 @@ class OSDDaemon:
         await self._mon_hunt()
         if self.beacon_interval > 0:
             self._beacon_task = asyncio.ensure_future(self._beacon())
+        if self.conf["osd_heartbeat_interval"] > 0:
+            self._hb_task = asyncio.ensure_future(self._heartbeat())
         # wait for the first map so ops can be served
         await asyncio.wait_for(self._map_event.wait(), 10)
 
@@ -225,7 +236,7 @@ class OSDDaemon:
     async def stop(self) -> None:
         self.stopping = True
         for t in (
-            self._beacon_task, self._recovery_task,
+            self._beacon_task, self._hb_task, self._recovery_task,
             getattr(self, "_rehome_task", None),
         ):
             if t:
@@ -245,6 +256,72 @@ class OSDDaemon:
     @property
     def epoch(self) -> int:
         return self.osdmap.epoch if self.osdmap else 0
+
+    # -- peer heartbeats (OSD::handle_osd_ping, src/osd/OSD.cc:5735) ---
+
+    async def _heartbeat(self) -> None:
+        """Ping every up peer; report peers whose replies stop to the
+        mon.  This catches OSD<->OSD partitions that mon beacons cannot
+        see (the peer's beacon keeps flowing while its data path is
+        dead) — the reference's front/back heartbeat role."""
+        interval = self.conf["osd_heartbeat_interval"]
+        grace = self.conf["osd_heartbeat_grace"]
+        while not self.stopping:
+            await asyncio.sleep(interval)
+            om = self.osdmap
+            if om is None:
+                continue
+            now = time.monotonic()
+            peers = [
+                o for o in range(om.max_osd)
+                if o != self.id and om.is_up(o) and o in om.osd_addrs
+            ]
+            for gone in set(self._hb_first_ping) - set(peers):
+                self._hb_first_ping.pop(gone, None)
+                self._hb_last_reply.pop(gone, None)
+                self._hb_reported.pop(gone, None)
+            for peer in peers:
+                self._hb_first_ping.setdefault(peer, now)
+                try:
+                    conn = await self._osd_conn(peer)
+                    await conn.send_message(MOSDPing(
+                        op=PING, from_osd=self.id, epoch=self.epoch,
+                        stamp=time.monotonic_ns(),
+                    ))
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    pass  # counts as silence; grace logic judges below
+                last_ok = max(
+                    self._hb_last_reply.get(peer, 0.0),
+                    self._hb_first_ping[peer],
+                )
+                if (
+                    now - last_ok > grace
+                    and now - self._hb_reported.get(peer, 0.0) > grace
+                ):
+                    self._hb_reported[peer] = now
+                    log.warning(
+                        "osd.%d: peer osd.%d silent for %.1fs; reporting",
+                        self.id, peer, now - last_ok,
+                    )
+                    try:
+                        await self._mon_conn.send_message(MOSDFailure(
+                            reporter=self.id, failed=peer, epoch=self.epoch,
+                        ))
+                    except (ConnectionError, OSError):
+                        pass
+
+    async def _handle_ping(self, msg: MOSDPing) -> None:
+        if msg.op == PING:
+            if self.drop_pings:
+                # test hook: peers cannot reach us (we still hear their
+                # replies to OUR pings, like a one-way-dead link)
+                return
+            await msg.conn.send_message(MOSDPing(
+                op=PING_REPLY, from_osd=self.id, epoch=self.epoch,
+                stamp=msg.stamp,
+            ))
+        elif msg.op == PING_REPLY:
+            self._hb_last_reply[msg.from_osd] = time.monotonic()
 
     # -- plumbing ------------------------------------------------------
 
@@ -358,6 +435,8 @@ class OSDDaemon:
         try:
             if isinstance(msg, MOSDMap):
                 await self._handle_map(msg)
+            elif isinstance(msg, MOSDPing):
+                await self._handle_ping(msg)
             elif isinstance(msg, MOSDOp):
                 asyncio.ensure_future(self._handle_client_op(msg))
             elif isinstance(msg, MOSDECSubOpWrite):
@@ -402,6 +481,28 @@ class OSDDaemon:
             await self._request_map_fill()
         self._map_event.set()
         log.info("osd.%d: map epoch %d", self.id, self.epoch)
+        if self.osdmap.max_osd > self.id and self.osdmap.is_up(self.id):
+            self._seen_up = True
+        if (
+            not self.stopping
+            and getattr(self, "_seen_up", False)
+            and self.osdmap.max_osd > self.id
+            and self.osdmap.exists(self.id)
+            and not self.osdmap.is_up(self.id)
+        ):
+            # the map says we are down but we are alive (false failure
+            # report, or a mon that hasn't seen our boot): re-assert
+            # with a fresh incarnation (OSD::_committed_osd_maps ->
+            # start_boot in the reference)
+            log.warning("osd.%d: map says I'm down; re-booting", self.id)
+            self.incarnation = time.time_ns()
+            try:
+                await self._mon_conn.send_message(MOSDBoot(
+                    osd=self.id, host=self.addr[0], port=self.addr[1],
+                    incarnation=self.incarnation,
+                ))
+            except (ConnectionError, OSError):
+                pass  # mon hunt will re-boot us
         if self._recovery_task is None or self._recovery_task.done():
             self._recovery_task = asyncio.ensure_future(self._recover_all())
 

@@ -139,13 +139,23 @@ class TestQuorum:
                 c.client._mon_conn = await c.client.messenger.connect_to(
                     ("mon", 1), *c.monmap[1]
                 )
+                epoch = c.mons[0].osdmap.epoch
                 code, rs, _ = await c.client.command(
                     {"prefix": "osd down", "id": "3"}
                 )
                 assert code == 0, rs
                 await asyncio.sleep(0.2)
+                # the command must have committed a down-mark epoch on
+                # every member; the LIVE osd.3 then re-asserts itself
+                # (map-says-down -> re-boot), so check the transition,
+                # not the final state
+                from ceph_tpu.osd.mapenc import decode_osdmap
+
                 for m in c.mons:
-                    assert not m.osdmap.is_up(3)
+                    assert any(
+                        e > epoch and not decode_osdmap(blob).is_up(3)
+                        for e, blob in list(m._epoch_blobs.items())
+                    ), f"mon.{m.rank} never saw osd.3 down"
 
         run(go())
 

@@ -31,11 +31,22 @@ async def amain(args) -> int:
         crush, osds_per_host=args.osds_per_host,
         n_hosts=(args.osds + args.osds_per_host - 1) // args.osds_per_host,
     )
+
+    def _store(name: str):
+        if not args.data:
+            return None
+        from ceph_tpu.store.filestore import FileStore
+
+        s = FileStore(os.path.join(args.data, name))
+        s.mount()
+        return s
+
     mons = [
         Monitor(
             crush=crush.copy(), rank=r, n_mons=args.mons,
             beacon_grace=args.beacon * 4 if args.beacon else 0.0,
             out_interval=args.out_interval,
+            store=_store(f"mon{r}"),
         )
         for r in range(args.mons)
     ]
@@ -48,7 +59,10 @@ async def amain(args) -> int:
         await m.wait_stable()
     osds = []
     for i in range(args.osds):
-        osd = OSDDaemon(i, monmap, beacon_interval=args.beacon)
+        osd = OSDDaemon(
+            i, monmap, beacon_interval=args.beacon,
+            store=_store(f"osd{i}"),
+        )
         await osd.start()
         osds.append(osd)
     spec = ",".join(f"{h}:{p}" for h, p in monmap)
@@ -74,6 +88,11 @@ def main(argv=None) -> int:
     ap.add_argument("--osds-per-host", type=int, default=1)
     ap.add_argument("--beacon", type=float, default=1.0)
     ap.add_argument("--out-interval", type=float, default=0.0)
+    ap.add_argument(
+        "--data", default="",
+        help="data directory: daemons run on durable FileStores and the "
+             "cluster survives restart (default: volatile MemStores)",
+    )
     args = ap.parse_args(argv)
     try:
         return asyncio.run(amain(args))
