@@ -23,13 +23,26 @@ Structure (all reference cites to ErasureCodeClay.cc):
   single-erasure repair = plane-ordered traversal touching only the
   repair planes (:462 repair_one_lost_chunk).
 
-TPU note: the inner pair transforms are independent 2x2 GF(2^8)
+TPU formulation: the inner pair transforms are independent 2x2 GF(2^8)
 systems over sc_size-byte vectors, and all planes of one iscore level
-are mutually independent — the natural batched formulation is one
-matmul per (iscore level, transform kind).  The current implementation
-runs them per-plane through the inner plugins' host matrix kernels
-(correctness and bit-layout first); the batched TPU formulation is the
-planned follow-up and does not change any byte of the chunk layout.
+are mutually independent, so each level runs as THREE batched phases:
+
+1. fill-U: every pair transform of the level, grouped by its
+   (known-ids -> out-ids) pattern, concatenated and solved as ONE
+   matrix decode per pattern (:class:`_PftBatch`);
+2. scalar-MDS: all planes of the level decoded in ONE call over the
+   concatenated plane payloads (the inner MDS code's decode matrix is
+   applied once to a (nodes, planes*sc) operand — the shape the
+   BitmatrixCodec device path wants);
+3. recover-C: the level's coupled-value recoveries, batched like 1.
+
+Phase-major execution is byte-identical to the reference's sequential
+per-plane traversal because cross-plane writes only ever target planes
+of the SAME level (the partner plane differs from z only in digit y,
+and the erasure-dot count is invariant under that swap), and duplicate
+pair solves write identical bytes.  Repair with aloof nodes (d <
+k+m-1) keeps the sequential path — its pair fills read another
+plane's U mid-level.
 """
 
 from __future__ import annotations
@@ -46,6 +59,41 @@ __erasure_code_version__ = "0.1.0"
 
 def _pow_int(a: int, x: int) -> int:
     return a**x
+
+
+class _PftBatch:
+    """Collects same-pattern (2,2) pair transforms and runs each
+    pattern as ONE matrix decode over the concatenated payloads — one
+    matmul per (level, kind) instead of q^t tiny host solves."""
+
+    def __init__(self, pft):
+        self.pft = pft
+        self.jobs: dict[tuple, list[tuple[dict, dict]]] = {}
+
+    def add(self, known: dict[int, np.ndarray], out: dict[int, np.ndarray]) -> None:
+        key = (tuple(sorted(known)), tuple(sorted(out)))
+        self.jobs.setdefault(key, []).append((known, out))
+
+    def run(self) -> None:
+        for (kids, oids), jobs in self.jobs.items():
+            if len(jobs) == 1:
+                known, out = jobs[0]
+                rec = self.pft.decode_payloads(known, list(out))
+                for i, buf in out.items():
+                    buf[...] = rec[i]
+                continue
+            known_cat = {
+                i: np.concatenate([np.asarray(j[0][i]) for j in jobs])
+                for i in kids
+            }
+            rec = self.pft.decode_payloads(known_cat, list(oids))
+            off = 0
+            for known, out in jobs:
+                ln = len(next(iter(known.values())))
+                for i, buf in out.items():
+                    buf[...] = rec[i][off : off + ln]
+                off += ln
+        self.jobs = {}
 
 
 class ErasureCodeClay(ErasureCode):
@@ -264,7 +312,15 @@ class ErasureCodeClay(ErasureCode):
             node = i if i < self.k else i + self.nu
             if i not in chunks:
                 erasures.add(node)
-            coded[node] = decoded[i]
+                coded[node] = decoded[i]
+            else:
+                buf = np.asarray(decoded[i])
+                if not buf.flags.writeable:
+                    # parity nodes padded into the erasure set get
+                    # (re)written during the layered decode even when
+                    # present — wire buffers arrive read-only
+                    buf = buf.copy()
+                coded[node] = buf
         chunk_size = len(coded[0])
         for i in range(self.k, self.k + self.nu):
             coded[i] = np.zeros(chunk_size, dtype=np.uint8)
@@ -277,12 +333,17 @@ class ErasureCodeClay(ErasureCode):
         erased: set[int],
         known: dict[int, np.ndarray],
         out: dict[int, np.ndarray],
+        batch: _PftBatch | None = None,
     ) -> None:
         """Decode the (2,2) pair code: reconstruct exactly the ids in
         ``out`` from ``known`` ids, writing into the (possibly strided)
         views in ``out``.  ``erased`` documents the caller's intent and
-        must cover ``out``."""
+        must cover ``out``.  With ``batch``, the solve is deferred into
+        the level's pattern batch instead of running immediately."""
         assert set(out) <= erased
+        if batch is not None:
+            batch.add(known, out)
+            return
         rec = self.pft.decode_payloads(known, list(out))
         for i, buf in out.items():
             buf[...] = rec[i]
@@ -292,17 +353,32 @@ class ErasureCodeClay(ErasureCode):
     ) -> None:
         """decode_uncoupled (cc:741-759): run the scalar MDS code over
         plane z of the uncoupled array."""
+        self._mds_decode_planes(erased, U, [z], sc)
+
+    def _mds_decode_planes(
+        self, erased: set[int], U: dict[int, np.ndarray], zs: list[int],
+        sc: int,
+    ) -> None:
+        """Batched decode_uncoupled: ONE scalar-MDS decode over the
+        concatenation of all given planes (they share the erasure
+        signature, so one decode matrix applies to the whole batch)."""
+        if not zs:
+            return
         known = {
-            i: np.ascontiguousarray(U[i][z * sc : (z + 1) * sc])
+            i: np.ascontiguousarray(
+                np.concatenate([U[i][z * sc : (z + 1) * sc] for z in zs])
+                if len(zs) > 1 else U[i][zs[0] * sc : (zs[0] + 1) * sc]
+            )
             for i in range(self.q * self.t)
             if i not in erased
         }
         decoded = dict(known)
         for i in erased:
-            decoded[i] = np.zeros(sc, dtype=np.uint8)
+            decoded[i] = np.zeros(sc * len(zs), dtype=np.uint8)
         self.mds.decode_chunks(erased, known, decoded)
         for i in erased:
-            U[i][z * sc : (z + 1) * sc] = decoded[i]
+            for n, z in enumerate(zs):
+                U[i][z * sc : (z + 1) * sc] = decoded[i][n * sc : (n + 1) * sc]
 
     def _pair_indices(self, x: int, y: int, z_vec: list[int], z: int):
         """The coupled/uncoupled pair geometry shared by every
@@ -346,37 +422,48 @@ class ErasureCodeClay(ErasureCode):
         max_iscore = len({i // self.q for i in erased})
 
         for iscore in range(max_iscore + 1):
-            for z in range(self.sub_chunk_no):
-                if order[z] == iscore:
-                    self._decode_erasures(erased, z, chunks, U, sc)
-
-            for z in range(self.sub_chunk_no):
-                if order[z] != iscore:
-                    continue
+            zs = [
+                z for z in range(self.sub_chunk_no) if order[z] == iscore
+            ]
+            # phase 1: fill U (every pair transform of the level, one
+            # batched solve per pattern)
+            batch = _PftBatch(self.pft)
+            for z in zs:
+                self._fill_uncoupled_plane(erased, z, chunks, U, sc, batch)
+            batch.run()
+            # phase 2: one scalar-MDS decode across the whole level
+            self._mds_decode_planes(erased, U, zs, sc)
+            # phase 3: recover the erased nodes' coupled values
+            batch = _PftBatch(self.pft)
+            for z in zs:
                 z_vec = self._plane_vector(z)
                 for node_xy in erased:
                     x, y = node_xy % self.q, node_xy // self.q
                     node_sw = y * self.q + z_vec[y]
                     if z_vec[y] != x:
                         if node_sw not in erased:
-                            self._recover_type1_erasure(chunks, U, x, y, z, z_vec, sc)
+                            self._recover_type1_erasure(
+                                chunks, U, x, y, z, z_vec, sc, batch)
                         elif z_vec[y] < x:
-                            self._get_coupled_from_uncoupled(chunks, U, x, y, z, z_vec, sc)
+                            self._get_coupled_from_uncoupled(
+                                chunks, U, x, y, z, z_vec, sc, batch)
                     else:
                         chunks[node_xy][z * sc : (z + 1) * sc] = U[node_xy][
                             z * sc : (z + 1) * sc
                         ]
+            batch.run()
 
-    def _decode_erasures(
+    def _fill_uncoupled_plane(
         self,
         erased: set[int],
         z: int,
         chunks: dict[int, np.ndarray],
         U: dict[int, np.ndarray],
         sc: int,
+        batch: _PftBatch | None = None,
     ) -> None:
-        """cc:712-739: fill U for all non-erased nodes in plane z, then
-        scalar-MDS-decode the erased ones."""
+        """cc:712-739 (fill half): fill U for all non-erased nodes in
+        plane z; the level's MDS decode runs separately (batched)."""
         z_vec = self._plane_vector(z)
         for x in range(self.q):
             for y in range(self.t):
@@ -385,18 +472,21 @@ class ErasureCodeClay(ErasureCode):
                 if node_xy in erased:
                     continue
                 if z_vec[y] < x:
-                    self._get_uncoupled_from_coupled(chunks, U, x, y, z, z_vec, sc)
+                    self._get_uncoupled_from_coupled(
+                        chunks, U, x, y, z, z_vec, sc, batch)
                 elif z_vec[y] == x:
                     U[node_xy][z * sc : (z + 1) * sc] = chunks[node_xy][
                         z * sc : (z + 1) * sc
                     ]
                 elif node_sw in erased:
-                    self._get_uncoupled_from_coupled(chunks, U, x, y, z, z_vec, sc)
-        self._mds_decode_plane(erased, U, z, sc)
+                    self._get_uncoupled_from_coupled(
+                        chunks, U, x, y, z, z_vec, sc, batch)
 
     # -- pair transforms (cc:774-871) ----------------------------------------
 
-    def _recover_type1_erasure(self, chunks, U, x, y, z, z_vec, sc) -> None:
+    def _recover_type1_erasure(
+        self, chunks, U, x, y, z, z_vec, sc, batch=None
+    ) -> None:
         """cc:774-811: C[node_xy][z] from its pair partner's C and own U."""
         node_xy, node_sw, z_sw, (i0, i1, i2, i3) = self._pair_indices(x, y, z_vec, z)
         known = {
@@ -404,9 +494,11 @@ class ErasureCodeClay(ErasureCode):
             i2: U[node_xy][z * sc : (z + 1) * sc],
         }
         out = {i0: chunks[node_xy][z * sc : (z + 1) * sc]}
-        self._pft_decode({i0}, known, out)
+        self._pft_decode({i0}, known, out, batch)
 
-    def _get_coupled_from_uncoupled(self, chunks, U, x, y, z, z_vec, sc) -> None:
+    def _get_coupled_from_uncoupled(
+        self, chunks, U, x, y, z, z_vec, sc, batch=None
+    ) -> None:
         """cc:813-838: both C of a pair from both U (both coupled erased)."""
         node_xy, node_sw, z_sw, _ = self._pair_indices(x, y, z_vec, z)
         assert z_vec[y] < x
@@ -418,9 +510,11 @@ class ErasureCodeClay(ErasureCode):
             0: chunks[node_xy][z * sc : (z + 1) * sc],
             1: chunks[node_sw][z_sw * sc : (z_sw + 1) * sc],
         }
-        self._pft_decode({0, 1}, known, out)
+        self._pft_decode({0, 1}, known, out, batch)
 
-    def _get_uncoupled_from_coupled(self, chunks, U, x, y, z, z_vec, sc) -> None:
+    def _get_uncoupled_from_coupled(
+        self, chunks, U, x, y, z, z_vec, sc, batch=None
+    ) -> None:
         """cc:840-871: both U of a pair from both C."""
         node_xy, node_sw, z_sw, (i0, i1, i2, i3) = self._pair_indices(x, y, z_vec, z)
         known = {
@@ -431,7 +525,7 @@ class ErasureCodeClay(ErasureCode):
             i2: U[node_xy][z * sc : (z + 1) * sc],
             i3: U[node_sw][z_sw * sc : (z_sw + 1) * sc],
         }
-        self._pft_decode({i2, i3}, known, out)
+        self._pft_decode({i2, i3}, known, out, batch)
 
     # -- single-chunk repair (cc:398-641) ------------------------------------
 
@@ -511,66 +605,88 @@ class ErasureCodeClay(ErasureCode):
         erasures |= aloof_nodes
         assert len(erasures) <= self.m + self.q - 1  # group + aloof
 
-        for order in sorted(ordered_planes):
-            for z in ordered_planes[order]:
-                z_vec = self._plane_vector(z)
-                # fill U for all non-erased nodes in this plane
-                for y in range(self.t):
-                    for x in range(self.q):
-                        node_xy = y * self.q + x
-                        if node_xy in erasures:
-                            continue
-                        _, node_sw, z_sw, (i0, i1, i2, i3) = self._pair_indices(
-                            x, y, z_vec, z
-                        )
-                        hz = repair_plane_to_ind[z]
-                        if node_sw in aloof_nodes:
-                            # partner lost to an aloof node: solve the
-                            # pair from own C and partner's U (cc:551-563)
-                            known = {
-                                i0: helper_data[node_xy][hz * sc : (hz + 1) * sc],
-                                i3: U[node_sw][z_sw * sc : (z_sw + 1) * sc],
-                            }
-                            out = {i2: U[node_xy][z * sc : (z + 1) * sc]}
-                            self._pft_decode({i2}, known, out)
-                        elif z_vec[y] != x:
-                            hz_sw = repair_plane_to_ind[z_sw]
-                            known = {
-                                i0: helper_data[node_xy][hz * sc : (hz + 1) * sc],
-                                i1: helper_data[node_sw][hz_sw * sc : (hz_sw + 1) * sc],
-                            }
-                            out = {i2: U[node_xy][z * sc : (z + 1) * sc]}
-                            self._pft_decode({i2}, known, out)
-                        else:
-                            U[node_xy][z * sc : (z + 1) * sc] = helper_data[node_xy][
-                                hz * sc : (hz + 1) * sc
-                            ]
+        # with aloof nodes a pair fill reads another plane's U
+        # mid-level; keep those runs sequential.  The common d=k+m-1
+        # deployments have none and take the fully batched path.
+        phase_major = not aloof_nodes
 
-                assert len(erasures) <= self.m, (erasures, self.m)
-                self._mds_decode_plane(erasures, U, z, sc)
-
-                # recover the coupled values of erased nodes (cc:600-638)
-                for i in sorted(erasures):
-                    if i in aloof_nodes:
+        def _fill_plane(z: int, z_vec: list[int], batch=None) -> None:
+            # fill U for all non-erased nodes in this plane
+            for y in range(self.t):
+                for x in range(self.q):
+                    node_xy = y * self.q + x
+                    if node_xy in erasures:
                         continue
-                    x, y = i % self.q, i // self.q
                     _, node_sw, z_sw, (i0, i1, i2, i3) = self._pair_indices(
                         x, y, z_vec, z
                     )
-                    if x == z_vec[y]:  # hole-dot pair (type 0)
-                        # within repair planes only the lost node can be
-                        # dotted: z_vec[y_lost] == x_lost defines them
-                        assert i == lost_chunk, (i, lost_chunk)
-                        recovered[z * sc : (z + 1) * sc] = U[i][z * sc : (z + 1) * sc]
-                    else:
-                        assert y == lost_chunk // self.q and node_sw == lost_chunk
-                        hz = repair_plane_to_ind[z]
+                    hz = repair_plane_to_ind[z]
+                    if node_sw in aloof_nodes:
+                        # partner lost to an aloof node: solve the
+                        # pair from own C and partner's U (cc:551-563)
                         known = {
-                            i0: helper_data[i][hz * sc : (hz + 1) * sc],
-                            i2: U[i][z * sc : (z + 1) * sc],
+                            i0: helper_data[node_xy][hz * sc : (hz + 1) * sc],
+                            i3: U[node_sw][z_sw * sc : (z_sw + 1) * sc],
                         }
-                        out = {i1: recovered[z_sw * sc : (z_sw + 1) * sc]}
-                        self._pft_decode({i1}, known, out)
+                        out = {i2: U[node_xy][z * sc : (z + 1) * sc]}
+                        self._pft_decode({i2}, known, out)
+                    elif z_vec[y] != x:
+                        hz_sw = repair_plane_to_ind[z_sw]
+                        known = {
+                            i0: helper_data[node_xy][hz * sc : (hz + 1) * sc],
+                            i1: helper_data[node_sw][hz_sw * sc : (hz_sw + 1) * sc],
+                        }
+                        out = {i2: U[node_xy][z * sc : (z + 1) * sc]}
+                        self._pft_decode({i2}, known, out, batch)
+                    else:
+                        U[node_xy][z * sc : (z + 1) * sc] = helper_data[node_xy][
+                            hz * sc : (hz + 1) * sc
+                        ]
+
+        def _recover_plane(z: int, z_vec: list[int], batch=None) -> None:
+            # recover the coupled values of erased nodes (cc:600-638)
+            for i in sorted(erasures):
+                if i in aloof_nodes:
+                    continue
+                x, y = i % self.q, i // self.q
+                _, node_sw, z_sw, (i0, i1, i2, i3) = self._pair_indices(
+                    x, y, z_vec, z
+                )
+                if x == z_vec[y]:  # hole-dot pair (type 0)
+                    # within repair planes only the lost node can be
+                    # dotted: z_vec[y_lost] == x_lost defines them
+                    assert i == lost_chunk, (i, lost_chunk)
+                    recovered[z * sc : (z + 1) * sc] = U[i][z * sc : (z + 1) * sc]
+                else:
+                    assert y == lost_chunk // self.q and node_sw == lost_chunk
+                    hz = repair_plane_to_ind[z]
+                    known = {
+                        i0: helper_data[i][hz * sc : (hz + 1) * sc],
+                        i2: U[i][z * sc : (z + 1) * sc],
+                    }
+                    out = {i1: recovered[z_sw * sc : (z_sw + 1) * sc]}
+                    self._pft_decode({i1}, known, out, batch)
+
+        for order in sorted(ordered_planes):
+            zs = ordered_planes[order]
+            if phase_major:
+                batch = _PftBatch(self.pft)
+                for z in zs:
+                    _fill_plane(z, self._plane_vector(z), batch)
+                batch.run()
+                assert len(erasures) <= self.m, (erasures, self.m)
+                self._mds_decode_planes(erasures, U, zs, sc)
+                batch = _PftBatch(self.pft)
+                for z in zs:
+                    _recover_plane(z, self._plane_vector(z), batch)
+                batch.run()
+            else:
+                for z in zs:
+                    z_vec = self._plane_vector(z)
+                    _fill_plane(z, z_vec)
+                    assert len(erasures) <= self.m, (erasures, self.m)
+                    self._mds_decode_plane(erasures, U, z, sc)
+                    _recover_plane(z, z_vec)
 
 def __erasure_code_init__(name: str, registry) -> None:
     from ceph_tpu.ec.registry import ErasureCodePlugin

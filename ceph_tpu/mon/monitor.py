@@ -161,8 +161,9 @@ class Monitor:
 
     # -- state-machine snapshots (trim / full-sync / restart) ----------
 
-    def _state_snapshot(self) -> bytes:
-        """Everything _apply_op derives, at _state_version."""
+    def _state_snapshot(self) -> tuple[int, bytes]:
+        """(version, blob): everything _apply_op derives, captured
+        atomically at _state_version."""
         import json
 
         from ceph_tpu.msg.denc import Encoder
@@ -178,7 +179,7 @@ class Monitor:
             },
             "up_from": {str(k): v for k, v in self._up_from.items()},
         }))
-        return enc.bytes()
+        return self._state_version, enc.bytes()
 
     async def _install_snapshot(
         self, version: int, blob: bytes, publish: bool = True
@@ -221,9 +222,7 @@ class Monitor:
             return
         below = px.last_committed - self.paxos_trim_keep + 1
         if self.store is not None:
-            await self.store.put_snapshot(
-                self._state_version, self._state_snapshot()
-            )
+            await self.store.put_snapshot(*self._state_snapshot())
         px.values = {v: b for v, b in px.values.items() if v >= below}
         px.first_committed = below
         if self.store is not None:
@@ -280,9 +279,9 @@ class Monitor:
             # in-flight BEGINs and stalls proposes for their timeout)
             try:
                 if peer[1] < len(self.monmap):
-                    await self.messenger.connect_to(
+                    await asyncio.wait_for(self.messenger.connect_to(
                         ("mon", peer[1]), *self.monmap[peer[1]]
-                    )
+                    ), 2.0)
                     return  # reconnected: not a leader loss
             except (ConnectionError, OSError, asyncio.TimeoutError):
                 pass

@@ -523,11 +523,23 @@ class Paxos:
                 and self._get_snapshot is not None
             ):
                 # the peer predates our trimmed tail: ship a state
-                # snapshot at our last_committed (store full-sync)
+                # snapshot (store full-sync).  The version comes from
+                # the snapshot ITSELF — the state machine can lag
+                # last_committed by an in-flight apply, and advertising
+                # a version the blob doesn't contain would silently
+                # drop that op on the receiver.  Any gap above the
+                # snapshot ships as ordinary commits right after.
+                ver, blob = self._get_snapshot()
                 await self._maybe_send(from_rank, MMonPaxos(
-                    SYNC, self.accepted_pn, self.last_committed,
-                    self._get_snapshot(), self.last_committed,
+                    SYNC, self.accepted_pn, ver, blob,
+                    self.last_committed,
                 ))
+                for v in range(ver + 1, self.last_committed + 1):
+                    if v in self.values:
+                        await self._maybe_send(from_rank, MMonPaxos(
+                            COMMIT, self.accepted_pn, v, self.values[v],
+                            self.last_committed,
+                        ))
                 return
             for v in range(msg.last_committed + 1, self.last_committed + 1):
                 if v in self.values:

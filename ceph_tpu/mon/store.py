@@ -97,12 +97,21 @@ class MonStore:
         })
 
     async def trim_values(self, below: int) -> None:
-        """Drop v.* entries with version < below; record the new tail."""
-        omap = self._load_omap()
-        drop = [
-            k for k in omap
-            if k.startswith("v.") and int(k[2:]) < below
-        ]
+        """Drop v.* entries with version < below; record the new tail.
+        Key names are deterministic, so the old tail marker alone gives
+        the drop range — no whole-omap scan of value blobs."""
+        import struct as _s
+
+        old = 1
+        if self.store.collection_exists(MON_COLL) and self.store.exists(
+            MON_COLL, PAXOS_OID
+        ):
+            raw = self.store.omap_get_values(
+                MON_COLL, PAXOS_OID, ["first_committed"]
+            ).get("first_committed")
+            if raw:
+                old = max(1, _s.unpack("<Q", raw)[0])
+        drop = [f"v.{v:016d}" for v in range(old, below)]
         t = self._txn()
         if drop:
             t.omap_rmkeys(MON_COLL, PAXOS_OID, drop)

@@ -460,7 +460,13 @@ class MOSDECSubOpWriteReply(Message):
 
 
 class MOSDECSubOpRead(Message):
-    """primary -> shard OSD: read chunk extents (+ attrs on demand)."""
+    """primary -> shard OSD: read chunk extents (+ attrs on demand).
+
+    ``extents`` (list of (off, len) byte runs) is how CLAY sub-chunk
+    repair reads ride the wire: the reply carries the concatenation of
+    the runs, so a regenerating repair moves only sub_chunk_no/q of
+    each helper chunk (reference ECCommon.cc:262-299 passing
+    minimum_to_decode's runs down to shard reads)."""
 
     TYPE = 110
 
@@ -468,10 +474,12 @@ class MOSDECSubOpRead(Message):
         self, tid: int = 0, pg: pg_t = pg_t(0, 0), shard: int = 0,
         from_osd: int = 0, oid: str = "", off: int = 0, length: int = 0,
         want_attrs: bool = False, epoch: int = 0,
+        extents: list[tuple[int, int]] | None = None,
     ):
         self.tid, self.pg, self.shard, self.from_osd = tid, pg, shard, from_osd
         self.oid, self.off, self.length = oid, off, length
         self.want_attrs, self.epoch = want_attrs, epoch
+        self.extents = extents or []
 
     def encode_payload(self, enc):
         enc.u64(self.tid)
@@ -482,15 +490,23 @@ class MOSDECSubOpRead(Message):
         enc.u64(self.length)
         enc.bool_(self.want_attrs)
         enc.u32(self.epoch)
+        enc.u32(len(self.extents))
+        for o, ln in self.extents:
+            enc.u64(o)
+            enc.u64(ln)
 
     @classmethod
     def decode_payload(cls, dec):
         tid = dec.u64()
         pg, shard = _dec_pg(dec)
-        return cls(
+        msg = cls(
             tid, pg, shard, dec.i32(), dec.str_(), dec.u64(), dec.u64(),
             dec.bool_(), dec.u32(),
         )
+        msg.extents = [
+            (dec.u64(), dec.u64()) for _ in range(dec.u32())
+        ]
+        return msg
 
 
 class MOSDECSubOpReadReply(Message):

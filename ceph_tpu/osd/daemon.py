@@ -1044,32 +1044,44 @@ class OSDDaemon:
         )
 
     async def _read_shard_quiet(
-        self, pool, pg, shard, osd, oid, *, off: int = 0, length: int = 0
+        self, pool, pg, shard, osd, oid, *, off: int = 0, length: int = 0,
+        extents: list[tuple[int, int]] | None = None,
     ):
         """_read_shard with transport failures mapped to EIO."""
         try:
             return await self._read_shard(
-                pool, pg, shard, osd, oid, off=off, length=length
+                pool, pg, shard, osd, oid, off=off, length=length,
+                extents=extents,
             )
         except (OSError, asyncio.TimeoutError, ConnectionError):
             return None, None, errno.EIO
 
     async def _read_shard(
-        self, pool, pg, shard, osd, oid, *, off: int = 0, length: int = 0
+        self, pool, pg, shard, osd, oid, *, off: int = 0, length: int = 0,
+        extents: list[tuple[int, int]] | None = None,
     ):
         """Ranged chunk read of one shard: (payload, attrs, errno).
-        ``length == 0`` reads to the shard end."""
+        ``length == 0`` reads to the shard end.  ``extents`` returns
+        the concatenation of multiple byte runs (sub-chunk repair)."""
         if osd == self.id:
             c = self._shard_coll(pool, pg, shard)
             o = ghobject_t(oid, shard=shard)
             if not self.store.exists(c, o):
                 return None, None, errno.ENOENT
-            data = self.store.read(c, o, off, None if length == 0 else length)
+            if extents:
+                data = b"".join(
+                    self.store.read(c, o, eo, ln) for eo, ln in extents
+                )
+            else:
+                data = self.store.read(
+                    c, o, off, None if length == 0 else length
+                )
             return data, self.store.getattrs(c, o), 0
         tid = next(self._tids)
         rep = await self._sub_op(osd, MOSDECSubOpRead(
             tid=tid, pg=pg, shard=shard, from_osd=self.id, oid=oid,
             off=off, length=length, want_attrs=True, epoch=self.epoch,
+            extents=extents or [],
         ), tid)
         if rep.result != 0:
             return None, None, -rep.result
@@ -1141,9 +1153,15 @@ class OSDDaemon:
                 result=-errno.ENOENT, epoch=self.epoch,
             )
         else:
-            data = self.store.read(
-                c, o, msg.off, None if msg.length == 0 else msg.length
-            )
+            if msg.extents:
+                data = b"".join(
+                    self.store.read(c, o, eo, ln) for eo, ln in msg.extents
+                )
+            else:
+                data = self.store.read(
+                    c, o, msg.off, None if msg.length == 0 else msg.length
+                )
+            self.perf.inc("subop_read_bytes", len(data))
             attrs = self.store.getattrs(c, o) if msg.want_attrs else {}
             rep = MOSDECSubOpReadReply(
                 tid=msg.tid, pg=msg.pg, shard=msg.shard, from_osd=self.id,
@@ -1632,24 +1650,73 @@ class OSDDaemon:
                 self.id, pg, oid, len(sources), k,
             )
             return
+        need = {s for s, _ in targets}
+        # single-shard repair of a regenerating code: thread
+        # minimum_to_decode's (sub-chunk offset, count) runs down to
+        # ranged shard reads so only sub_chunk_no/q of each helper
+        # crosses the wire (reference ECCommon.cc:262-299 +
+        # ErasureCodeClay::repair_one_lost_chunk) — CLAY's whole point
+        repair_extents: dict[int, list[tuple[int, int]]] | None = None
+        if (
+            len(need) == 1 and ec.get_sub_chunk_count() > 1
+            and not getattr(self, "disable_subchunk_repair", False)
+        ):
+            try:
+                if ec.is_repair(need, set(sources)):
+                    minimum = ec.minimum_to_decode(need, set(sources))
+                    cs = sinfo.chunk_size
+                    sub = cs // ec.get_sub_chunk_count()
+                    size = int(src_attrs.get(SIZE_ATTR, b"0"))
+                    ns = max(
+                        1, sinfo.logical_to_next_chunk_offset(size) // cs
+                    )
+                    repair_extents = {
+                        s: [
+                            (stripe * cs + o * sub, c * sub)
+                            for stripe in range(ns)
+                            for o, c in runs
+                        ]
+                        for s, runs in minimum.items()
+                    }
+            except Exception:
+                repair_extents = None  # fall back to full-chunk reads
         # helper-shard reads and shard pushes both fan out concurrently
         # (the reference's ECSubRead/MOSDPGPush are fire-and-gather)
         chunks: dict[int, np.ndarray] = {}
-        src_items = list(sources.items())
-        payloads = await asyncio.gather(*(
-            self._read_shard_quiet(pool, pg, s, o, oid) for s, o in src_items
-        ))
-        for (s, o), (payload, _a, _e) in zip(src_items, payloads):
-            if payload is not None:
-                chunks[s] = np.frombuffer(payload, np.uint8)
-        if len(chunks) < k:
-            log.error(
-                "osd.%d: %s/%s recovery aborted: %d/%d source reads "
-                "succeeded", self.id, pg, oid, len(chunks), k,
-            )
-            return
-        need = {s for s, _ in targets}
-        rebuilt = ecutil.decode_shards(sinfo, ec, chunks, need)
+        used_packed = False
+        if repair_extents is not None and set(repair_extents) <= set(sources):
+            src_items = [(s, sources[s]) for s in sorted(repair_extents)]
+            payloads = await asyncio.gather(*(
+                self._read_shard_quiet(
+                    pool, pg, s, o, oid, extents=repair_extents[s]
+                )
+                for s, o in src_items
+            ))
+            for (s, o), (payload, _a, _e) in zip(src_items, payloads):
+                if payload is not None:
+                    chunks[s] = np.frombuffer(payload, np.uint8)
+            if len(chunks) < len(repair_extents):
+                chunks = {}  # a helper vanished: retry with full reads
+            else:
+                used_packed = True
+        if not chunks:
+            src_items = list(sources.items())
+            payloads = await asyncio.gather(*(
+                self._read_shard_quiet(pool, pg, s, o, oid)
+                for s, o in src_items
+            ))
+            for (s, o), (payload, _a, _e) in zip(src_items, payloads):
+                if payload is not None:
+                    chunks[s] = np.frombuffer(payload, np.uint8)
+            if len(chunks) < k:
+                log.error(
+                    "osd.%d: %s/%s recovery aborted: %d/%d source reads "
+                    "succeeded", self.id, pg, oid, len(chunks), k,
+                )
+                return
+        rebuilt = ecutil.decode_shards(
+            sinfo, ec, chunks, need, packed_repair=used_packed
+        )
         await asyncio.gather(*(
             self._push(pool, pg, s, o, oid, rebuilt[s].tobytes(), src_attrs)
             for s, o in targets

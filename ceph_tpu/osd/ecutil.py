@@ -166,11 +166,20 @@ def decode_shards(
     ec_impl: ErasureCodeInterface,
     to_decode: Mapping[int, np.ndarray],
     need: set[int],
+    *,
+    packed_repair: bool = False,
 ) -> dict[int, np.ndarray]:
-    """ECUtil::decode per-target-shard form (ECUtil.cc:50-121): given
-    shard reads sized by minimum_to_decode's sub-chunk runs, rebuild
+    """ECUtil::decode per-target-shard form (ECUtil.cc:50-121): rebuild
     full shard payloads for ``need`` (shard ids).  This is the recovery
-    path; CLAY helpers pass partial (sub-chunk) payloads."""
+    path.
+
+    ``packed_repair`` declares the payload layout: True means each
+    helper payload is the stripe-major concatenation of
+    minimum_to_decode's sub-chunk runs (the regenerating-repair ranged
+    read); False means full chunks.  The two layouts can be the same
+    length (e.g. 2 stripes x half-chunk runs == 1 full chunk), so the
+    caller must say which it read — guessing here silently corrupts
+    the rebuilt shard."""
     assert to_decode
     cs = sinfo.chunk_size
     for v in to_decode.values():
@@ -185,17 +194,21 @@ def decode_shards(
         chunks = ec_impl.decode_payloads(to_decode, [inv[s] for s in need])
         return {ec_impl.chunk_index(c): v for c, v in chunks.items()}
 
-    avail = set(to_decode)
-    minimum = ec_impl.minimum_to_decode(need, avail)
-    sub_chunk = cs // ec_impl.get_sub_chunk_count()
-    first_min = next(iter(minimum))
-    repair_per_chunk = sub_chunk * sum(c for _, c in minimum[first_min])
-    chunks_count = len(np.asarray(to_decode[first_min]).reshape(-1)) // repair_per_chunk
+    first_len = len(np.asarray(next(iter(to_decode.values()))).reshape(-1))
+    if packed_repair:
+        avail = set(to_decode)
+        minimum = ec_impl.minimum_to_decode(need, avail)
+        sub_chunk = cs // ec_impl.get_sub_chunk_count()
+        first_min = next(iter(minimum))
+        per_chunk = sub_chunk * sum(c for _, c in minimum[first_min])
+    else:
+        per_chunk = cs
+    chunks_count = first_len // per_chunk
 
     out: dict[int, list[np.ndarray]] = {s: [] for s in need}
     for i in range(chunks_count):
         piece = {
-            shard: np.asarray(v)[i * repair_per_chunk : (i + 1) * repair_per_chunk]
+            shard: np.asarray(v)[i * per_chunk : (i + 1) * per_chunk]
             for shard, v in to_decode.items()
         }
         decoded = ec_impl.decode(need, piece, cs)

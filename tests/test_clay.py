@@ -224,7 +224,9 @@ def test_ecutil_decode_shards_with_subchunk_reads():
                 )
         helper_payloads[node] = np.concatenate(pieces)
 
-    rebuilt = ecutil.decode_shards(si, ec, helper_payloads, {lost})
+    rebuilt = ecutil.decode_shards(
+        si, ec, helper_payloads, {lost}, packed_repair=True
+    )
     assert np.array_equal(rebuilt[lost], shards[lost])
 
 
