@@ -111,7 +111,16 @@ class MonStore:
             ).get("first_committed")
             if raw:
                 old = max(1, _s.unpack("<Q", raw)[0])
-        drop = [f"v.{v:016d}" for v in range(old, below)]
+        if below - old > 10 * len(self._load_omap()) + 1000:
+            # marker far behind reality (e.g. fresh store adopting a
+            # full-sync at a huge version): enumerate what actually
+            # exists instead of materializing millions of key names
+            drop = [
+                k for k in self._load_omap()
+                if k.startswith("v.") and int(k[2:]) < below
+            ]
+        else:
+            drop = [f"v.{v:016d}" for v in range(old, below)]
         t = self._txn()
         if drop:
             t.omap_rmkeys(MON_COLL, PAXOS_OID, drop)

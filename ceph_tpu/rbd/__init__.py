@@ -1,0 +1,187 @@
+"""RBD-lite: a block-image layer over RADOS — the librbd slice.
+
+Mirrors the reference's v2 image format essentials (src/librbd/,
+doc/dev/rbd-layering.rst): a small header object holds image metadata
+in omap (``rbd_header.<id>``: size, order, object_prefix), a directory
+object maps names to ids (``rbd_directory``), and data lives in
+``<prefix>.<objectno:016x>`` objects of 2^order bytes each.  Like the
+reference's ``--data-pool`` images, metadata can sit on a replicated
+pool while data objects ride an erasure-coded pool.
+
+Capabilities: create / open / list / remove, ranged read/write at any
+offset (sparse: unwritten extents read as zeros), resize, stat.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+
+RBD_DIRECTORY = "rbd_directory"
+DEFAULT_ORDER = 22  # 4 MiB objects, the reference default
+
+
+class RBDError(OSError):
+    pass
+
+
+class RBD:
+    """Pool-level image operations (librbd::RBD)."""
+
+    def __init__(self, meta_ioctx, data_ioctx=None):
+        self.meta = meta_ioctx
+        self.data = data_ioctx or meta_ioctx
+
+    async def create(
+        self, name: str, size: int, order: int = DEFAULT_ORDER
+    ) -> None:
+        existing = await self._dir()
+        if name in existing:
+            raise RBDError(errno.EEXIST, f"image {name!r} exists")
+        header = f"rbd_header.{name}"
+        await self.meta.omap_set(header, {
+            "size": str(size).encode(),
+            "order": str(order).encode(),
+            "object_prefix": f"rbd_data.{name}".encode(),
+        })
+        await self.meta.omap_set(RBD_DIRECTORY, {name: b"1"})
+
+    async def _dir(self) -> dict[str, bytes]:
+        try:
+            return await self.meta.omap_get(RBD_DIRECTORY)
+        except OSError as e:
+            if e.errno == errno.ENOENT:
+                return {}
+            raise
+
+    async def list(self) -> list[str]:
+        return sorted(await self._dir())
+
+    async def remove(self, name: str) -> None:
+        img = await self.open(name)
+        await img.remove_data()
+        try:
+            await self.meta.remove(f"rbd_header.{name}")
+        except OSError as e:
+            if e.errno != errno.ENOENT:
+                raise
+        await self.meta.omap_rm_keys(RBD_DIRECTORY, [name])
+
+    async def open(self, name: str) -> "Image":
+        try:
+            meta = await self.meta.omap_get(f"rbd_header.{name}")
+        except OSError as e:
+            raise RBDError(errno.ENOENT, f"no image {name!r}") from e
+        if "size" not in meta:
+            raise RBDError(errno.ENOENT, f"no image {name!r}")
+        return Image(
+            self, name,
+            size=int(meta["size"]),
+            order=int(meta["order"]),
+            prefix=meta["object_prefix"].decode(),
+        )
+
+
+class Image:
+    """An open image handle (librbd::Image)."""
+
+    def __init__(self, rbd: RBD, name: str, size: int, order: int, prefix: str):
+        self.rbd = rbd
+        self.name = name
+        self._size = size
+        self.order = order
+        self.obj_size = 1 << order
+        self.prefix = prefix
+
+    def size(self) -> int:
+        return self._size
+
+    def _oid(self, objectno: int) -> str:
+        return f"{self.prefix}.{objectno:016x}"
+
+    def _extents(self, off: int, length: int):
+        out = []
+        pos, end = off, off + length
+        while pos < end:
+            objno, obj_off = divmod(pos, self.obj_size)
+            n = min(self.obj_size - obj_off, end - pos)
+            out.append((objno, obj_off, n))
+            pos += n
+        return out
+
+    async def write(self, off: int, data: bytes) -> None:
+        if off + len(data) > self._size:
+            raise RBDError(errno.EINVAL, "write past image size")
+        pos = 0
+        writes = []
+        for objno, obj_off, n in self._extents(off, len(data)):
+            writes.append(self.rbd.data.write(
+                self._oid(objno), data[pos : pos + n], off=obj_off
+            ))
+            pos += n
+        await asyncio.gather(*writes)
+
+    async def read(self, off: int, length: int) -> bytes:
+        end = min(off + length, self._size)
+        if off >= end:
+            return b""
+
+        async def _one(objno: int, obj_off: int, n: int) -> bytes:
+            try:
+                chunk = await self.rbd.data.read(
+                    self._oid(objno), off=obj_off, length=n
+                )
+            except OSError as e:
+                if e.errno == errno.ENOENT:
+                    chunk = b""  # never written: zeros
+                else:
+                    raise
+            return chunk.ljust(n, b"\0")
+
+        parts = await asyncio.gather(*(
+            _one(*ext) for ext in self._extents(off, end - off)
+        ))
+        return b"".join(parts)
+
+    async def resize(self, new_size: int) -> None:
+        if new_size < self._size:
+            # drop whole objects past the end; trim the boundary object
+            first_dead = (new_size + self.obj_size - 1) // self.obj_size
+            last_old = (self._size + self.obj_size - 1) // self.obj_size
+            ops = []
+            for objno in range(first_dead, last_old):
+                ops.append(self._remove_quiet(self._oid(objno)))
+            if new_size % self.obj_size:
+                ops.append(self._trim_quiet(
+                    self._oid(new_size // self.obj_size),
+                    new_size % self.obj_size,
+                ))
+            if ops:
+                await asyncio.gather(*ops)
+        self._size = new_size
+        await self.rbd.meta.omap_set(f"rbd_header.{self.name}", {
+            "size": str(new_size).encode(),
+        })
+
+    async def _trim_quiet(self, oid: str, keep: int) -> None:
+        try:
+            cur = await self.rbd.data.stat(oid)
+        except OSError as e:
+            if e.errno == errno.ENOENT:
+                return
+            raise
+        if cur > keep:
+            await self.rbd.data.truncate(oid, keep)
+
+    async def _remove_quiet(self, oid: str) -> None:
+        try:
+            await self.rbd.data.remove(oid)
+        except OSError as e:
+            if e.errno != errno.ENOENT:
+                raise
+
+    async def remove_data(self) -> None:
+        n_objs = (self._size + self.obj_size - 1) // self.obj_size
+        await asyncio.gather(*(
+            self._remove_quiet(self._oid(i)) for i in range(n_objs)
+        ))
