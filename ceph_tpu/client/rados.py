@@ -25,7 +25,10 @@ from ceph_tpu.msg.messages import (
     MOSDMap,
     MOSDOp,
     MOSDOpReply,
+    MWatchNotify,
+    MWatchNotifyAck,
     OP_APPEND,
+    OP_CALL,
     OP_CREATE,
     OP_DELETE,
     OP_GETXATTR,
@@ -40,7 +43,10 @@ from ceph_tpu.msg.messages import (
     OP_RMXATTR,
     OP_SETXATTR,
     OP_STAT,
+    OP_NOTIFY,
     OP_TRUNCATE,
+    OP_UNWATCH,
+    OP_WATCH,
     OP_WRITE,
     OP_WRITE_FULL,
     OP_ZERO,
@@ -63,10 +69,11 @@ class RadosError(OSError):
 class RadosClient:
     """The cluster handle (librados::Rados)."""
 
-    def __init__(self, client_id: int | None = None):
+    def __init__(self, client_id: int | None = None, auth=None):
         self.id = client_id if client_id is not None else (os.getpid() << 8) | 1
         self.messenger = Messenger(
-            ("client", self.id), self._dispatch, on_reset=self._on_reset
+            ("client", self.id), self._dispatch, on_reset=self._on_reset,
+            auth=auth,
         )
         self.osdmap: OSDMap | None = None
         self._mon_conn: Connection | None = None
@@ -74,6 +81,9 @@ class RadosClient:
         self._op_waiters: dict[int, asyncio.Future] = {}
         self._cmd_waiters: dict[int, asyncio.Future] = {}
         self._map_event = asyncio.Event()
+        # watch registrations: cookie -> callback(notify_id, payload)
+        # -> optional reply bytes (librados watch2/notify2)
+        self._watches: dict[int, object] = {}
 
     async def connect(self, mon_host: str, mon_port: int) -> None:
         await self.connect_multi([(mon_host, mon_port)])
@@ -171,6 +181,24 @@ class RadosClient:
             fut = self._cmd_waiters.get(msg.tid)
             if fut and not fut.done():
                 fut.set_result(msg)
+        elif isinstance(msg, MWatchNotify):
+            cb = self._watches.get(msg.cookie)
+            if cb is None:
+                return  # stale/unknown watch handle: no ack (the
+                # notifier times this watcher out)
+            reply = b""
+            try:
+                out = cb(msg.notify_id, msg.payload)
+                if out:
+                    reply = bytes(out)
+            except Exception:
+                log.exception("watch callback failed")
+            try:
+                await msg.conn.send_message(MWatchNotifyAck(
+                    notify_id=msg.notify_id, cookie=msg.cookie, reply=reply,
+                ))
+            except ConnectionError:
+                pass
 
     async def _wait_new_map(self, than_epoch: int, timeout: float = 10.0) -> None:
         loop = asyncio.get_running_loop()
@@ -481,3 +509,60 @@ class IoCtx:
 
     async def omap_rm_keys(self, oid: str, keys: list[str]) -> None:
         await self.operate(oid, ObjectOperation().omap_rm_keys(keys))
+
+    # -- object classes (librados exec / cls dispatch) -----------------
+
+    async def execute(
+        self, oid: str, cls: str, method: str, indata: bytes = b""
+    ) -> bytes:
+        """librados exec(): run an object-class method on the primary."""
+        reply = await self.client._submit(self.pool_id, MOSDOp(
+            pool=self.pool_id, oid=oid,
+            ops=[OSDOp(OP_CALL, name=f"{cls}.{method}", data=bytes(indata))],
+        ))
+        if reply.outs and reply.outs[0][0] < 0:
+            raise RadosError(-reply.outs[0][0], f"exec {cls}.{method}")
+        if reply.result != 0:
+            raise RadosError(-reply.result, f"exec {cls}.{method}")
+        return reply.outs[0][1] if reply.outs else reply.data
+
+    # -- watch / notify (librados watch2/notify2) ----------------------
+
+    async def watch(self, oid: str, callback) -> int:
+        """Register a watch; returns the cookie.  ``callback(notify_id,
+        payload) -> bytes | None`` runs on every notify."""
+        cookie = next(self.client._tids)
+        # register BEFORE the op lands: a notify can race the watch
+        # reply and must find the callback
+        self.client._watches[cookie] = callback
+        try:
+            await self._op1(oid, "watch", op=OP_WATCH, off=cookie)
+        except BaseException:
+            self.client._watches.pop(cookie, None)
+            raise
+        return cookie
+
+    async def unwatch(self, oid: str, cookie: int) -> None:
+        self.client._watches.pop(cookie, None)
+        await self._op1(oid, "unwatch", op=OP_UNWATCH, off=cookie)
+
+    async def notify(
+        self, oid: str, payload: bytes = b"", timeout_ms: int = 5000
+    ) -> dict:
+        """Returns {"acks": [[entity, cookie, reply bytes]...],
+        "timeouts": [[entity, cookie]...]}."""
+        import base64
+        import json
+
+        reply = await self._op1(
+            oid, "notify", op=OP_NOTIFY, data=bytes(payload),
+            length=timeout_ms,
+        )
+        out = json.loads(reply.data.decode()) if reply.data else {
+            "acks": [], "timeouts": [],
+        }
+        out["acks"] = [
+            [tuple(e), c, base64.b64decode(r)] for e, c, r in out["acks"]
+        ]
+        out["timeouts"] = [[tuple(e), c] for e, c in out["timeouts"]]
+        return out

@@ -1,7 +1,11 @@
 """Shared daemon infrastructure (reference src/common/): typed config,
-perf counters, metrics exposition."""
+perf counters, metrics exposition, op tracking, admin sockets,
+leveled dout logging."""
 
+from ceph_tpu.common.admin_socket import AdminSocket, admin_command
 from ceph_tpu.common.config import OPTIONS, ConfigProxy, Option, declare
+from ceph_tpu.common.dout import DoutLogger
+from ceph_tpu.common.optracker import OpTracker, TrackedOp
 from ceph_tpu.common.metrics import (
     MetricsServer,
     PerfCounters,
@@ -11,7 +15,12 @@ from ceph_tpu.common.metrics import (
 )
 
 __all__ = [
+    "AdminSocket",
+    "DoutLogger",
     "OPTIONS",
+    "OpTracker",
+    "TrackedOp",
+    "admin_command",
     "ConfigProxy",
     "MetricsServer",
     "Option",

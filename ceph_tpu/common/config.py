@@ -84,6 +84,14 @@ declare(
     Option("mon_osd_min_down_reporters", int, 1, LEVEL_ADVANCED,
            "distinct failure reporters required before the mon marks "
            "an osd down", min=1),
+    Option("admin_socket", str, "", LEVEL_ADVANCED,
+           "unix socket path for daemon admin commands ('' disables; "
+           "the reference's admin_socket option)"),
+    Option("osd_op_complaint_time", float, 30.0, LEVEL_ADVANCED,
+           "ops slower than this land in the slow-op history "
+           "(reference osd_op_complaint_time)", min=0.0),
+    Option("osd_op_history_size", int, 20, LEVEL_ADVANCED,
+           "completed ops kept for dump_historic_ops", min=0),
     Option("osd_min_pg_log_entries", int, 128, LEVEL_ADVANCED,
            "pg log entries kept per shard", min=1,
            see_also=("osd_max_pg_log_entries",)),

@@ -1,0 +1,105 @@
+"""In-flight op tracking + slow-op forensics — the TrackedOp twin.
+
+Behavioral twin of the reference's op tracker (src/common/TrackedOp.h:
+121 OpTracker, TrackedOp::mark_event; src/osd/OpRequest.h): every
+client op registers on arrival, marks named events as it moves through
+the pipeline, and lands in a bounded history on completion; ops slower
+than the complaint threshold are kept in a separate slow-op history
+and counted, and the admin socket exposes ``dump_ops_in_flight`` /
+``dump_historic_ops`` / ``dump_historic_slow_ops`` exactly like the
+reference daemons.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+
+
+class TrackedOp:
+    __slots__ = ("tracker", "id", "description", "start", "events", "done_at")
+
+    def __init__(self, tracker: "OpTracker", opid: int, description: str):
+        self.tracker = tracker
+        self.id = opid
+        self.description = description
+        self.start = time.monotonic()
+        self.events: list[tuple[float, str]] = [(self.start, "initiated")]
+        self.done_at: float | None = None
+
+    def mark_event(self, name: str) -> None:
+        self.events.append((time.monotonic(), name))
+
+    def finish(self) -> None:
+        self.tracker.complete(self)
+
+    @property
+    def duration(self) -> float:
+        return (self.done_at or time.monotonic()) - self.start
+
+    def dump(self) -> dict:
+        return {
+            "id": self.id,
+            "description": self.description,
+            "age": round(time.monotonic() - self.start, 6),
+            "duration": round(self.duration, 6),
+            "type_data": {
+                "events": [
+                    {"event": name, "at": round(t - self.start, 6)}
+                    for t, name in self.events
+                ],
+            },
+        }
+
+
+class OpTracker:
+    """Reference OpTracker: in-flight registry + bounded histories."""
+
+    def __init__(
+        self,
+        history_size: int = 20,
+        slow_threshold: float = 30.0,
+        slow_history_size: int = 20,
+    ):
+        self._ids = itertools.count(1)
+        self.inflight: dict[int, TrackedOp] = {}
+        self.history: deque[TrackedOp] = deque(maxlen=history_size)
+        self.slow_history: deque[TrackedOp] = deque(maxlen=slow_history_size)
+        self.slow_threshold = slow_threshold
+        self.complaints = 0
+
+    def create(self, description: str) -> TrackedOp:
+        op = TrackedOp(self, next(self._ids), description)
+        self.inflight[op.id] = op
+        return op
+
+    def complete(self, op: TrackedOp) -> None:
+        op.done_at = time.monotonic()
+        op.mark_event("done")
+        self.inflight.pop(op.id, None)
+        self.history.append(op)
+        if op.duration >= self.slow_threshold:
+            self.slow_history.append(op)
+            self.complaints += 1
+
+    # -- admin-socket dumps (TrackedOp.cc dump_ops_in_flight et al) ----
+
+    def dump_ops_in_flight(self) -> dict:
+        return {
+            "num_ops": len(self.inflight),
+            "ops": [op.dump() for op in self.inflight.values()],
+        }
+
+    def dump_historic_ops(self) -> dict:
+        return {
+            "num_ops": len(self.history),
+            "ops": [op.dump() for op in self.history],
+        }
+
+    def dump_historic_slow_ops(self) -> dict:
+        return {
+            "num_ops": len(self.slow_history),
+            "complaints": self.complaints,
+            "ops": [op.dump() for op in self.slow_history],
+        }

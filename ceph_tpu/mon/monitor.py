@@ -65,6 +65,7 @@ class Monitor:
         paxos_trim_max: int = 500,
         paxos_trim_keep: int = 250,
         conf=None,
+        auth=None,
     ):
         """``beacon_grace``/``out_interval``: seconds without a beacon
         before an OSD is marked down / out; 0 disables the sweep (tests
@@ -88,7 +89,8 @@ class Monitor:
         self.monmap: list[tuple[str, int]] = []
         self.osdmap = OSDMap(crush=crush or CrushMap())
         self.messenger = Messenger(
-            ("mon", rank), self._dispatch, on_reset=self._on_reset
+            ("mon", rank), self._dispatch, on_reset=self._on_reset,
+            auth=auth,
         )
         self.store = MonStore(store) if store is not None else None
         self.paxos = Paxos(
@@ -129,6 +131,7 @@ class Monitor:
         self._tids = itertools.count(1)
         self._scrub_waiters: dict[int, asyncio.Future] = {}
         self._tick_task: asyncio.Task | None = None
+        self._admin = None
         self.addr: tuple[str, int] | None = None
         self._snapshot()
 
@@ -136,6 +139,35 @@ class Monitor:
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
         self.addr = await self.messenger.bind(host, port)
+        sock_path = self.conf["admin_socket"]
+        if sock_path:
+            from ceph_tpu.common import AdminSocket
+
+            self._admin = AdminSocket(
+                sock_path.replace("$id", f"mon{self.rank}")
+            )
+            self._admin.register(
+                "config show", "effective configuration",
+                lambda cmd: self.conf.show(),
+            )
+            self._admin.register(
+                "quorum_status", "election/quorum state",
+                lambda cmd: {
+                    "rank": self.rank,
+                    "leader": self.paxos.leader,
+                    "election_epoch": self.paxos.election_epoch,
+                    "quorum": sorted(self.paxos.quorum),
+                    "last_committed": self.paxos.last_committed,
+                },
+            )
+            self._admin.register(
+                "status", "cluster status",
+                lambda cmd: {
+                    "epoch": self.osdmap.epoch,
+                    "num_pools": len(self.osdmap.pools),
+                },
+            )
+            await self._admin.start()
         await self._replay()
         if self.beacon_grace > 0:
             self._tick_task = asyncio.ensure_future(self._tick())
@@ -239,6 +271,8 @@ class Monitor:
         await asyncio.wait_for(self.paxos.stable.wait(), timeout)
 
     async def stop(self) -> None:
+        if self._admin is not None:
+            await self._admin.stop()
         if self._tick_task:
             self._tick_task.cancel()
         await self.messenger.shutdown()

@@ -4,8 +4,14 @@ Behavioral twin of the reference's protocol v2 framing
 (src/msg/async/frames_v2.h:40-143): a banner exchange, then segmented
 frames — preamble (tag, segment count, segment lengths, preamble crc)
 followed by the segments and an epilogue carrying per-segment crc32c.
-Secure (AES-GCM) mode and on-wire compression are not implemented yet;
 crc mode matches the reference's rev1 epilogue semantics.
+
+SECURE mode (the reference's crypto_onwire.cc): once a connection's
+auth handshake establishes a session key, ``write_frame``/``read_frame``
+take a :class:`~ceph_tpu.msg.auth.FrameCrypto` and every frame ships as
+``u32 length || AES-GCM(tag || nseg || seg_lens || segments)`` with
+per-direction keys and counter nonces — confidentiality + integrity
+replace the crc epilogue, and any tamper or replay fails the AEAD tag.
 
 All crcs use the native crc32c runtime (ceph_tpu/native), seeded -1
 like the reference frame crcs.
@@ -62,10 +68,20 @@ def _preamble(tag: int, seg_lens: list[int]) -> bytes:
 
 
 async def write_frame(
-    writer: asyncio.StreamWriter, tag: int, segments: list[bytes]
+    writer: asyncio.StreamWriter, tag: int, segments: list[bytes],
+    crypto=None,
 ) -> None:
     assert 0 < len(segments) <= MAX_SEGMENTS
     segs = [bytes(s) for s in segments]
+    if crypto is not None:
+        plain = struct.pack(
+            "<BB4I", tag, len(segs),
+            *([len(s) for s in segs] + [0] * (MAX_SEGMENTS - len(segs))),
+        ) + b"".join(segs)
+        ct = crypto.encrypt(plain)
+        writer.write(struct.pack("<I", len(ct)) + ct)
+        await writer.drain()
+        return
     writer.write(_preamble(tag, [len(s) for s in segs]))
     for s in segs:
         writer.write(s)
@@ -75,8 +91,28 @@ async def write_frame(
 
 
 async def read_frame(
-    reader: asyncio.StreamReader,
+    reader: asyncio.StreamReader, crypto=None,
 ) -> tuple[int, list[bytes]]:
+    if crypto is not None:
+        (ln,) = struct.unpack("<I", await reader.readexactly(4))
+        if ln > MAX_FRAME_LEN:
+            raise FrameError("secure frame too large")
+        try:
+            plain = crypto.decrypt(await reader.readexactly(ln))
+        except Exception as e:  # InvalidTag and friends
+            raise FrameError(f"secure frame authentication failed: {e}")
+        tag, nseg = plain[0], plain[1]
+        if not 0 < nseg <= MAX_SEGMENTS:
+            raise FrameError(f"bad segment count {nseg}")
+        seg_lens = struct.unpack_from("<4I", plain, 2)[:nseg]
+        off = 2 + 16
+        segs = []
+        for n in seg_lens:
+            segs.append(plain[off : off + n])
+            off += n
+        if off != len(plain):
+            raise FrameError("secure frame length mismatch")
+        return tag, segs
     head = await reader.readexactly(18)
     (want_crc,) = struct.unpack("<I", await reader.readexactly(4))
     if crc32c(head) != want_crc:

@@ -271,6 +271,11 @@ class OSDOp:
         )
 
     def is_write(self) -> bool:
+        if self.op == OP_CALL:
+            from ceph_tpu.cls import method_is_write
+
+            c, _, m = self.name.partition(".")
+            return method_is_write(c, m)
         return self.op in WRITE_OPS
 
 
@@ -830,6 +835,53 @@ class MOSDPGLogAck(Message):
         tid = dec.u64()
         pg, shard = _dec_pg(dec)
         return cls(tid, pg, shard, dec.i32(), dec.i32(), dec.u32())
+
+
+# -- watch/notify (src/messages/MWatchNotify.h) -----------------------------
+
+class MWatchNotify(Message):
+    """primary OSD -> watching client: a notify fired on an object the
+    client watches (reference MWatchNotify; the client acks with
+    MWatchNotifyAck and the notifier's OP_NOTIFY completes when every
+    watcher acked or timed out)."""
+
+    TYPE = 73
+
+    def __init__(
+        self, notify_id: int = 0, cookie: int = 0, oid: str = "",
+        pool: int = 0, payload: bytes = b"",
+    ):
+        self.notify_id, self.cookie = notify_id, cookie
+        self.oid, self.pool, self.payload = oid, pool, payload
+
+    def encode_payload(self, enc):
+        enc.u64(self.notify_id)
+        enc.u64(self.cookie)
+        enc.str_(self.oid)
+        enc.i64(self.pool)
+        enc.bytes_(self.payload)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        return cls(dec.u64(), dec.u64(), dec.str_(), dec.i64(), dec.bytes_())
+
+
+class MWatchNotifyAck(Message):
+    TYPE = 74
+
+    def __init__(
+        self, notify_id: int = 0, cookie: int = 0, reply: bytes = b"",
+    ):
+        self.notify_id, self.cookie, self.reply = notify_id, cookie, reply
+
+    def encode_payload(self, enc):
+        enc.u64(self.notify_id)
+        enc.u64(self.cookie)
+        enc.bytes_(self.reply)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        return cls(dec.u64(), dec.u64(), dec.bytes_())
 
 
 # -- heartbeats (src/messages/MOSDPing.h) -----------------------------------

@@ -98,6 +98,8 @@ class Connection:
         self._seq = 0
         self._closed = False
         self._reader_task: asyncio.Task | None = None
+        # msgr2 SECURE mode: set by the auth handshake; None = crc mode
+        self.crypto = None
 
     async def send_message(self, msg: Message) -> None:
         if self._closed:
@@ -116,19 +118,29 @@ class Connection:
         async with self._send_lock:
             self._seq += 1
             segs = encode_message(msg, self.messenger.entity, self._seq)
-            await frames.write_frame(self.writer, frames.Tag.MESSAGE, segs)
+            await frames.write_frame(
+                self.writer, frames.Tag.MESSAGE, segs, crypto=self.crypto
+            )
 
     async def _run(self) -> None:
         try:
             while not self._closed:
-                tag, segs = await frames.read_frame(self.reader)
+                tag, segs = await frames.read_frame(
+                    self.reader, crypto=self.crypto
+                )
+                if getattr(self, "_needs_auth_proof", False):
+                    # first frame decrypted+authenticated: the peer
+                    # holds the session key; NOW adopt it for routing
+                    self._needs_auth_proof = False
+                    await self.messenger._register(self)
                 if tag == frames.Tag.MESSAGE:
                     msg = decode_message(segs)
                     msg.conn = self
                     await self.messenger._dispatch(msg)
                 elif tag == frames.Tag.KEEPALIVE2:
                     await frames.write_frame(
-                        self.writer, frames.Tag.KEEPALIVE2_ACK, segs
+                        self.writer, frames.Tag.KEEPALIVE2_ACK, segs,
+                        crypto=self.crypto,
                     )
                 elif tag == frames.Tag.CLOSE:
                     break
@@ -169,10 +181,14 @@ class Messenger:
         entity: tuple[str, int],
         dispatcher: Callable[[Message], Awaitable[None]] | None = None,
         on_reset: Callable[[Connection], Awaitable[None]] | None = None,
+        auth=None,
     ):
         self.entity = entity
         self.dispatcher = dispatcher
         self.on_reset = on_reset
+        # AuthContext (ceph_tpu.msg.auth) => cephx handshake + SECURE
+        # frames on every connection; None => legacy crc mode
+        self.auth = auth
         self._server: asyncio.base_events.Server | None = None
         self._conns: dict[tuple[str, int], Connection] = {}  # by entity
         # every live connection needs a strong root: asyncio's
@@ -230,10 +246,13 @@ class Messenger:
             enc.str_(self.entity[0])
             enc.i64(self.entity[1])
             await frames.write_frame(writer, frames.Tag.HELLO, [enc.bytes()])
-        except (ConnectionError, asyncio.IncompleteReadError, OSError):
+            if self.auth is not None:
+                await self._auth_accept(conn)
+        except (ConnectionError, asyncio.IncompleteReadError, OSError, PermissionError):
             writer.close()
             return
-        await self._register(conn)
+        if not getattr(conn, "_needs_auth_proof", False):
+            await self._register(conn)
         self._live.add(conn)
         conn._reader_task = asyncio.ensure_future(conn._run())
 
@@ -290,10 +309,125 @@ class Messenger:
             raise frames.FrameError(f"expected HELLO, got {tag}")
         dec = Decoder(segs[0])
         conn.peer = (dec.str_(), dec.i64())
+        if self.auth is not None:
+            await self._auth_connect(conn)
         await self._register(conn)
         self._live.add(conn)
         conn._reader_task = asyncio.ensure_future(conn._run())
         return conn
+
+    # -- cephx handshake (see ceph_tpu/msg/auth.py) --------------------
+
+    async def _auth_connect(self, conn: Connection) -> None:
+        """Outbound side: present a ticket (cluster daemons self-mint;
+        clients use the one granted by the mon) or, first mon contact,
+        request a grant.  Ends with the connection in SECURE mode."""
+        import os as _os
+
+        from ceph_tpu.msg.auth import FrameCrypto
+
+        a = self.auth
+        nonce_c = _os.urandom(12)
+        if a.service_secret is not None:
+            ticket, session_key = a.self_ticket()
+        elif a.ticket is not None:
+            ticket, session_key = a.ticket, a.session_key
+        else:
+            ticket, session_key = None, None  # mon grant flow
+        enc = Encoder()
+        enc.str_(a.entity)
+        enc.bool_(ticket is not None)
+        enc.bytes_(ticket or b"")
+        enc.bytes_(nonce_c)
+        await frames.write_frame(
+            conn.writer, frames.Tag.AUTH_REQUEST, [enc.bytes()]
+        )
+        tag, segs = await frames.read_frame(conn.reader)
+        if tag != frames.Tag.AUTH_DONE:
+            raise frames.FrameError(f"expected AUTH_DONE, got {tag}")
+        dec = Decoder(segs[0])
+        granted = dec.bool_()
+        sealed = dec.bytes_()
+        nonce_s = dec.bytes_()
+        if granted:
+            try:
+                session_key, new_ticket = a.open_grant(sealed)
+            except Exception as e:  # InvalidTag: not sealed for OUR key
+                raise frames.FrameError(
+                    f"grant not decryptable with our secret: {e}"
+                )
+            # keep the grant for subsequent OSD dials (client flow)
+            a.ticket, a.session_key = new_ticket, session_key
+        if session_key is None:
+            raise frames.FrameError("auth refused")
+        conn.crypto = FrameCrypto.from_session(
+            session_key, nonce_c, nonce_s, connector=True
+        )
+
+    async def _auth_accept(self, conn: Connection) -> None:
+        import os as _os
+
+        from ceph_tpu.msg.auth import FrameCrypto, open_ticket
+
+        a = self.auth
+        tag, segs = await frames.read_frame(conn.reader)
+        if tag != frames.Tag.AUTH_REQUEST:
+            raise frames.FrameError(f"expected AUTH_REQUEST, got {tag}")
+        dec = Decoder(segs[0])
+        entity = dec.str_()
+        has_ticket = dec.bool_()
+        ticket = dec.bytes_()
+        nonce_c = dec.bytes_()
+        nonce_s = _os.urandom(12)
+        if has_ticket:
+            if a.service_secret is None:
+                raise PermissionError("cannot validate tickets")
+            try:
+                t_entity, session_key = open_ticket(a.service_secret, ticket)
+            except PermissionError:
+                raise
+            except Exception as e:  # InvalidTag / malformed blob
+                raise PermissionError(f"bad ticket: {type(e).__name__}")
+            if t_entity != entity:
+                raise PermissionError(
+                    f"ticket entity {t_entity!r} != claimed {entity!r}"
+                )
+            enc = Encoder()
+            enc.bool_(False)
+            enc.bytes_(b"")
+            enc.bytes_(nonce_s)
+            await frames.write_frame(
+                conn.writer, frames.Tag.AUTH_DONE, [enc.bytes()]
+            )
+        else:
+            res = a.grant(entity)
+            if res is None:
+                raise PermissionError(f"unknown entity {entity!r}")
+            sealed, session_key, _ticket = res
+            enc = Encoder()
+            enc.bool_(True)
+            enc.bytes_(sealed)
+            enc.bytes_(nonce_s)
+            await frames.write_frame(
+                conn.writer, frames.Tag.AUTH_DONE, [enc.bytes()]
+            )
+        # the claimed entity must match the HELLO identity
+        kind, _, num = entity.partition(".")
+        try:
+            claimed = (kind, int(num))
+        except ValueError:
+            raise PermissionError(f"malformed entity {entity!r}")
+        if conn.peer != claimed:
+            raise PermissionError(
+                f"auth entity {entity!r} != hello identity {conn.peer}"
+            )
+        conn.crypto = FrameCrypto.from_session(
+            session_key, nonce_c, nonce_s, connector=False
+        )
+        # identity is CLAIMED until the peer proves possession of the
+        # session key by sending a frame that authenticates: outbound
+        # routing must not be hijackable by a keyless impostor
+        conn._needs_auth_proof = True
 
     def get_connection(self, peer: tuple[str, int]) -> Connection | None:
         return self._conns.get(peer)

@@ -56,6 +56,8 @@ from ceph_tpu.msg.messages import (
     MOSDFailure,
     MOSDMap,
     MOSDPing,
+    MWatchNotify,
+    MWatchNotifyAck,
     MOSDOp,
     MOSDOpReply,
     MOSDPGPush,
@@ -69,6 +71,7 @@ from ceph_tpu.msg.messages import (
     MOSDScrub,
     MOSDScrubReply,
     OP_APPEND,
+    OP_CALL,
     OP_CREATE,
     OP_DELETE,
     OP_GETXATTR,
@@ -84,6 +87,9 @@ from ceph_tpu.msg.messages import (
     OP_SETXATTR,
     OP_STAT,
     OP_TRUNCATE,
+    OP_NOTIFY,
+    OP_UNWATCH,
+    OP_WATCH,
     OP_WRITE,
     OP_WRITE_FULL,
     OP_ZERO,
@@ -150,6 +156,7 @@ class OSDDaemon:
         store: MemStore | None = None,
         beacon_interval: float | None = None,
         conf=None,
+        auth=None,
     ):
         from ceph_tpu.common import ConfigProxy, get_perf_counters
 
@@ -162,12 +169,22 @@ class OSDDaemon:
         self.conf = conf if conf is not None else ConfigProxy()
         self.store = store or MemStore()
         self.messenger = Messenger(
-            ("osd", osd_id), self._dispatch, on_reset=self._on_reset
+            ("osd", osd_id), self._dispatch, on_reset=self._on_reset,
+            auth=auth,
         )
         self.messenger.inject_socket_failures = self.conf[
             "ms_inject_socket_failures"
         ]
         self.perf = get_perf_counters(f"osd.{osd_id}")
+        from ceph_tpu.common import DoutLogger, OpTracker
+
+        # slow-op forensics (TrackedOp.h:121) + per-subsystem dout
+        self.op_tracker = OpTracker(
+            history_size=self.conf["osd_op_history_size"],
+            slow_threshold=self.conf["osd_op_complaint_time"],
+        )
+        self.dlog = DoutLogger("osd", self.conf, name_suffix=str(osd_id))
+        self._admin: object | None = None
         self._log_keep = self.conf["osd_min_pg_log_entries"]
         self.osdmap: OSDMap | None = None
         self.beacon_interval = (
@@ -184,6 +201,11 @@ class OSDDaemon:
         # analogue): RMW read/encode/fan-out must not interleave with
         # another write to the same object
         self._obj_locks: dict[tuple[int, str], asyncio.Lock] = {}
+        # watch/notify state (primary-local; the reference persists
+        # watchers in object_info and re-establishes via client linger —
+        # here clients re-watch after a primary change)
+        self._watchers: dict[tuple[int, str], dict[tuple, object]] = {}
+        self._notify_waiters: dict[int, asyncio.Future] = {}
         self._ec_cache: dict[str, object] = {}
         self._pg_logs: dict[coll_t, PGLog] = {}
         self._beacon_task: asyncio.Task | None = None
@@ -205,6 +227,13 @@ class OSDDaemon:
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> None:
         self.addr = await self.messenger.bind(host, port)
+        sock_path = self.conf["admin_socket"]
+        if sock_path:
+            from ceph_tpu.common import AdminSocket
+
+            self._admin = AdminSocket(sock_path.replace("$id", str(self.id)))
+            self._register_admin_commands(self._admin)
+            await self._admin.start()
         await self._mon_hunt()
         if self.beacon_interval > 0:
             self._beacon_task = asyncio.ensure_future(self._beacon())
@@ -233,8 +262,50 @@ class OSDDaemon:
                 last = e
         raise ConnectionError(f"osd.{self.id}: no monitor reachable: {last}")
 
+    def _register_admin_commands(self, sock) -> None:
+        """The reference OSD's admin-socket surface
+        (src/osd/OSD.cc::asok_command slice)."""
+        sock.register(
+            "perf dump", "dump perf counters",
+            lambda cmd: self.perf.dump(),
+        )
+        sock.register(
+            "dump_ops_in_flight", "in-flight client ops",
+            lambda cmd: self.op_tracker.dump_ops_in_flight(),
+        )
+        sock.register(
+            "dump_historic_ops", "recently completed ops",
+            lambda cmd: self.op_tracker.dump_historic_ops(),
+        )
+        sock.register(
+            "dump_historic_slow_ops", "ops over the complaint threshold",
+            lambda cmd: self.op_tracker.dump_historic_slow_ops(),
+        )
+        sock.register(
+            "config show", "effective configuration",
+            lambda cmd: self.conf.show(),
+        )
+        sock.register(
+            "config set", "set a config option at runtime",
+            lambda cmd: (
+                self.conf.apply_changes({cmd["var"]: cmd["val"]}),
+                {"success": cmd["var"]},
+            )[1],
+        )
+        sock.register(
+            "status", "daemon status",
+            lambda cmd: {
+                "osd": self.id,
+                "epoch": self.epoch,
+                "up": not self.stopping,
+                "num_pgs": len(self._pg_logs),
+            },
+        )
+
     async def stop(self) -> None:
         self.stopping = True
+        if self._admin is not None:
+            await self._admin.stop()
         for t in (
             self._beacon_task, self._hb_task, self._recovery_task,
             getattr(self, "_rehome_task", None),
@@ -437,6 +508,8 @@ class OSDDaemon:
                 await self._handle_map(msg)
             elif isinstance(msg, MOSDPing):
                 await self._handle_ping(msg)
+            elif isinstance(msg, MWatchNotifyAck):
+                self._handle_notify_ack(msg)
             elif isinstance(msg, MOSDOp):
                 asyncio.ensure_future(self._handle_client_op(msg))
             elif isinstance(msg, MOSDECSubOpWrite):
@@ -518,6 +591,10 @@ class OSDDaemon:
     # -- client ops (the PrimaryLogPG::do_op slice) --------------------
 
     async def _handle_client_op(self, msg: MOSDOp) -> None:
+        tracked = self.op_tracker.create(
+            f"osd_op({msg.oid} pool={msg.pool} "
+            f"ops={[o.op for o in msg.ops]} tid={msg.tid})"
+        )
         try:
             self.perf.inc("op")
             if msg.is_write():
@@ -527,7 +604,10 @@ class OSDDaemon:
                 )
             else:
                 self.perf.inc("op_r")
+            self.dlog.dout(4, "osd.%d: op %s", self.id, tracked.description)
+            tracked.mark_event("executing")
             reply = await self._execute_op(msg)
+            tracked.mark_event("replying")
             if reply.result == 0 and reply.data:
                 self.perf.inc("op_out_bytes", len(reply.data))
         except ECConnErrors as e:
@@ -542,6 +622,8 @@ class OSDDaemon:
             await msg.conn.send_message(reply)
         except ConnectionError:
             pass
+        finally:
+            tracked.finish()
 
     async def _execute_op(self, msg: MOSDOp) -> MOSDOpReply:
         """do_op/do_osd_ops dispatch: route the op vector to the pool's
@@ -557,6 +639,8 @@ class OSDDaemon:
         if primary != self.id:
             # client raced a map change; tell it to retry on a newer map
             return MOSDOpReply(tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
+        if any(o.op in (OP_WATCH, OP_UNWATCH, OP_NOTIFY) for o in msg.ops):
+            return await self._watch_notify_vector(pool, pg, msg)
         if msg.is_write():
             async with self._obj_lock(pool.id, msg.oid):
                 if pool.is_erasure():
@@ -1169,6 +1253,82 @@ class OSDDaemon:
             )
         await msg.conn.send_message(rep)
 
+    # -- watch/notify (PrimaryLogPG watch/notify + MWatchNotify) -------
+
+    async def _watch_notify_vector(self, pool, pg, msg) -> MOSDOpReply:
+        import base64
+        import json
+
+        outs = []
+        for o in msg.ops:
+            r, d, kv = 0, b"", {}
+            key = (pool.id, msg.oid)
+            if o.op not in (OP_WATCH, OP_UNWATCH, OP_NOTIFY):
+                # watch vectors are control-only; silently "succeeding"
+                # a data op here would drop it
+                outs.append((-errno.EOPNOTSUPP, b"", {}))
+                continue
+            if o.op == OP_WATCH:
+                self._watchers.setdefault(key, {})[
+                    (msg.src, o.off)
+                ] = msg.conn
+            elif o.op == OP_UNWATCH:
+                self._watchers.get(key, {}).pop((msg.src, o.off), None)
+            elif o.op == OP_NOTIFY:
+                notify_id = next(self._tids)
+                timeout = (o.length or 5000) / 1000.0
+                watchers = dict(self._watchers.get(key, {}))
+                acks: list[tuple] = []
+                missed: list[tuple] = []
+                waits = []
+                for (entity, cookie), conn in watchers.items():
+                    fut = asyncio.get_running_loop().create_future()
+                    self._notify_waiters[notify_id * 1000003 + cookie] = fut
+                    try:
+                        await conn.send_message(MWatchNotify(
+                            notify_id=notify_id, cookie=cookie,
+                            oid=msg.oid, pool=pool.id, payload=o.data,
+                        ))
+                        waits.append((entity, cookie, fut))
+                    except (ConnectionError, OSError):
+                        # dead watcher: drop it (client linger would
+                        # re-establish in the reference)
+                        self._watchers.get(key, {}).pop((entity, cookie), None)
+                        self._notify_waiters.pop(
+                            notify_id * 1000003 + cookie, None)
+                deadline = asyncio.get_running_loop().time() + timeout
+                for entity, cookie, fut in waits:
+                    remaining = deadline - asyncio.get_running_loop().time()
+                    try:
+                        ack = await asyncio.wait_for(
+                            fut, max(0.001, remaining)
+                        )
+                        acks.append((entity, cookie, ack.reply))
+                    except asyncio.TimeoutError:
+                        missed.append((entity, cookie))
+                    finally:
+                        self._notify_waiters.pop(
+                            notify_id * 1000003 + cookie, None)
+                d = json.dumps({
+                    "acks": [
+                        [list(e), c, base64.b64encode(rep).decode()]
+                        for e, c, rep in acks
+                    ],
+                    "timeouts": [[list(e), c] for e, c in missed],
+                }).encode()
+            outs.append((r, d, kv))
+        data = next((d for _r, d, _kv in outs if d), b"")
+        result = next((r for r, _d, _kv in outs if r != 0), 0)
+        return MOSDOpReply(
+            tid=msg.tid, result=result, epoch=self.epoch, data=data,
+            outs=outs,
+        )
+
+    def _handle_notify_ack(self, msg: MWatchNotifyAck) -> None:
+        fut = self._notify_waiters.get(msg.notify_id * 1000003 + msg.cookie)
+        if fut and not fut.done():
+            fut.set_result(msg)
+
     # -- replicated backend -------------------------------------------
 
     async def _rep_read_vector(self, pool, pg, acting, msg) -> MOSDOpReply:
@@ -1204,6 +1364,12 @@ class OSDDaemon:
                 kv = self.store.omap_get(c, o)
             elif op.op == OP_OMAP_GETVALSBYKEYS:
                 kv = self.store.omap_get_values(c, o, op.keys)
+            elif op.op == OP_CALL:
+                from ceph_tpu import cls as _cls
+
+                cname, _, meth = op.name.partition(".")
+                ctx = _cls.MethodContext(self.store, c, o)
+                r, d = _cls.call(cname, meth, ctx, op.data)
             else:
                 r = -errno.EOPNOTSUPP
             outs.append((r, d, kv))
@@ -1225,7 +1391,27 @@ class OSDDaemon:
         exists = self.store.exists(c, o)
         size = self.store.stat(c, o) if exists else 0
         effects: list[OSDOp] = []
+        outs: list[tuple[int, bytes, dict]] = []
+        expanded: list[OSDOp] = []
         for op in ops:
+            if op.op == OP_CALL:
+                # run the object-class method on the primary; its
+                # recorded mutations splice into the effect vector so
+                # class side effects replicate atomically (objclass
+                # dispatch, src/osd/PrimaryLogPG.cc CEPH_OSD_OP_CALL)
+                from ceph_tpu import cls as _cls
+
+                cname, _, meth = op.name.partition(".")
+                ctx = _cls.MethodContext(self.store, c, o)
+                rc, outdata = _cls.call(cname, meth, ctx, op.data)
+                outs.append((rc, outdata, {}))
+                if rc < 0:
+                    return -rc
+                expanded.extend(ctx.effects)
+            else:
+                outs.append((0, b"", {}))
+                expanded.append(op)
+        for op in expanded:
             if op.op == OP_CREATE:
                 if op.off and exists:
                     return errno.EEXIST
@@ -1270,7 +1456,7 @@ class OSDDaemon:
                 return errno.EOPNOTSUPP
         # an object deleted mid-vector and rewritten afterwards is not a
         # delete; only the final state counts for the log entry
-        return effects, size, not exists
+        return effects, size, not exists, outs
 
     def _rep_effect_txn(
         self, pool, pg, oid, effects, attrs, version: eversion_t,
@@ -1336,7 +1522,7 @@ class OSDDaemon:
         resolved = self._rep_effects(c, o, msg.ops)
         if isinstance(resolved, int):
             return MOSDOpReply(tid=msg.tid, result=-resolved, epoch=self.epoch)
-        effects, size, delete = resolved
+        effects, size, delete, call_outs = resolved
         version = self._next_version(c)
         attrs = {
             SIZE_ATTR: str(size).encode(),
@@ -1365,7 +1551,11 @@ class OSDDaemon:
             for rep in replies:
                 if rep.result != 0:
                     return MOSDOpReply(tid=msg.tid, result=rep.result, epoch=self.epoch)
-        return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
+        first_out = next((d for _r, d, _kv in call_outs if d), b"")
+        return MOSDOpReply(
+            tid=msg.tid, result=0, epoch=self.epoch, outs=call_outs,
+            data=first_out,
+        )
 
     async def _apply_full_object(
         self, pool, pg, oid, data, attrs, delete=False,

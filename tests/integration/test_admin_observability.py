@@ -1,0 +1,95 @@
+"""Admin socket + OpTracker + dout over live daemons.
+
+Reference surfaces: src/common/admin_socket.h (`ceph daemon <sock>
+<cmd>` JSON protocol), src/common/TrackedOp.h:121 (in-flight registry,
+historic + slow-op dumps, complaint threshold), src/common/dout.h
+(per-subsystem levels honoring live config changes).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ceph_tpu.common import ConfigProxy, DoutLogger, OpTracker, admin_command
+
+from .test_mini_cluster import Cluster, run
+
+
+def test_op_tracker_histories():
+    t = OpTracker(history_size=3, slow_threshold=0.0)  # everything "slow"
+    ops = [t.create(f"op{i}") for i in range(5)]
+    assert t.dump_ops_in_flight()["num_ops"] == 5
+    for op in ops:
+        op.mark_event("stage")
+        op.finish()
+    assert t.dump_ops_in_flight()["num_ops"] == 0
+    hist = t.dump_historic_ops()
+    assert hist["num_ops"] == 3  # bounded
+    assert [o["description"] for o in hist["ops"]] == ["op2", "op3", "op4"]
+    slow = t.dump_historic_slow_ops()
+    assert slow["complaints"] == 5
+    events = hist["ops"][0]["type_data"]["events"]
+    assert [e["event"] for e in events] == ["initiated", "stage", "done"]
+
+
+def test_dout_levels_live_update(caplog):
+    conf = ConfigProxy({"debug_osd": 1})
+    d = DoutLogger("osd", conf, name_suffix="t")
+    with caplog.at_level(logging.DEBUG, logger="ceph_tpu.osd.t"):
+        d.dout(5, "hidden %d", 1)
+        d.dout(1, "visible %d", 2)
+        conf.apply_changes({"debug_osd": 5})
+        d.dout(5, "now visible %d", 3)
+        d.derr("always %d", 4)
+    msgs = [r.getMessage() for r in caplog.records]
+    assert msgs == ["visible 2", "now visible 3", "always 4"]
+
+
+class TestAdminSocket:
+    def test_osd_admin_surface(self, tmp_path):
+        async def go():
+            sock_dir = str(tmp_path)
+            conf = {"admin_socket": sock_dir + "/osd.$id.asok"}
+            async with Cluster(n_osds=4, osd_conf=conf) as c:
+                await c.client.pool_create("rbd", pg_num=8, size=3)
+                io = c.client.ioctx("rbd")
+                for i in range(6):
+                    await io.write_full(f"o{i}", b"x" * 1000)
+
+                # find a primary that served ops and query its socket
+                path = sock_dir + "/osd.0.asok"
+                helptext = await admin_command(path, "help")
+                assert "dump_ops_in_flight" in helptext
+                perf = await admin_command(path, "perf dump")
+                assert isinstance(perf, dict)
+                cfg = await admin_command(path, "config show")
+                assert cfg["osd_op_history_size"] == 20
+                status = await admin_command(path, "status")
+                assert status["osd"] == 0 and status["up"]
+
+                # some OSD recorded completed client ops
+                total_hist = 0
+                for i in range(4):
+                    h = await admin_command(
+                        sock_dir + f"/osd.{i}.asok", "dump_historic_ops"
+                    )
+                    total_hist += h["num_ops"]
+                assert total_hist >= 6
+                # in-flight is empty at rest, events recorded
+                infl = await admin_command(path, "dump_ops_in_flight")
+                assert infl["num_ops"] == 0
+
+                # runtime config change through the socket
+                out = await admin_command(path, {
+                    "prefix": "config set", "var": "debug_osd", "val": "5",
+                })
+                assert out["success"] == "debug_osd"
+                cfg = await admin_command(path, "config show")
+                assert cfg["debug_osd"] == 5
+                assert c.osds[0].dlog.level == 5  # observer fired
+
+                unknown = await admin_command(path, "frobnicate")
+                assert "error" in unknown
+
+        run(go())
