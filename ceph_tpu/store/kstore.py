@@ -1,0 +1,364 @@
+"""KStore: an ObjectStore that keeps whole objects in a KeyValueDB.
+
+Behavioral twin of the reference's kv-only store (src/os/kstore/
+KStore.cc): object data is chunked into fixed stripes stored as kv
+values, xattrs/omap ride dedicated column families, and every
+ObjectStore transaction commits as ONE atomic WriteBatch — giving the
+OSD the same all-or-nothing contract as MemStore/FileStore but with
+the metadata layout BlueStore-family engines use (RocksDB column
+families; here ceph_tpu.kv.FileDB's WAL+checkpoint provides the
+durability).
+
+Column families: C (collections), O (object sizes), D (data stripes),
+X (xattrs), M (omap).  Keys join components with \\x01 so collection
+scans are ordered prefix ranges.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from ceph_tpu.kv import MemDB, WriteBatch
+from ceph_tpu.store.objectstore import (
+    ObjectStore,
+    Transaction,
+    TxOp,
+    coll_t,
+    ghobject_t,
+)
+
+SEP = "\x01"
+STRIPE = 65536
+
+
+def _ckey(c: coll_t) -> str:
+    return f"{c.pool}.{c.ps}.{c.shard}"
+
+
+def _okey(c: coll_t, o: ghobject_t) -> str:
+    return _ckey(c) + SEP + f"{o.name}{SEP}{o.snap}{SEP}{o.gen}{SEP}{o.shard}"
+
+
+def _parse_okey(key: str) -> tuple[str, ghobject_t]:
+    ck, name, snap, gen, shard = key.split(SEP)
+    return ck, ghobject_t(name, int(snap), int(gen), int(shard))
+
+
+class KStore(ObjectStore):
+    def __init__(self, db=None):
+        self.db = db if db is not None else MemDB()
+
+    def mount(self) -> None:
+        if hasattr(self.db, "mount"):
+            self.db.mount()
+
+    def umount(self) -> None:
+        if hasattr(self.db, "umount"):
+            self.db.umount()
+
+    # -- reads ---------------------------------------------------------
+
+    def _size_of(self, c: coll_t, o: ghobject_t) -> int | None:
+        raw = self.db.get("O", _okey(c, o))
+        return None if raw is None else struct.unpack("<Q", raw)[0]
+
+    def _require(self, c: coll_t, o: ghobject_t) -> int:
+        if not self.collection_exists(c):
+            raise FileNotFoundError(f"collection {c}")
+        size = self._size_of(c, o)
+        if size is None:
+            raise FileNotFoundError(f"{c}/{o}")
+        return size
+
+    def read(self, c, o, off=0, length=None):
+        size = self._require(c, o)
+        end = size if length is None else min(off + length, size)
+        if off >= end:
+            return b""
+        out = bytearray(end - off)
+        base = _okey(c, o) + SEP
+        s0, s1 = off // STRIPE, (end - 1) // STRIPE
+        for s in range(s0, s1 + 1):
+            stripe = self.db.get("D", base + f"{s:08x}") or b""
+            lo = max(off, s * STRIPE)
+            hi = min(end, s * STRIPE + STRIPE)
+            seg = stripe[lo - s * STRIPE : hi - s * STRIPE]
+            out[lo - off : lo - off + len(seg)] = seg
+        return bytes(out)
+
+    def stat(self, c, o):
+        return self._require(c, o)
+
+    def exists(self, c, o):
+        return self.collection_exists(c) and self._size_of(c, o) is not None
+
+    def getattr(self, c, o, name):
+        self._require(c, o)
+        raw = self.db.get("X", _okey(c, o) + SEP + name)
+        if raw is None:
+            raise KeyError(name)
+        return raw
+
+    def getattrs(self, c, o):
+        self._require(c, o)
+        base = _okey(c, o) + SEP
+        it = self.db.get_iterator("X").lower_bound(base)
+        out = {}
+        while it.valid() and it.key().startswith(base):
+            out[it.key()[len(base):]] = it.value()
+            it.next()
+        return out
+
+    def omap_get(self, c, o):
+        self._require(c, o)
+        base = _okey(c, o) + SEP
+        it = self.db.get_iterator("M").lower_bound(base)
+        out = {}
+        while it.valid() and it.key().startswith(base):
+            out[it.key()[len(base):]] = it.value()
+            it.next()
+        return out
+
+    def omap_get_values(self, c, o, keys):
+        self._require(c, o)
+        base = _okey(c, o) + SEP
+        out = {}
+        for k in keys:
+            v = self.db.get("M", base + k)
+            if v is not None:
+                out[k] = v
+        return out
+
+    def list_collections(self):
+        it = self.db.get_iterator("C").seek_to_first()
+        out = []
+        while it.valid():
+            pool, ps, shard = it.key().split(".")
+            out.append(coll_t(int(pool), int(ps), int(shard)))
+            it.next()
+        return sorted(out)
+
+    def collection_exists(self, c):
+        return self.db.get("C", _ckey(c)) is not None
+
+    def collection_list(self, c):
+        if not self.collection_exists(c):
+            raise FileNotFoundError(f"collection {c}")
+        base = _ckey(c) + SEP
+        it = self.db.get_iterator("O").lower_bound(base)
+        out = []
+        while it.valid() and it.key().startswith(base):
+            out.append(_parse_okey(it.key())[1])
+            it.next()
+        return sorted(out)
+
+    # -- transactions --------------------------------------------------
+
+    def queue_transaction(self, txn: Transaction) -> None:
+        # validate against a shadow of existence state, then translate
+        # to ONE atomic WriteBatch (the all-or-nothing contract)
+        self._validate(txn)
+        batch = WriteBatch()
+        # data mutations need read-modify-write of stripes; sizes track
+        # through the txn so later ops in the same txn see earlier ones
+        sizes: dict[tuple, int | None] = {}
+
+        def size_of(c, o):
+            key = (c, o)
+            if key not in sizes:
+                sizes[key] = self._size_of(c, o)
+            return sizes[key]
+
+        def set_size(c, o, n):
+            sizes[(c, o)] = n
+            batch.set("O", _okey(c, o), struct.pack("<Q", n))
+
+        def write_span(c, o, off, data):
+            base = _okey(c, o) + SEP
+            pos = 0
+            while pos < len(data):
+                s = (off + pos) // STRIPE
+                s_off = (off + pos) % STRIPE
+                n = min(STRIPE - s_off, len(data) - pos)
+                old = self.db.get("D", base + f"{s:08x}") or b""
+                buf = bytearray(max(len(old), s_off + n))
+                buf[: len(old)] = old
+                buf[s_off : s_off + n] = data[pos : pos + n]
+                batch.set("D", base + f"{s:08x}", bytes(buf))
+                # later ops in this txn must see this write
+                self._pending_stripes[base + f"{s:08x}"] = bytes(buf)
+                pos += n
+
+        # overlay for intra-txn stripe reads
+        self._pending_stripes: dict[str, bytes] = {}
+        real_get = self.db.get
+
+        def get_overlay(prefix, key):
+            if prefix == "D" and key in self._pending_stripes:
+                return self._pending_stripes[key]
+            return real_get(prefix, key)
+
+        self.db.get = get_overlay  # type: ignore[assignment]
+        try:
+            for op in txn.ops:
+                self._translate(op, batch, size_of, set_size, write_span)
+        finally:
+            self.db.get = real_get  # type: ignore[assignment]
+            self._pending_stripes = {}
+        self.db.submit(batch)
+        for cb in txn.on_applied:
+            cb()
+        for cb in txn.on_commit:
+            cb()
+
+    def _translate(self, op, batch, size_of, set_size, write_span) -> None:
+        kind = op[0]
+        if kind == TxOp.MKCOLL:
+            batch.set("C", _ckey(op[1]), b"1")
+        elif kind == TxOp.RMCOLL:
+            batch.rmkey("C", _ckey(op[1]))
+        elif kind == TxOp.TOUCH:
+            _, c, o = op
+            if size_of(c, o) is None:
+                set_size(c, o, 0)
+        elif kind == TxOp.WRITE:
+            _, c, o, off, data = op
+            cur = size_of(c, o) or 0
+            write_span(c, o, off, data)
+            if off + len(data) > cur or size_of(c, o) is None:
+                set_size(c, o, max(cur, off + len(data)))
+        elif kind == TxOp.ZERO:
+            _, c, o, off, length = op
+            cur = size_of(c, o) or 0
+            write_span(c, o, off, b"\0" * length)
+            set_size(c, o, max(cur, off + length))
+        elif kind == TxOp.TRUNCATE:
+            _, c, o, size = op
+            cur = size_of(c, o) or 0
+            if size < cur:
+                base = _okey(c, o) + SEP
+                last_keep = (size - 1) // STRIPE if size else -1
+                for s in range(max(last_keep, 0), cur // STRIPE + 1):
+                    if s > last_keep:
+                        batch.rmkey("D", base + f"{s:08x}")
+                        self._pending_stripes[base + f"{s:08x}"] = b""
+                if size % STRIPE and size:
+                    s = size // STRIPE
+                    old = self.db.get("D", base + f"{s:08x}") or b""
+                    batch.set("D", base + f"{s:08x}", old[: size % STRIPE])
+                    self._pending_stripes[base + f"{s:08x}"] = old[: size % STRIPE]
+            set_size(c, o, size)
+        elif kind == TxOp.REMOVE:
+            _, c, o = op
+            self._rm_object(batch, c, o)
+        elif kind == TxOp.SETATTRS:
+            _, c, o, attrs = op
+            if size_of(c, o) is None:
+                set_size(c, o, 0)
+            for k, v in attrs.items():
+                batch.set("X", _okey(c, o) + SEP + k, v)
+        elif kind == TxOp.RMATTR:
+            _, c, o, name = op
+            batch.rmkey("X", _okey(c, o) + SEP + name)
+        elif kind == TxOp.OMAP_SETKEYS:
+            _, c, o, kv = op
+            if size_of(c, o) is None:
+                set_size(c, o, 0)
+            for k, v in kv.items():
+                batch.set("M", _okey(c, o) + SEP + k, v)
+        elif kind == TxOp.OMAP_RMKEYS:
+            _, c, o, keys = op
+            if size_of(c, o) is None:
+                set_size(c, o, 0)
+            for k in keys:
+                batch.rmkey("M", _okey(c, o) + SEP + k)
+        elif kind == TxOp.OMAP_CLEAR:
+            _, c, o = op
+            base = _okey(c, o) + SEP
+            batch.rm_range("M", base, base + "\x7f")
+            if size_of(c, o) is None:
+                set_size(c, o, 0)
+        elif kind == TxOp.CLONE:
+            _, c, src, dst = op
+            size = size_of(c, src)
+            sbase = _okey(c, src) + SEP
+            dbase = _okey(c, dst) + SEP
+            set_size(c, dst, size or 0)
+            for prefix in ("D", "X", "M"):
+                it = self.db.get_iterator(prefix).lower_bound(sbase)
+                while it.valid() and it.key().startswith(sbase):
+                    batch.set(prefix, dbase + it.key()[len(sbase):], it.value())
+                    it.next()
+        elif kind == TxOp.COLL_MOVE_RENAME:
+            _, src_c, src_o, dst_c, dst_o = op
+            size = size_of(src_c, src_o)
+            sbase = _okey(src_c, src_o) + SEP
+            dbase = _okey(dst_c, dst_o) + SEP
+            for prefix in ("D", "X", "M"):
+                it = self.db.get_iterator(prefix).lower_bound(sbase)
+                while it.valid() and it.key().startswith(sbase):
+                    batch.set(prefix, dbase + it.key()[len(sbase):], it.value())
+                    it.next()
+            set_size(dst_c, dst_o, size or 0)
+            self._rm_object(batch, src_c, src_o)
+        else:  # pragma: no cover
+            raise ValueError(f"unknown op {kind}")
+
+    def _rm_object(self, batch: WriteBatch, c: coll_t, o: ghobject_t) -> None:
+        batch.rmkey("O", _okey(c, o))
+        base = _okey(c, o) + SEP
+        for prefix in ("D", "X", "M"):
+            batch.rm_range(prefix, base, base + "\x7f")
+
+    # -- validation (MemStore-grade structural checks) -----------------
+
+    def _validate(self, txn: Transaction) -> None:
+        have_coll = {c for c in self.list_collections()}
+        objs: dict[tuple, bool] = {}
+
+        def obj_exists(c, o):
+            key = (c, o)
+            if key not in objs:
+                objs[key] = self.exists(c, o)
+            return objs[key]
+
+        for op in txn.ops:
+            kind = op[0]
+            if kind == TxOp.MKCOLL:
+                if op[1] in have_coll:
+                    raise FileExistsError(f"collection {op[1]} exists")
+                have_coll.add(op[1])
+            elif kind == TxOp.RMCOLL:
+                if op[1] not in have_coll:
+                    raise FileNotFoundError(f"collection {op[1]}")
+                have_coll.discard(op[1])
+            elif kind == TxOp.COLL_MOVE_RENAME:
+                _, src_c, src_o, dst_c, dst_o = op
+                if src_c not in have_coll or not obj_exists(src_c, src_o):
+                    raise FileNotFoundError(f"{src_c}/{src_o}")
+                if dst_c not in have_coll:
+                    raise FileNotFoundError(f"collection {dst_c}")
+                if obj_exists(dst_c, dst_o):
+                    raise FileExistsError(f"{dst_c}/{dst_o}")
+                objs[(src_c, src_o)] = False
+                objs[(dst_c, dst_o)] = True
+            else:
+                c = op[1]
+                if c not in have_coll:
+                    raise FileNotFoundError(f"collection {c}")
+                if kind == TxOp.CLONE:
+                    _, _, src, dst = op
+                    if not obj_exists(c, src):
+                        raise FileNotFoundError(f"{c}/{src}")
+                    objs[(c, dst)] = True
+                elif kind == TxOp.REMOVE:
+                    _, _, o = op
+                    if not obj_exists(c, o):
+                        raise FileNotFoundError(f"{c}/{o}")
+                    objs[(c, o)] = False
+                elif kind == TxOp.RMATTR:
+                    _, _, o, _name = op
+                    if not obj_exists(c, o):
+                        raise FileNotFoundError(f"{c}/{o}")
+                else:
+                    objs[(op[1], op[2])] = True
