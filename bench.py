@@ -3,23 +3,27 @@
 
 Clone of the reference harness semantics (ceph_erasure_code_benchmark,
 reference src/test/erasure-code/ceph_erasure_code_benchmark.cc:155-193:
-encode a buffer in a timed loop, report bytes/second;
-qa/workunits/erasure-code/bench.sh:170 computes GiB/s).  The encode
-runs the fused pallas TPU kernel over a 6 GiB stripe batch resident in
-HBM (falling back to 2 GiB / 512 MiB when HBM is short).
+encode a buffer in a timed loop, report bytes/second; qa/workunits/
+erasure-code/bench.sh:170 computes GiB/s).
 
-Methodology notes (measured on the tunneled v5e):
-- Each kernel LAUNCH pays a fixed relay/queueing cost that swings from
-  ~10 ms to ~200 ms with co-tenant load, while the kernel itself
-  streams at >100 GB/s — so the benchmark uses one giant launch per
-  sample (6 GiB per dispatch) to amortize it, not a chain of small
-  ones (the previous chain harness also xor-folded the parity into the
-  input each iteration, which XLA materialized as a full HBM copy that
-  dominated the measurement).
-- Samples are spread over ~30 s and the best is reported, so a brief
-  co-tenant burst doesn't define the number.
-- Input data is generated on-device (threefry): correctness of the
-  kernel vs the host GF(2^8) reference is asserted on a slice first.
+Harness design (measured, tools/perf_lab2.py + perf_lab3.py, committed
+in PERF_LAB_r03.md): the tunneled v5e pays a ~100 ms relay cost per
+kernel LAUNCH that swings with co-tenant load, while the fused pallas
+kernel itself streams ~140 GB/s.  So the timed encode loop runs as ONE
+launch: ``lax.fori_loop`` over an aliased-carry kernel,
+
+    carry = carry ^ encode(data ^ iteration_seed)
+
+where the per-iteration seed stops XLA hoisting the encode out of the
+loop and the carry fold keeps every iteration's parity live; both fuse
+into the kernel's existing VPU pass, so each iteration does a full,
+honest k*S-byte encode with one extra m*S carry read.  32 iterations
+per launch amortize the relay to <3%.  Samples are spread over ~25 s
+and the best is reported so a co-tenant burst doesn't define the
+number.
+
+Input data is generated on-device (threefry); correctness of the
+kernel vs the host GF(2^8) reference is asserted on a slice first.
 
 Prints ONE JSON line:
   {"metric": ..., "value": GB/s, "unit": "GB/s", "vs_baseline": value/40}
@@ -36,6 +40,7 @@ import numpy as np
 def main() -> int:
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     from ceph_tpu.models import isa_cauchy_matrix
     from ceph_tpu.ops import rs_kernels as rk
@@ -43,43 +48,77 @@ def main() -> int:
     k, m = 8, 3
     codec = rk.BitmatrixCodec(isa_cauchy_matrix(k, m))
     on_tpu = jax.default_backend() not in ("cpu",)
-    # 6 GiB of data on TPU (falls back if HBM is short); CI smoke on CPU.
-    sizes = [768 * 2**20, 256 * 2**20, 64 * 2**20] if on_tpu else [2**16]
 
-    data = out = encode = None
-    for S in sizes:
-        try:
-            gen = jax.jit(lambda key, S=S: jax.random.bits(key, (k, S), jnp.uint8))
-            data = gen(jax.random.key(0))
-            jax.block_until_ready(data)
-            encode = jax.jit(lambda d: codec.encode(d, pallas=on_tpu))
-            out = encode(data)
-            jax.block_until_ready(out)  # warm + compile
-            break
-        except Exception:  # RESOURCE_EXHAUSTED on smaller-HBM parts
-            data = out = None
-    assert data is not None, "no batch size fit in device memory"
-
-    # sanity: the kernel output must match the host-reference encode
+    # sanity: kernel output must match the host-reference GF(2^8) encode
     from ceph_tpu.ops.gf256 import gf_matmul
 
-    head = np.asarray(out[:, :4096])
-    ref = gf_matmul(codec.C, np.asarray(data[:, :4096]))
-    assert np.array_equal(head, ref), "kernel/host encode mismatch"
+    probe = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (k, 2**20), dtype=np.uint8))
+    got = np.asarray(codec.encode(probe, pallas=on_tpu))
+    ref = gf_matmul(codec.C, np.asarray(probe))
+    assert np.array_equal(got, ref), "kernel/host encode mismatch"
 
-    rounds = 8 if on_tpu else 2
-    pause = 4.0 if on_tpu else 0.0
-    best = float("inf")
-    for r in range(rounds):
+    if not on_tpu:
+        # CI smoke on CPU: XLA path, tiny buffer, loop of 2
+        S, iters = 2**16, 2
+        data = jnp.asarray(
+            np.random.default_rng(1).integers(0, 256, (k, S), dtype=np.uint8))
+        jax.block_until_ready(codec.encode(data, pallas=False))  # warm jit
         t0 = time.perf_counter()
-        out = encode(data)
+        for i in range(iters):
+            out = codec.encode(data, pallas=False)
         jax.block_until_ready(out)
-        _ = np.asarray(out[0, :8])  # host round-trip barrier
-        best = min(best, time.perf_counter() - t0)
-        if pause and r < rounds - 1:
-            time.sleep(pause)
+        dt = time.perf_counter() - t0
+        gbs = k * S * iters / dt / 1e9
+    else:
+        TILE = 262144
+        ITERS = 32
 
-    gbs = (k * S) / best / 1e9
+        @jax.jit
+        def loop_encode(d, n):
+            c = jnp.zeros((m, d.shape[1]), jnp.uint8)
+
+            def body(i, c):
+                return rk.gf_bitmatmul_pallas_acc(
+                    codec.encode_bits, d, c,
+                    jnp.array([i], jnp.int32), tile_s=TILE)
+
+            return lax.fori_loop(0, n, body, c)
+
+        # fold-correctness of the loop harness itself on a small buffer
+        small = probe[:, : 2**18]
+        got2 = np.asarray(loop_encode(small, jnp.int32(2)))
+        r0 = gf_matmul(codec.C, np.asarray(small))
+        r1 = gf_matmul(codec.C, np.asarray(small) ^ 1)
+        assert np.array_equal(got2, r0 ^ r1), "loop harness fold mismatch"
+
+        data = None
+        for s_rows in (256 * 2**20, 64 * 2**20, 16 * 2**20):
+            try:
+                gen = jax.jit(
+                    lambda key, S=s_rows: jax.random.bits(key, (k, S), jnp.uint8))
+                data = gen(jax.random.key(0))
+                jax.block_until_ready(data)
+                out = loop_encode(data, jnp.int32(ITERS))
+                jax.block_until_ready(out)  # warm + compile
+                S = s_rows
+                break
+            except Exception:  # RESOURCE_EXHAUSTED on smaller-HBM parts
+                data = out = None  # drop the failed attempt's buffers too
+        assert data is not None, "no batch size fit in device memory"
+
+        best = float("inf")
+        rounds, pause = 6, 3.0
+        for r in range(rounds):
+            t0 = time.perf_counter()
+            out = loop_encode(data, jnp.int32(ITERS))
+            jax.block_until_ready(out)
+            _ = np.asarray(out[0, :8])  # host round-trip barrier
+            best = min(best, time.perf_counter() - t0)
+            if r < rounds - 1:
+                time.sleep(pause)
+        gbs = (k * S * ITERS) / best / 1e9
+
     print(json.dumps({
         "metric": "RS(8,3) erasure encode throughput, 1 chip",
         "value": round(gbs, 2),

@@ -87,8 +87,9 @@ def _bit_major_perm(n: int) -> "np.ndarray":
     return idx
 
 
-def _bitmatmul_kernel(bm_ref, data_ref, out_ref):
-    """One S-tile of the fused encode/decode.
+def _encode_tile(bm, d, m):
+    """Core of the fused kernels: (k, T) uint8 tile -> (m, T) uint8 parity
+    via the bit-major (8m, 8k) GF(2) matrix ``bm``.
 
     Measured on v5e-1 (see bench.py): the naive formulation (uint8 ->
     int32 cast, 8 shift/and planes, per-plane int8 casts) spends ~85% of
@@ -102,23 +103,26 @@ def _bitmatmul_kernel(bm_ref, data_ref, out_ref):
     - one (8m, 8k) @ (8k, T) int8 MXU matmul with int32 accumulation;
     - mod-2 and byte re-pack on the (8m, T) accumulator (small).
     """
-    d = data_ref[:]                                       # (k, T) uint8
     kk = d.shape[0]
     X = jnp.concatenate([d] * 8, axis=0)                  # (8k, T)
     r = jax.lax.broadcasted_iota(jnp.int32, (8 * kk, 1), 0)
     mask = (jnp.int32(1) << (r // kk)).astype(jnp.uint8)  # row r -> bit r//k
     bits = ((X & mask) != 0).astype(jnp.int8)
     acc = jax.lax.dot_general(
-        bm_ref[:],
+        bm,
         bits,
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32,
     ) & 1                                                 # (8m, T) bit-major
-    m = out_ref.shape[0]
     out = acc[0:m]
     for b in range(1, 8):
         out = out | (acc[b * m:(b + 1) * m] << b)
-    out_ref[:] = out.astype(jnp.uint8)
+    return out.astype(jnp.uint8)
+
+
+def _bitmatmul_kernel(bm_ref, data_ref, out_ref):
+    """One S-tile of the fused encode/decode (see :func:`_encode_tile`)."""
+    out_ref[:] = _encode_tile(bm_ref[:], data_ref[:], out_ref.shape[0])
 
 
 def _grouped_kernel(bm_ref, data_ref, out_ref):
@@ -267,6 +271,62 @@ def gf_bitmatmul_pallas(
         out_specs=pl.BlockSpec((m, tile_s), lambda i: (0, i)),
         interpret=interpret,
     )(bm_perm.astype(jnp.int8), data)
+
+
+@functools.partial(jax.jit, static_argnames=("tile_s", "interpret"))
+def gf_bitmatmul_pallas_acc(
+    bitmat: jax.Array,
+    data: jax.Array,
+    carry: jax.Array,
+    seed: jax.Array,
+    *,
+    tile_s: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused ``carry ^ encode(data ^ seed)`` with the carry buffer aliased
+    to the output (under an enclosing jit loop the carry is updated in
+    place — no extra HBM allocation per iteration).
+
+    This is the loop body of the sustained-throughput benchmark harness:
+    the tunneled chip pays a ~100 ms relay cost per *launch* (measured,
+    tools/perf_lab2.py), so the reference harness's timed encode loop
+    (ceph_erasure_code_benchmark.cc:186-191) is expressed as ONE launch
+    of ``lax.fori_loop`` over this kernel.  The per-iteration seed is
+    XORed into every loaded data byte so XLA cannot hoist the encode out
+    of the loop as loop-invariant; the carry fold makes every iteration's
+    parity live.  Both are cheap VPU ops fused into the same pass over
+    the tile, so per-iteration HBM traffic (read k·S, read+write m·S)
+    matches a plain encode-and-write within 27%.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k, s = data.shape
+    m8, k8 = bitmat.shape
+    m = m8 // 8
+    assert s % tile_s == 0, (s, tile_s)
+    bm_perm = bitmat[jnp.asarray(_bit_major_perm(m))][:, jnp.asarray(_bit_major_perm(k))]
+
+    def kern(seed_ref, bm_ref, d_ref, c_ref, o_ref):
+        sd = seed_ref[0].astype(jnp.uint8)
+        o_ref[:] = _encode_tile(bm_ref[:], d_ref[:] ^ sd, m) ^ c_ref[:]
+
+    return pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(s // tile_s,),
+            in_specs=[
+                pl.BlockSpec((m8, k8), lambda i, *_: (0, 0)),
+                pl.BlockSpec((k, tile_s), lambda i, *_: (0, i)),
+                pl.BlockSpec((m, tile_s), lambda i, *_: (0, i)),
+            ],
+            out_specs=pl.BlockSpec((m, tile_s), lambda i, *_: (0, i)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((m, s), jnp.uint8),
+        input_output_aliases={3: 0},
+        interpret=interpret,
+    )(seed, bm_perm.astype(jnp.int8), data, carry)
 
 
 # ---------------------------------------------------------------------------

@@ -118,3 +118,53 @@ def test_decode_unsorted_erasures_row_order():
     chunks = np.concatenate([D, P], axis=0)
     rec = np.asarray(codec.decode(chunks, (9, 0)))
     assert np.array_equal(rec, chunks[[9, 0]])
+
+
+def test_acc_pallas_interpret_mode(rng):
+    """The aliased-carry loop-body kernel (bench.py harness): seed is
+    XORed into the data, result is folded into the carry, bit-exact."""
+    import jax
+    import jax.numpy as jnp
+
+    k, m = 8, 3
+    C = mx.isa_cauchy_matrix(k, m)
+    codec = rk.BitmatrixCodec(C)
+    D = rng.integers(0, 256, (k, 1024), dtype=np.uint8)
+    carry = rng.integers(0, 256, (m, 1024), dtype=np.uint8)
+    for seed in (0, 3):
+        got = rk.gf_bitmatmul_pallas_acc(
+            codec.encode_bits, jnp.asarray(D), jnp.asarray(carry),
+            jnp.array([seed], jnp.int32), tile_s=512, interpret=True,
+        )
+        want = carry ^ gf.gf_matmul(C, D ^ np.uint8(seed))
+        assert np.array_equal(np.asarray(got), want)
+
+
+def test_acc_pallas_loop_fold(rng):
+    """fori_loop of the acc kernel == XOR of per-seed encodes (this is
+    exactly the bench.py one-launch timed loop)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    k, m = 8, 3
+    C = mx.isa_cauchy_matrix(k, m)
+    codec = rk.BitmatrixCodec(C)
+    D = rng.integers(0, 256, (k, 512), dtype=np.uint8)
+
+    @jax.jit
+    def loop_encode(d, n):
+        c = jnp.zeros((m, d.shape[1]), jnp.uint8)
+
+        def body(i, c):
+            return rk.gf_bitmatmul_pallas_acc(
+                codec.encode_bits, d, c, jnp.array([i], jnp.int32),
+                tile_s=512, interpret=True)
+
+        return lax.fori_loop(0, n, body, c)
+
+    got = np.asarray(loop_encode(jnp.asarray(D), jnp.int32(3)))
+    want = np.zeros((m, 512), np.uint8)
+    for i in range(3):
+        want ^= gf.gf_matmul(C, D ^ np.uint8(i))
+    assert np.array_equal(got, want)

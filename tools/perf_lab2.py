@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Perf lab 2: ablate the RS-encode pallas kernel stage by stage and sweep
+dispatch/pipeline shapes, to locate the bottleneck behind the 28 GB/s r2
+plateau (reference harness semantics: ceph_erasure_code_benchmark.cc:186).
+
+Run on the real chip:  PYTHONPATH=/root/.axon_site:. python tools/perf_lab2.py
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ceph_tpu.models import isa_cauchy_matrix
+from ceph_tpu.ops import rs_kernels as rk
+
+K, M = 8, 3
+
+
+def timed(name, fn, data, n=16, reps=4, bytes_per=None, window=6):
+    """Pipelined dispatch with at most `window` results in flight."""
+    out = fn(data)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        outs = []
+        for _ in range(n):
+            outs.append(fn(data))
+            if len(outs) > window:
+                jax.block_until_ready(outs.pop(0))
+        jax.block_until_ready(outs)
+        del outs
+        best = min(best, (time.perf_counter() - t0) / n)
+    bp = bytes_per if bytes_per is not None else data.size
+    print(f"{name:52s} {best*1e3:8.2f} ms  {bp/best/1e9:8.2f} GB/s", flush=True)
+    return bp / best / 1e9
+
+
+def make_ablate(stage, tile, codec):
+    """Kernel truncated after `stage`: load | extract | matmul | full."""
+    bm = codec.encode_bits
+    m8, k8 = bm.shape
+    m = m8 // 8
+    bmp = bm[jnp.asarray(rk._bit_major_perm(m))][:, jnp.asarray(rk._bit_major_perm(K))]
+    bmp = bmp.astype(jnp.int8)
+
+    def kern(bm_ref, d_ref, o_ref):
+        d = d_ref[:]
+        if stage == "load":
+            o_ref[:] = d[0:m]
+            return
+        X = jnp.concatenate([d] * 8, axis=0)
+        r = jax.lax.broadcasted_iota(jnp.int32, (8 * K, 1), 0)
+        mask = (jnp.int32(1) << (r // K)).astype(jnp.uint8)
+        bits = ((X & mask) != 0).astype(jnp.int8)
+        if stage == "extract":
+            o_ref[:] = bits[0:m].astype(jnp.uint8)
+            return
+        acc = jax.lax.dot_general(
+            bm_ref[:], bits, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32) & 1
+        if stage == "matmul":
+            o_ref[:] = acc[0:m].astype(jnp.uint8)
+            return
+        out = acc[0:m]
+        for b in range(1, 8):
+            out = out | (acc[b * m:(b + 1) * m] << b)
+        o_ref[:] = out.astype(jnp.uint8)
+
+    @jax.jit
+    def run(d):
+        s = d.shape[1]
+        return pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct((m, s), jnp.uint8),
+            grid=(s // tile,),
+            in_specs=[pl.BlockSpec((m8, k8), lambda i: (0, 0)),
+                      pl.BlockSpec((K, tile), lambda i: (0, i))],
+            out_specs=pl.BlockSpec((m, tile), lambda i: (0, i)),
+        )(bmp, d)
+
+    return run
+
+
+def make_repeat_variant(tile, codec):
+    """Byte-major extraction via pltpu.repeat (no concat, no row permute)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    bm = codec.encode_bits.astype(jnp.int8)  # byte-major (8m, 8k) as-is
+    m8, k8 = bm.shape
+    m = m8 // 8
+
+    def kern(bm_ref, d_ref, o_ref):
+        d = d_ref[:]
+        X = pltpu.repeat(d, 8, axis=0)                    # row 8i+b = d_i
+        r = jax.lax.broadcasted_iota(jnp.int32, (8 * K, 1), 0)
+        mask = (jnp.int32(1) << (r % 8)).astype(jnp.uint8)
+        bits = ((X & mask) != 0).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            bm_ref[:], bits, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32) & 1          # byte-major rows 8u+b
+        out = acc[0:m8:8]
+        for b in range(1, 8):
+            out = out | (acc[b:m8:8] << b)
+        o_ref[:] = out.astype(jnp.uint8)
+
+    @jax.jit
+    def run(d):
+        s = d.shape[1]
+        return pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct((m, s), jnp.uint8),
+            grid=(s // tile,),
+            in_specs=[pl.BlockSpec((m8, k8), lambda i: (0, 0)),
+                      pl.BlockSpec((K, tile), lambda i: (0, i))],
+            out_specs=pl.BlockSpec((m, tile), lambda i: (0, i)),
+        )(bm, d)
+
+    return run
+
+
+def main():
+    codec = rk.BitmatrixCodec(isa_cauchy_matrix(K, M))
+    rng = np.random.default_rng(0)
+
+    print("== dispatch-size x pipeline sweep (ungrouped tile=262144) ==")
+    for s_mb in (16, 64, 256):
+        S = s_mb * 2**20
+        data = jnp.asarray(rng.integers(0, 256, (K, S), dtype=np.uint8))
+        jax.block_until_ready(data)
+        enc = jax.jit(lambda d: rk.gf_bitmatmul_pallas(
+            codec.encode_bits, d, tile_s=262144))
+        for n in (1, 4, 16):
+            timed(f"S={s_mb}MiB/row n={n}", enc, data, n=n)
+        del data
+
+    S = 64 * 2**20
+    data = jnp.asarray(rng.integers(0, 256, (K, S), dtype=np.uint8))
+    jax.block_until_ready(data)
+
+    print("== grouped vs ungrouped (S=64MiB/row, n=16) ==")
+    for tile, g in ((262144, 1), (131072, 2), (262144, 2), (65536, 2)):
+        if g == 1:
+            enc = jax.jit(lambda d, t=tile: rk.gf_bitmatmul_pallas(
+                codec.encode_bits, d, tile_s=t))
+        else:
+            enc = jax.jit(lambda d, t=tile, g=g: rk.gf_bitmatmul_pallas_grouped(
+                codec.encode_bits, d, tile_s=t, groups=g))
+        timed(f"tile={tile} g={g}", enc, data)
+
+    print("== kernel stage ablation (tile=262144 ungrouped, n=16) ==")
+    for stage in ("load", "extract", "matmul", "full"):
+        timed(f"ablate:{stage}", make_ablate(stage, 262144, codec), data)
+
+    print("== extraction variants (n=16) ==")
+    timed("repeat-variant tile=262144", make_repeat_variant(262144, codec), data)
+    timed("repeat-variant tile=131072", make_repeat_variant(131072, codec), data)
+    timed("repeat-variant tile=524288", make_repeat_variant(524288, codec), data)
+
+    # correctness of the repeat variant
+    from ceph_tpu.ops.gf256 import gf_matmul
+    out = make_repeat_variant(262144, codec)(data[:, : 2**20])
+    ref = gf_matmul(codec.C, np.asarray(data[:, : 2**20]))
+    print("repeat variant bit-exact:", bool(np.array_equal(np.asarray(out), ref)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

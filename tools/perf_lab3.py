@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Perf lab 3: one-launch looped encode (lax.fori_loop around the pallas
+kernel, seeded input variation + carry fold via input/output aliasing) vs
+pipelined independent dispatches.  The relay in front of the tunneled chip
+costs ~100 ms per launch (perf_lab2), so a whole timed loop per launch is
+the only congestion-proof harness.
+
+Run:  PYTHONPATH=/root/.axon_site:. python tools/perf_lab3.py
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+from ceph_tpu.models import isa_cauchy_matrix
+from ceph_tpu.ops import rs_kernels as rk
+
+K, M = 8, 3
+
+
+def make_acc_encode(codec, tile):
+    """(data, carry, seed) -> carry ^ encode(data ^ seed); carry donated."""
+    bm = codec.encode_bits
+    m8, k8 = bm.shape
+    m = m8 // 8
+    bmp = bm[jnp.asarray(rk._bit_major_perm(m))][:, jnp.asarray(rk._bit_major_perm(K))]
+    bmp = bmp.astype(jnp.int8)
+
+    def kern(seed_ref, bm_ref, d_ref, c_ref, o_ref):
+        s = seed_ref[0].astype(jnp.uint8)
+        d = d_ref[:] ^ s
+        X = jnp.concatenate([d] * 8, axis=0)
+        r = jax.lax.broadcasted_iota(jnp.int32, (8 * K, 1), 0)
+        mask = (jnp.int32(1) << (r // K)).astype(jnp.uint8)
+        bits = ((X & mask) != 0).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            bm_ref[:], bits, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32) & 1
+        out = acc[0:m]
+        for b in range(1, 8):
+            out = out | (acc[b * m:(b + 1) * m] << b)
+        o_ref[:] = out.astype(jnp.uint8) ^ c_ref[:]
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    def run(d, c, seed):
+        s = d.shape[1]
+        return pl.pallas_call(
+            kern,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(s // tile,),
+                in_specs=[
+                    pl.BlockSpec((m8, k8), lambda i, *_: (0, 0)),
+                    pl.BlockSpec((K, tile), lambda i, *_: (0, i)),
+                    pl.BlockSpec((m, tile), lambda i, *_: (0, i)),
+                ],
+                out_specs=pl.BlockSpec((m, tile), lambda i, *_: (0, i)),
+            ),
+            out_shape=jax.ShapeDtypeStruct((m, s), jnp.uint8),
+            input_output_aliases={3: 0},   # carry (4th flat input) -> out
+        )(seed, bmp, d, c)
+
+    return run
+
+
+def main():
+    codec = rk.BitmatrixCodec(isa_cauchy_matrix(K, M))
+    rng = np.random.default_rng(0)
+    TILE = 262144
+
+    acc_encode = make_acc_encode(codec, TILE)
+
+    # correctness first (small S)
+    small = jnp.asarray(rng.integers(0, 256, (K, 2**20), dtype=np.uint8))
+    c0 = jnp.zeros((M, 2**20), jnp.uint8)
+    out = acc_encode(small, c0, jnp.array([0], jnp.int32))
+    from ceph_tpu.ops.gf256 import gf_matmul
+    ref = gf_matmul(codec.C, np.asarray(small))
+    print("acc kernel bit-exact (seed 0):", np.array_equal(np.asarray(out), ref))
+    out2 = acc_encode(small, out, jnp.array([3], jnp.int32))
+    ref2 = ref ^ gf_matmul(codec.C, np.asarray(small) ^ 3)
+    print("acc kernel fold (seed 3):", np.array_equal(np.asarray(out2), ref2))
+
+    @jax.jit
+    def loop_encode(d, n):
+        c = jnp.zeros((M, d.shape[1]), jnp.uint8)
+        def body(i, c):
+            return acc_encode(d, c, jnp.array([i], jnp.int32).astype(jnp.int32))
+        return lax.fori_loop(0, n, body, c)
+
+    for s_mb in (64, 256):
+        S = s_mb * 2**20
+        data = jnp.asarray(rng.integers(0, 256, (K, S), dtype=np.uint8))
+        jax.block_until_ready(data)
+        for n in (4, 16):
+            nn = jnp.int32(n)
+            out = loop_encode(data, nn)
+            jax.block_until_ready(out)
+            for rep in range(3):
+                t0 = time.perf_counter()
+                out = loop_encode(data, nn)
+                jax.block_until_ready(out)
+                dt = time.perf_counter() - t0
+                print(f"loop S={s_mb}MiB/row n={n:3d} rep{rep}: "
+                      f"{dt*1e3:8.2f} ms  {K*S*n/dt/1e9:8.2f} GB/s", flush=True)
+        del data
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
