@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import errno
 import json
+import logging
 
 RD = 1
 WR = 2
@@ -134,6 +135,15 @@ def call(
         return 0, out if out is not None else b""
     except ClsError as e:
         return -(e.errno or errno.EIO), b""
+    except Exception:
+        # malformed client input (bad json, missing fields, ...) must
+        # surface as a clean EINVAL, not an unhandled traceback + EIO —
+        # the reference's method-call containment (ClassHandler); keep
+        # the traceback at debug level so OSD-side method bugs stay
+        # diagnosable without letting clients spam the error log
+        logging.getLogger("ceph.cls").debug(
+            "cls %s.%s raised", cls_name, method, exc_info=True)
+        return -errno.EINVAL, b""
 
 
 def method_is_write(cls_name: str, method: str) -> bool:

@@ -179,6 +179,10 @@ class FileDB(MemDB):
         self.checkpoint_bytes = checkpoint_bytes
         self._wal = None
         self._wal_size = 0
+        # serializes WAL append+fsync+checkpoint; the memtable lock
+        # (self._lock) is held only for _apply so readers on the event
+        # loop never wait out an fsync
+        self._commit_lock = threading.Lock()
 
     blocking_commit = True
 
@@ -217,7 +221,7 @@ class FileDB(MemDB):
             self._wal = None
 
     def submit(self, batch: WriteBatch, sync: bool = True) -> None:
-        with self._lock:
+        with self._commit_lock:
             body = batch.encode()
             rec = struct.pack("<HI", _MAGIC, len(body)) + struct.pack(
                 "<I", crc32c(body)
@@ -227,7 +231,8 @@ class FileDB(MemDB):
             if sync:
                 os.fsync(self._wal.fileno())
             self._wal_size += len(rec)
-            self._apply(batch)
+            with self._lock:
+                self._apply(batch)
             if self._wal_size >= self.checkpoint_bytes:
                 self._checkpoint()
 

@@ -11,12 +11,15 @@ durability).
 
 Column families: C (collections), O (object sizes), D (data stripes),
 X (xattrs), M (omap).  Keys join components with \\x01 so collection
-scans are ordered prefix ranges.
+scans are ordered prefix ranges; object names are escaped so a name
+containing the separator cannot inject into another object's key space
+(the reference KStore's append_escaped, src/os/kstore/KStore.cc).
 """
 
 from __future__ import annotations
 
 import struct
+import threading
 
 from ceph_tpu.kv import MemDB, WriteBatch
 from ceph_tpu.store.objectstore import (
@@ -28,7 +31,27 @@ from ceph_tpu.store.objectstore import (
 )
 
 SEP = "\x01"
+ESC = "\x02"
 STRIPE = 65536
+
+
+def _esc(s: str) -> str:
+    """Escape SEP/ESC out of a key component (reversible, SEP-free)."""
+    return s.replace(ESC, ESC + "e").replace(SEP, ESC + "s")
+
+
+def _unesc(s: str) -> str:
+    return s.replace(ESC + "s", SEP).replace(ESC + "e", ESC)
+
+
+def _prefix_end(prefix: str) -> str:
+    """Exclusive upper bound covering every key that starts with
+    ``prefix`` (bump the last non-maximal code point)."""
+    i = len(prefix) - 1
+    while i >= 0 and ord(prefix[i]) >= 0x10FFFF:
+        i -= 1
+    assert i >= 0, "degenerate prefix"
+    return prefix[:i] + chr(ord(prefix[i]) + 1)
 
 
 def _ckey(c: coll_t) -> str:
@@ -36,17 +59,86 @@ def _ckey(c: coll_t) -> str:
 
 
 def _okey(c: coll_t, o: ghobject_t) -> str:
-    return _ckey(c) + SEP + f"{o.name}{SEP}{o.snap}{SEP}{o.gen}{SEP}{o.shard}"
+    return _ckey(c) + SEP + f"{_esc(o.name)}{SEP}{o.snap}{SEP}{o.gen}{SEP}{o.shard}"
 
 
 def _parse_okey(key: str) -> tuple[str, ghobject_t]:
     ck, name, snap, gen, shard = key.split(SEP)
-    return ck, ghobject_t(name, int(snap), int(gen), int(shard))
+    return ck, ghobject_t(_unesc(name), int(snap), int(gen), int(shard))
+
+
+class _TxnView:
+    """One transaction's mutations mirrored over the committed db.
+
+    Every mutation goes into the WriteBatch (the atomic commit unit)
+    AND an in-memory overlay, so later ops in the same transaction read
+    their predecessors' effects across ALL column families: a REMOVE
+    hides committed keys from a following re-create, and CLONE sees
+    same-txn writes of data, xattrs and omap alike.
+    """
+
+    def __init__(self, db, batch: WriteBatch):
+        self.db = db
+        self.batch = batch
+        self._over: dict[str, dict[str, bytes | None]] = {}  # None = deleted
+        self._dead: dict[str, list[tuple[str, str]]] = {}    # range tombstones
+
+    def set(self, p: str, k: str, v: bytes) -> None:
+        self.batch.set(p, k, v)
+        self._over.setdefault(p, {})[k] = bytes(v)
+
+    def rmkey(self, p: str, k: str) -> None:
+        self.batch.rmkey(p, k)
+        self._over.setdefault(p, {})[k] = None
+
+    def rm_range(self, p: str, start: str, end: str) -> None:
+        self.batch.rm_range(p, start, end)
+        over = self._over.setdefault(p, {})
+        for k in [k for k in over if start <= k < end]:
+            del over[k]
+        self._dead.setdefault(p, []).append((start, end))
+
+    def get(self, p: str, k: str) -> bytes | None:
+        over = self._over.get(p, {})
+        if k in over:
+            return over[k]
+        if any(s <= k < e for s, e in self._dead.get(p, ())):
+            return None
+        return self.db.get(p, k)
+
+    def items(self, p: str, prefix: str) -> list[tuple[str, bytes]]:
+        """Sorted (key, value) pairs under ``prefix``, txn effects
+        included (committed minus tombstones, then overlay wins)."""
+        out: dict[str, bytes] = {}
+        it = self.db.get_iterator(p).lower_bound(prefix)
+        while it.valid() and it.key().startswith(prefix):
+            out[it.key()] = it.value()
+            it.next()
+        for s, e in self._dead.get(p, ()):
+            for k in [k for k in out if s <= k < e]:
+                del out[k]
+        for k, v in self._over.get(p, {}).items():
+            if k.startswith(prefix):
+                if v is None:
+                    out.pop(k, None)
+                else:
+                    out[k] = v
+        return sorted(out.items())
 
 
 class KStore(ObjectStore):
     def __init__(self, db=None):
         self.db = db if db is not None else MemDB()
+        # one txn translates+submits at a time: queue_transaction may run
+        # on a worker thread (blocking_commit) while reads stay on the
+        # event loop
+        self._txn_lock = threading.Lock()
+
+    @property
+    def blocking_commit(self) -> bool:
+        """Forward the backing DB's fsync behavior so the OSD/mon move
+        commits off the event loop (FileDB fsyncs per batch)."""
+        return bool(getattr(self.db, "blocking_commit", False))
 
     def mount(self) -> None:
         if hasattr(self.db, "mount"):
@@ -156,22 +248,32 @@ class KStore(ObjectStore):
 
     def queue_transaction(self, txn: Transaction) -> None:
         # validate against a shadow of existence state, then translate
-        # to ONE atomic WriteBatch (the all-or-nothing contract)
-        self._validate(txn)
-        batch = WriteBatch()
-        # data mutations need read-modify-write of stripes; sizes track
-        # through the txn so later ops in the same txn see earlier ones
-        sizes: dict[tuple, int | None] = {}
+        # to ONE atomic WriteBatch (the all-or-nothing contract); a
+        # _TxnView overlays the batch's own mutations so later ops in
+        # the same txn read their predecessors' effects
+        with self._txn_lock:
+            self._validate(txn)
+            batch = WriteBatch()
+            view = _TxnView(self.db, batch)
+            for op in txn.ops:
+                self._translate(op, view)
+            self.db.submit(batch)
+        for cb in txn.on_applied:
+            cb()
+        for cb in txn.on_commit:
+            cb()
 
+    @staticmethod
+    def _size_of_view(view: "_TxnView", c: coll_t, o: ghobject_t) -> int | None:
+        raw = view.get("O", _okey(c, o))
+        return None if raw is None else struct.unpack("<Q", raw)[0]
+
+    def _translate(self, op, view: "_TxnView") -> None:
         def size_of(c, o):
-            key = (c, o)
-            if key not in sizes:
-                sizes[key] = self._size_of(c, o)
-            return sizes[key]
+            return self._size_of_view(view, c, o)
 
         def set_size(c, o, n):
-            sizes[(c, o)] = n
-            batch.set("O", _okey(c, o), struct.pack("<Q", n))
+            view.set("O", _okey(c, o), struct.pack("<Q", n))
 
         def write_span(c, o, off, data):
             base = _okey(c, o) + SEP
@@ -180,43 +282,18 @@ class KStore(ObjectStore):
                 s = (off + pos) // STRIPE
                 s_off = (off + pos) % STRIPE
                 n = min(STRIPE - s_off, len(data) - pos)
-                old = self.db.get("D", base + f"{s:08x}") or b""
+                old = view.get("D", base + f"{s:08x}") or b""
                 buf = bytearray(max(len(old), s_off + n))
                 buf[: len(old)] = old
                 buf[s_off : s_off + n] = data[pos : pos + n]
-                batch.set("D", base + f"{s:08x}", bytes(buf))
-                # later ops in this txn must see this write
-                self._pending_stripes[base + f"{s:08x}"] = bytes(buf)
+                view.set("D", base + f"{s:08x}", bytes(buf))
                 pos += n
 
-        # overlay for intra-txn stripe reads
-        self._pending_stripes: dict[str, bytes] = {}
-        real_get = self.db.get
-
-        def get_overlay(prefix, key):
-            if prefix == "D" and key in self._pending_stripes:
-                return self._pending_stripes[key]
-            return real_get(prefix, key)
-
-        self.db.get = get_overlay  # type: ignore[assignment]
-        try:
-            for op in txn.ops:
-                self._translate(op, batch, size_of, set_size, write_span)
-        finally:
-            self.db.get = real_get  # type: ignore[assignment]
-            self._pending_stripes = {}
-        self.db.submit(batch)
-        for cb in txn.on_applied:
-            cb()
-        for cb in txn.on_commit:
-            cb()
-
-    def _translate(self, op, batch, size_of, set_size, write_span) -> None:
         kind = op[0]
         if kind == TxOp.MKCOLL:
-            batch.set("C", _ckey(op[1]), b"1")
+            view.set("C", _ckey(op[1]), b"1")
         elif kind == TxOp.RMCOLL:
-            batch.rmkey("C", _ckey(op[1]))
+            view.rmkey("C", _ckey(op[1]))
         elif kind == TxOp.TOUCH:
             _, c, o = op
             if size_of(c, o) is None:
@@ -240,75 +317,70 @@ class KStore(ObjectStore):
                 last_keep = (size - 1) // STRIPE if size else -1
                 for s in range(max(last_keep, 0), cur // STRIPE + 1):
                     if s > last_keep:
-                        batch.rmkey("D", base + f"{s:08x}")
-                        self._pending_stripes[base + f"{s:08x}"] = b""
+                        view.rmkey("D", base + f"{s:08x}")
                 if size % STRIPE and size:
                     s = size // STRIPE
-                    old = self.db.get("D", base + f"{s:08x}") or b""
-                    batch.set("D", base + f"{s:08x}", old[: size % STRIPE])
-                    self._pending_stripes[base + f"{s:08x}"] = old[: size % STRIPE]
+                    old = view.get("D", base + f"{s:08x}") or b""
+                    view.set("D", base + f"{s:08x}", old[: size % STRIPE])
             set_size(c, o, size)
         elif kind == TxOp.REMOVE:
             _, c, o = op
-            self._rm_object(batch, c, o)
+            self._rm_object(view, c, o)
         elif kind == TxOp.SETATTRS:
             _, c, o, attrs = op
             if size_of(c, o) is None:
                 set_size(c, o, 0)
             for k, v in attrs.items():
-                batch.set("X", _okey(c, o) + SEP + k, v)
+                view.set("X", _okey(c, o) + SEP + k, v)
         elif kind == TxOp.RMATTR:
             _, c, o, name = op
-            batch.rmkey("X", _okey(c, o) + SEP + name)
+            view.rmkey("X", _okey(c, o) + SEP + name)
         elif kind == TxOp.OMAP_SETKEYS:
             _, c, o, kv = op
             if size_of(c, o) is None:
                 set_size(c, o, 0)
             for k, v in kv.items():
-                batch.set("M", _okey(c, o) + SEP + k, v)
+                view.set("M", _okey(c, o) + SEP + k, v)
         elif kind == TxOp.OMAP_RMKEYS:
             _, c, o, keys = op
             if size_of(c, o) is None:
                 set_size(c, o, 0)
             for k in keys:
-                batch.rmkey("M", _okey(c, o) + SEP + k)
+                view.rmkey("M", _okey(c, o) + SEP + k)
         elif kind == TxOp.OMAP_CLEAR:
             _, c, o = op
             base = _okey(c, o) + SEP
-            batch.rm_range("M", base, base + "\x7f")
+            view.rm_range("M", base, _prefix_end(base))
             if size_of(c, o) is None:
                 set_size(c, o, 0)
         elif kind == TxOp.CLONE:
             _, c, src, dst = op
             size = size_of(c, src)
-            sbase = _okey(c, src) + SEP
-            dbase = _okey(c, dst) + SEP
             set_size(c, dst, size or 0)
-            for prefix in ("D", "X", "M"):
-                it = self.db.get_iterator(prefix).lower_bound(sbase)
-                while it.valid() and it.key().startswith(sbase):
-                    batch.set(prefix, dbase + it.key()[len(sbase):], it.value())
-                    it.next()
+            self._copy_object_keys(view, _okey(c, src) + SEP,
+                                   _okey(c, dst) + SEP)
         elif kind == TxOp.COLL_MOVE_RENAME:
             _, src_c, src_o, dst_c, dst_o = op
             size = size_of(src_c, src_o)
-            sbase = _okey(src_c, src_o) + SEP
-            dbase = _okey(dst_c, dst_o) + SEP
-            for prefix in ("D", "X", "M"):
-                it = self.db.get_iterator(prefix).lower_bound(sbase)
-                while it.valid() and it.key().startswith(sbase):
-                    batch.set(prefix, dbase + it.key()[len(sbase):], it.value())
-                    it.next()
+            self._copy_object_keys(view, _okey(src_c, src_o) + SEP,
+                                   _okey(dst_c, dst_o) + SEP)
             set_size(dst_c, dst_o, size or 0)
-            self._rm_object(batch, src_c, src_o)
+            self._rm_object(view, src_c, src_o)
         else:  # pragma: no cover
             raise ValueError(f"unknown op {kind}")
 
-    def _rm_object(self, batch: WriteBatch, c: coll_t, o: ghobject_t) -> None:
-        batch.rmkey("O", _okey(c, o))
+    @staticmethod
+    def _copy_object_keys(view: "_TxnView", sbase: str, dbase: str) -> None:
+        for prefix in ("D", "X", "M"):
+            for key, val in view.items(prefix, sbase):
+                view.set(prefix, dbase + key[len(sbase):], val)
+
+    @staticmethod
+    def _rm_object(view: "_TxnView", c: coll_t, o: ghobject_t) -> None:
+        view.rmkey("O", _okey(c, o))
         base = _okey(c, o) + SEP
         for prefix in ("D", "X", "M"):
-            batch.rm_range(prefix, base, base + "\x7f")
+            view.rm_range(prefix, base, _prefix_end(base))
 
     # -- validation (MemStore-grade structural checks) -----------------
 
@@ -331,6 +403,16 @@ class KStore(ObjectStore):
             elif kind == TxOp.RMCOLL:
                 if op[1] not in have_coll:
                     raise FileNotFoundError(f"collection {op[1]}")
+                # ENOTEMPTY semantics (MemStore parity): account for
+                # objects created/removed earlier in this same txn
+                residual = set()
+                if self.collection_exists(op[1]):
+                    residual = {(op[1], o) for o in self.collection_list(op[1])}
+                for (oc, oo), alive in objs.items():
+                    if oc == op[1]:
+                        (residual.add if alive else residual.discard)((oc, oo))
+                if residual:
+                    raise OSError(f"collection {op[1]} not empty")
                 have_coll.discard(op[1])
             elif kind == TxOp.COLL_MOVE_RENAME:
                 _, src_c, src_o, dst_c, dst_o = op

@@ -99,6 +99,11 @@ class TestObjectClasses:
                 with pytest.raises(RadosError) as ei:
                     await io.execute("obj", "nope", "nothing")
                 assert ei.value.errno == errno.EOPNOTSUPP
+                # malformed client input is contained as EINVAL, not EIO
+                # (reference ClassHandler method-call containment)
+                with pytest.raises(RadosError) as ei:
+                    await io.execute("obj", "lock", "lock", b"not-json")
+                assert ei.value.errno == errno.EINVAL
 
         run(go())
 

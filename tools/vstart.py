@@ -35,9 +35,15 @@ async def amain(args) -> int:
     def _store(name: str):
         if not args.data:
             return None
-        from ceph_tpu.store.filestore import FileStore
+        if getattr(args, "store", "file") == "kstore":
+            from ceph_tpu.kv import FileDB
+            from ceph_tpu.store.kstore import KStore
 
-        s = FileStore(os.path.join(args.data, name))
+            s = KStore(FileDB(os.path.join(args.data, name)))
+        else:
+            from ceph_tpu.store.filestore import FileStore
+
+            s = FileStore(os.path.join(args.data, name))
         s.mount()
         return s
 
@@ -90,8 +96,13 @@ def main(argv=None) -> int:
     ap.add_argument("--out-interval", type=float, default=0.0)
     ap.add_argument(
         "--data", default="",
-        help="data directory: daemons run on durable FileStores and the "
+        help="data directory: daemons run on durable stores and the "
              "cluster survives restart (default: volatile MemStores)",
+    )
+    ap.add_argument(
+        "--store", choices=("file", "kstore"), default="file",
+        help="durable engine under --data: file = FileStore WAL, "
+             "kstore = objects-in-kv over FileDB (src/os/kstore twin)",
     )
     args = ap.parse_args(argv)
     try:
