@@ -34,8 +34,11 @@ class Option:
     min: float | None = None
     max: float | None = None
     see_also: tuple[str, ...] = ()
+    enum: tuple[str, ...] = ()
 
     def cast(self, value: Any) -> Any:
+        if self.enum and value not in self.enum:
+            raise ValueError(f"{self.name}: {value!r} not in {self.enum}")
         if self.type is bool and isinstance(value, str):
             v = value.strip().lower()
             if v in ("true", "1", "yes", "on"):
@@ -103,6 +106,14 @@ declare(
            "inject a connection reset every N sent frames (0 = off); "
            "the reference's ms_inject_socket_failures "
            "(src/common/options/global.yaml.in:1242)"),
+    Option("osd_ec_encode_farm", str, "auto", LEVEL_ADVANCED,
+           "route EC encode/decode matmuls through the multi-device "
+           "encode farm (ceph_tpu/parallel/encode_service.py): auto = "
+           "when the process sees >1 jax device, on, off",
+           enum=("auto", "on", "off")),
+    Option("osd_ec_farm_min_bytes", int, 32768, LEVEL_ADVANCED,
+           "payloads below this stay on the single-device path even "
+           "when the farm is active", min=0),
     Option("debug_osd", int, 1, LEVEL_DEV, "osd log verbosity", min=0, max=5),
     Option("debug_mon", int, 1, LEVEL_DEV, "mon log verbosity", min=0, max=5),
 )

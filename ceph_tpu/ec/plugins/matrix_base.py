@@ -185,6 +185,57 @@ class MatrixErasureCode(ErasureCode):
             self._decode_cache.popitem(last=False)
         return D
 
+    def decode_plan(
+        self,
+        available: Mapping[int, np.ndarray],
+        want_chunks: Iterable[int],
+    ) -> tuple[tuple[int, ...], list[int], list[int], np.ndarray | None]:
+        """Survivor/erasure algebra shared by the sync decode path and
+        the encode farm's async twin (ecutil._decode_chunks_async):
+        (erasures, survivors, need_rec, decode matrix or None)."""
+        import errno as _errno
+
+        n = self.k + self.m
+        erasures = tuple(c for c in range(n) if self.chunk_index(c) not in available)
+        survivors = [c for c in range(n) if self.chunk_index(c) in available][: self.k]
+        if len(survivors) < self.k:
+            raise ECError(_errno.EIO, "not enough chunks to decode")
+        need_rec = [c for c in want_chunks if c in erasures]
+        D = self._decode_matrix(erasures) if need_rec else None
+        return erasures, survivors, need_rec, D
+
+    def decode_rows(
+        self, available: Mapping[int, np.ndarray], survivors: list[int]
+    ) -> np.ndarray:
+        """Stack survivor payloads into the matmul operand."""
+        return np.concatenate(
+            [
+                self._chunk_to_rows(
+                    np.ascontiguousarray(available[self.chunk_index(c)])
+                )
+                for c in survivors
+            ]
+        )
+
+    def decode_assemble(
+        self,
+        available: Mapping[int, np.ndarray],
+        want_chunks: Iterable[int],
+        erasures: tuple[int, ...],
+        need_rec: list[int],
+        rec_rows: np.ndarray | None,
+    ) -> dict[int, np.ndarray]:
+        """Map reconstructed rows + passthrough chunks to chunk ids."""
+        out: dict[int, np.ndarray] = {}
+        r = self.rows_per_chunk
+        for t, c in enumerate(erasures):
+            if c in need_rec:
+                out[c] = self._rows_to_chunk(rec_rows[t * r : (t + 1) * r])
+        for c in want_chunks:
+            if c not in out:
+                out[c] = np.asarray(available[self.chunk_index(c)])
+        return out
+
     def decode_payloads(
         self,
         available: Mapping[int, np.ndarray],
@@ -197,36 +248,16 @@ class MatrixErasureCode(ErasureCode):
 
         This is the single home of the survivor/erasure algebra; both
         per-stripe decode_chunks and ECUtil's whole-payload batched
-        decode (reference ECUtil.cc:50-121) go through it.
+        decode (reference ECUtil.cc:50-121) go through it, and the
+        encode-farm async twin reuses the same plan/rows/assemble
+        pieces with the matmul on the mesh.
         """
-        import errno as _errno
-
-        n = self.k + self.m
-        erasures = tuple(c for c in range(n) if self.chunk_index(c) not in available)
-        survivors = [c for c in range(n) if self.chunk_index(c) in available][: self.k]
-        if len(survivors) < self.k:
-            raise ECError(_errno.EIO, "not enough chunks to decode")
-        out: dict[int, np.ndarray] = {}
-        need_rec = [c for c in want_chunks if c in erasures]
+        want_chunks = list(want_chunks)
+        erasures, survivors, need_rec, D = self.decode_plan(available, want_chunks)
+        rec_rows = None
         if need_rec:
-            D = self._decode_matrix(erasures)
-            rows = np.concatenate(
-                [
-                    self._chunk_to_rows(
-                        np.ascontiguousarray(available[self.chunk_index(c)])
-                    )
-                    for c in survivors
-                ]
-            )
-            rec = self._apply_matrix(D, rows)
-            r = self.rows_per_chunk
-            for t, c in enumerate(erasures):
-                if c in need_rec:
-                    out[c] = self._rows_to_chunk(rec[t * r : (t + 1) * r])
-        for c in want_chunks:
-            if c not in out:
-                out[c] = np.asarray(available[self.chunk_index(c)])
-        return out
+            rec_rows = self._apply_matrix(D, self.decode_rows(available, survivors))
+        return self.decode_assemble(available, want_chunks, erasures, need_rec, rec_rows)
 
     def decode_chunks(
         self,

@@ -157,6 +157,7 @@ class OSDDaemon:
         beacon_interval: float | None = None,
         conf=None,
         auth=None,
+        encode_service=None,
     ):
         from ceph_tpu.common import ConfigProxy, get_perf_counters
 
@@ -168,6 +169,11 @@ class OSDDaemon:
         self.mon_addr = self.mon_addrs[0]
         self.conf = conf if conf is not None else ConfigProxy()
         self.store = store or MemStore()
+        # multi-device encode farm (production ECSubWrite-fan-out seam,
+        # SURVEY.md §2.9); resolved lazily so single-device processes
+        # never touch jax at boot
+        self._encode_service = encode_service
+        self._encode_service_resolved = encode_service is not None
         self.messenger = Messenger(
             ("osd", osd_id), self._dispatch, on_reset=self._on_reset,
             auth=auth,
@@ -205,7 +211,7 @@ class OSDDaemon:
         # watchers in object_info and re-establishes via client linger —
         # here clients re-watch after a primary change)
         self._watchers: dict[tuple[int, str], dict[tuple, object]] = {}
-        self._notify_waiters: dict[int, asyncio.Future] = {}
+        self._notify_waiters: dict[tuple, asyncio.Future] = {}
         self._ec_cache: dict[str, object] = {}
         self._pg_logs: dict[coll_t, PGLog] = {}
         self._beacon_task: asyncio.Task | None = None
@@ -462,6 +468,32 @@ class OSDDaemon:
     def _acting(self, pool: PgPool, pg: pg_t) -> tuple[list[int], int]:
         _, _, acting, primary = self.osdmap.pg_to_up_acting_osds(pg)
         return acting, primary
+
+    @property
+    def encode_service(self):
+        """The process encode farm, per osd_ec_encode_farm config:
+        'auto' = farm when >1 local jax device, 'on' = always attach the
+        shared service, 'off' = never.  Resolved once, lazily."""
+        if not self._encode_service_resolved:
+            self._encode_service_resolved = True
+            mode = self.conf["osd_ec_encode_farm"]
+            if mode != "off":
+                from ceph_tpu.parallel import encode_service as es
+
+                svc = es.shared()
+                if svc.active() or mode == "on":
+                    svc.min_bytes = self.conf["osd_ec_farm_min_bytes"]
+                    self._encode_service = svc
+        return self._encode_service
+
+    async def _ecu_encode(self, sinfo, ec, logical):
+        """ecutil.encode via the farm (falls back inside)."""
+        return await ecutil.encode_async(
+            sinfo, ec, logical, service=self.encode_service)
+
+    async def _ecu_decode_concat(self, sinfo, ec, chunks):
+        return await ecutil.decode_concat_async(
+            sinfo, ec, chunks, service=self.encode_service)
 
     def _pg_log(self, c: coll_t) -> PGLog:
         lg = self._pg_logs.get(c)
@@ -832,7 +864,7 @@ class OSDDaemon:
                     off, buf = e
                     padded[off : off + len(buf)] = buf
             if len(padded):
-                shards = ecutil.encode(sinfo, ec, padded)
+                shards = await self._ecu_encode(sinfo, ec, padded)
             else:
                 shards = {s: np.zeros(0, np.uint8) for s in range(ec.get_chunk_count())}
             hinfo = ecutil.HashInfo(ec.get_chunk_count())
@@ -894,14 +926,14 @@ class OSDDaemon:
                 )
             except ECFetchError as e:
                 return MOSDOpReply(tid=msg.tid, result=-e.errno, epoch=self.epoch)
-            old_logical = ecutil.decode_concat(sinfo, ec, chunks)
+            old_logical = await self._ecu_decode_concat(sinfo, ec, chunks)
             buf[: len(old_logical)] = old_logical
         for off, data in real_edits:
             lo = max(off, d_lo)
             hi = min(off + len(data), d_hi)
             if lo < hi:
                 buf[lo - d_lo : hi - d_lo] = data[lo - off : hi - off]
-        shards = ecutil.encode(sinfo, ec, buf)
+        shards = await self._ecu_encode(sinfo, ec, buf)
         # the cumulative-append crc chain cannot survive an overwrite;
         # deep scrub falls back to the parity-equation check (the
         # reference's ec_overwrites pools drop hinfo the same way)
@@ -1091,7 +1123,7 @@ class OSDDaemon:
         logical = None
         base = 0
         if reads and chunks and any(len(v) for v in chunks.values()):
-            logical = ecutil.decode_concat(sinfo, ec, chunks)
+            logical = await self._ecu_decode_concat(sinfo, ec, chunks)
             base = sinfo.aligned_chunk_offset_to_logical_offset(chunk_off)
         outs: list[tuple[int, bytes, dict[str, bytes]]] = []
         first_read: bytes | None = None
@@ -1283,7 +1315,7 @@ class OSDDaemon:
                 waits = []
                 for (entity, cookie), conn in watchers.items():
                     fut = asyncio.get_running_loop().create_future()
-                    self._notify_waiters[notify_id * 1000003 + cookie] = fut
+                    self._notify_waiters[(notify_id, entity, cookie)] = fut
                     try:
                         await conn.send_message(MWatchNotify(
                             notify_id=notify_id, cookie=cookie,
@@ -1294,8 +1326,7 @@ class OSDDaemon:
                         # dead watcher: drop it (client linger would
                         # re-establish in the reference)
                         self._watchers.get(key, {}).pop((entity, cookie), None)
-                        self._notify_waiters.pop(
-                            notify_id * 1000003 + cookie, None)
+                        self._notify_waiters.pop((notify_id, entity, cookie), None)
                 deadline = asyncio.get_running_loop().time() + timeout
                 for entity, cookie, fut in waits:
                     remaining = deadline - asyncio.get_running_loop().time()
@@ -1307,8 +1338,7 @@ class OSDDaemon:
                     except asyncio.TimeoutError:
                         missed.append((entity, cookie))
                     finally:
-                        self._notify_waiters.pop(
-                            notify_id * 1000003 + cookie, None)
+                        self._notify_waiters.pop((notify_id, entity, cookie), None)
                 d = json.dumps({
                     "acks": [
                         [list(e), c, base64.b64encode(rep).decode()]
@@ -1325,7 +1355,7 @@ class OSDDaemon:
         )
 
     def _handle_notify_ack(self, msg: MWatchNotifyAck) -> None:
-        fut = self._notify_waiters.get(msg.notify_id * 1000003 + msg.cookie)
+        fut = self._notify_waiters.get((msg.notify_id, msg.src, msg.cookie))
         if fut and not fut.done():
             fut.set_result(msg)
 
@@ -1904,8 +1934,9 @@ class OSDDaemon:
                     "succeeded", self.id, pg, oid, len(chunks), k,
                 )
                 return
-        rebuilt = ecutil.decode_shards(
-            sinfo, ec, chunks, need, packed_repair=used_packed
+        rebuilt = await ecutil.decode_shards_async(
+            sinfo, ec, chunks, need, packed_repair=used_packed,
+            service=self.encode_service,
         )
         await asyncio.gather(*(
             self._push(pool, pg, s, o, oid, rebuilt[s].tobytes(), src_attrs)

@@ -1,0 +1,197 @@
+"""EncodeService: the in-daemon microbatching bridge onto the encode farm.
+
+This is the production wiring of the multi-chip shardings
+(ceph_tpu/parallel/encode_farm.py) into the I/O path: OSD write/recovery
+ops running as concurrent asyncio tasks enqueue their GF(2^8) matrix
+applications here; requests that land within one coalescing window and
+share a matrix are padded into a single (B, k, S) batch and dispatched
+through :func:`batch_encode_dp` over the device mesh.  A lone large
+request takes the chunk-sharded :func:`sharded_encode_tp` path instead
+(partial GF sums psum-combined over ICI).
+
+This is the seam the reference implements as the ECSubWrite fan-out /
+per-op `ECUtil::encode` loop (reference src/osd/ECCommon.cc:749
+generate_transactions -> ECTransaction.cc:37 encode_and_write, and
+src/osd/OSDMapMapping.h:18 ParallelPGMapper for the batch-parallel
+pattern): independent per-PG ops become one batched TPU computation.
+
+Single-device processes (or payloads under ``min_bytes``) fall back to
+the caller's host/1-chip path — the service is then inactive and
+``apply`` is never awaited (callers check :meth:`active`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+
+import numpy as np
+
+#: payloads smaller than this stay on the caller's local path — TPU/mesh
+#: dispatch overhead dwarfs the math (SURVEY.md §7 hard part 3)
+DEFAULT_MIN_BYTES = 32768
+
+_BITS_CACHE_SIZE = 64
+
+
+class EncodeService:
+    """Coalesces concurrent GF matrix applications onto a device mesh.
+
+    ``mesh`` must have a ``pg`` axis (stripe-batch data parallelism) and
+    may have a ``shard`` axis (chunk sharding for the tp path).  With
+    ``mesh=None`` the service is inactive and callers use their local
+    path.
+    """
+
+    def __init__(self, mesh=None, *, min_bytes: int = DEFAULT_MIN_BYTES,
+                 window_s: float = 0.001):
+        self.mesh = mesh
+        self.min_bytes = min_bytes
+        self.window_s = window_s
+        self._pending: dict[bytes, list[tuple]] = {}
+        self._flush_handle = None
+        self._bits_cache: collections.OrderedDict = collections.OrderedDict()
+        self.stats = collections.Counter()
+
+    # -- gating --------------------------------------------------------
+
+    def active(self) -> bool:
+        return self.mesh is not None
+
+    def usable(self, rows: np.ndarray) -> bool:
+        return self.active() and rows.size >= self.min_bytes
+
+    # -- request side --------------------------------------------------
+
+    async def apply(self, M: np.ndarray, rows: np.ndarray) -> np.ndarray:
+        """``M @ rows`` over GF(2^8), batched with concurrent callers.
+
+        M is an (out, k) byte matrix (coding or cached decode matrix);
+        rows is (k, S) uint8.  Returns (out, S) uint8.
+        """
+        assert self.active()
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        key = M.shape[0].to_bytes(2, "little") + M.tobytes()
+        self._pending.setdefault(key, []).append((M, rows, fut))
+        if self._flush_handle is None:
+            self._flush_handle = loop.call_later(self.window_s, self._flush)
+        return await fut
+
+    # -- dispatch side -------------------------------------------------
+
+    def _bits(self, M: np.ndarray):
+        import jax.numpy as jnp
+
+        from ceph_tpu.ops.gf256 import gf_matrix_to_bitmatrix
+
+        key = M.shape[0].to_bytes(2, "little") + M.tobytes()
+        hit = self._bits_cache.get(key)
+        if hit is None:
+            hit = jnp.asarray(gf_matrix_to_bitmatrix(M))
+            self._bits_cache[key] = hit
+            if len(self._bits_cache) > _BITS_CACHE_SIZE:
+                self._bits_cache.popitem(last=False)
+        else:
+            self._bits_cache.move_to_end(key)
+        return hit
+
+    def _flush(self) -> None:
+        """call_later callback: hand every pending group to a worker
+        thread.  The JAX dispatch (and any first-use XLA compile) must
+        NOT run on the event loop — it would stall heartbeats and op
+        processing for every daemon in the process."""
+        self._flush_handle = None
+        pending, self._pending = self._pending, {}
+        loop = asyncio.get_running_loop()
+        for group in pending.values():
+            loop.create_task(self._dispatch_group(group))
+
+    async def _dispatch_group(self, group: list[tuple]) -> None:
+        try:
+            outs = await asyncio.to_thread(self._run_group, group)
+        except Exception:
+            # farm failure: answer every waiter from the host path
+            # (always correct), don't fail client ops
+            from ceph_tpu.ops.gf256 import gf_matmul
+
+            self.stats["fallbacks"] += 1
+            outs = await asyncio.to_thread(
+                lambda: [gf_matmul(M, rows) for M, rows, _ in group])
+        for (_, _, fut), out in zip(group, outs):
+            if not fut.done():
+                fut.set_result(out)
+
+    def _run_group(self, group: list[tuple]) -> list[np.ndarray]:
+        """Worker-thread body: one farm dispatch for the whole group;
+        returns per-request outputs in order."""
+        import jax.numpy as jnp
+
+        from ceph_tpu.parallel.encode_farm import (
+            batch_encode_dp,
+            sharded_encode_tp,
+        )
+
+        M = group[0][0]
+        bits = self._bits(M)
+        k = M.shape[1]
+
+        if len(group) == 1 and "shard" in self.mesh.shape:
+            _, rows, _fut = group[0]
+            nsh = self.mesh.shape["shard"]
+            if nsh > 1 and k % nsh == 0:
+                out = np.asarray(
+                    sharded_encode_tp(self.mesh, bits, jnp.asarray(rows)))
+                self.stats["tp_dispatches"] += 1
+                return [out]
+
+        # data-parallel batch: pad each request to the widest S, pad the
+        # batch to the device count, one sharded dispatch
+        ndev = 1
+        for ax in self.mesh.shape.values():
+            ndev *= ax
+        widths = [rows.shape[1] for _, rows, _ in group]
+        S = max(widths)
+        B = -(-len(group) // ndev) * ndev
+        batch = np.zeros((B, k, S), np.uint8)
+        for i, (_, rows, _) in enumerate(group):
+            batch[i, :, : rows.shape[1]] = rows
+        axes = tuple(a for a in ("pg", "shard") if a in self.mesh.shape)
+        out = np.asarray(
+            batch_encode_dp(self.mesh, bits, jnp.asarray(batch), axis=axes))
+        self.stats["dp_dispatches"] += 1
+        self.stats["coalesced"] += len(group)
+        return [
+            np.ascontiguousarray(out[i, :, : rows.shape[1]])
+            for i, (_, rows, _) in enumerate(group)
+        ]
+
+
+_shared: EncodeService | None = None
+
+
+def shared() -> EncodeService:
+    """Process-wide service; builds a mesh over all local devices on
+    first use (inactive when the process sees a single device)."""
+    global _shared
+    if _shared is None:
+        mesh = None
+        try:
+            import jax
+            from jax.sharding import Mesh
+
+            devs = jax.devices()
+            if len(devs) > 1:
+                nsh = 2 if len(devs) % 2 == 0 else 1
+                devgrid = np.asarray(devs).reshape(len(devs) // nsh, nsh)
+                mesh = Mesh(devgrid, ("pg", "shard"))
+        except Exception:
+            mesh = None
+        _shared = EncodeService(mesh)
+    return _shared
+
+
+def reset_shared() -> None:
+    """Test hook: drop the process-wide service."""
+    global _shared
+    _shared = None

@@ -37,8 +37,10 @@ def run(coro):
 
 
 class Cluster:
-    def __init__(self, n_osds: int = N_OSDS, osd_conf: dict | None = None):
+    def __init__(self, n_osds: int = N_OSDS, osd_conf: dict | None = None,
+                 store_factory=None):
         self.osd_conf = osd_conf
+        self.store_factory = store_factory
         crush = CrushMap()
         # one osd per host: failure domain host == osd for small tests
         B.build_hierarchy(crush, osds_per_host=1, n_hosts=n_osds)
@@ -52,7 +54,8 @@ class Cluster:
 
         for i in range(len(self.osds)):
             conf = ConfigProxy(self.osd_conf) if self.osd_conf else None
-            self.osds[i] = OSDDaemon(i, self.mon.addr, conf=conf)
+            store = self.store_factory(i) if self.store_factory else None
+            self.osds[i] = OSDDaemon(i, self.mon.addr, conf=conf, store=store)
             await self.osds[i].start()
         await self.client.connect(*self.mon.addr)
         return self
@@ -94,6 +97,28 @@ class TestReplicatedPool:
                 await io.remove("obj-small")
                 with pytest.raises(OSError):
                     await io.read("obj-small")
+
+        run(go())
+
+    def test_write_read_on_kstore(self, tmp_path):
+        """OSDs on the durable objects-in-kv engine (KStore over FileDB):
+        exercises blocking_commit off-loop commits through the daemon."""
+        from ceph_tpu.kv import FileDB
+        from ceph_tpu.store.kstore import KStore
+
+        def factory(i):
+            s = KStore(FileDB(str(tmp_path / f"osd{i}")))
+            s.mount()
+            return s
+
+        async def go():
+            async with Cluster(store_factory=factory) as c:
+                await c.client.pool_create("rbd", pg_num=4, size=3)
+                io = c.client.ioctx("rbd")
+                for oid, data in PAYLOADS.items():
+                    await io.write_full(oid, data)
+                for oid, data in PAYLOADS.items():
+                    assert await io.read(oid) == data
 
         run(go())
 
