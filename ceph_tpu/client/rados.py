@@ -423,28 +423,90 @@ class ObjectOperation:
 
 
 class IoCtx:
-    """Per-pool I/O handle (librados::IoCtx)."""
+    """Per-pool I/O handle (librados::IoCtx).
+
+    Snapshots (librados snap API): :meth:`set_snap_context` attaches a
+    self-managed SnapContext to writes (selfmanaged_snap_set_write_ctx);
+    :meth:`snap_set_read` points reads at a snap id (NOSNAP = head).
+    """
 
     def __init__(self, client: RadosClient, pool_id: int):
         self.client = client
         self.pool_id = pool_id
+        from ceph_tpu.osd.snaps import NOSNAP
+
+        self.snap_seq: int = 0
+        self.snaps: list[int] = []
+        self.read_snap: int = NOSNAP
+
+    def set_snap_context(self, seq: int, snaps: list[int]) -> None:
+        """selfmanaged_snap_set_write_ctx: snaps newest-first."""
+        if snaps and (seq < snaps[0] or sorted(
+                snaps, reverse=True) != list(snaps)):
+            raise RadosError(22, "invalid snap context")
+        self.snap_seq, self.snaps = seq, list(snaps)
+
+    def snap_set_read(self, snapid) -> None:
+        from ceph_tpu.osd.snaps import NOSNAP
+
+        self.read_snap = NOSNAP if snapid is None else snapid
+
+    async def selfmanaged_snap_create(self) -> int:
+        """Allocate a new self-managed snap id (pool snap_seq bump)."""
+        import json as _json
+
+        name = self.client.osdmap.pool_names[self.pool_id]
+        code, rs, data = await self.client.command({
+            "prefix": "osd pool selfmanaged-snap create", "pool": name,
+        })
+        if code != 0:
+            raise RadosError(-code, rs)
+        return _json.loads(data)["snapid"]
+
+    async def selfmanaged_snap_remove(self, snapid: int) -> None:
+        name = self.client.osdmap.pool_names[self.pool_id]
+        code, rs, _ = await self.client.command({
+            "prefix": "osd pool selfmanaged-snap rm", "pool": name,
+            "snapid": str(snapid),
+        })
+        if code != 0:
+            raise RadosError(-code, rs)
+
+    def _msg(self, oid: str, **kw) -> MOSDOp:
+        m = MOSDOp(pool=self.pool_id, oid=oid, **kw)
+        m.snap_seq, m.snaps = self.snap_seq, list(self.snaps)
+        m.snapid = self.read_snap
+        return m
 
     async def _op1(self, oid: str, what: str, **kw) -> MOSDOpReply:
-        reply = await self.client._submit(self.pool_id, MOSDOp(
-            pool=self.pool_id, oid=oid, **kw,
-        ))
+        reply = await self.client._submit(
+            self.pool_id, self._msg(oid, **kw))
         if reply.result != 0:
             raise RadosError(-reply.result, f"{what} {oid!r}")
         return reply
 
     async def operate(self, oid: str, op: ObjectOperation) -> MOSDOpReply:
         """Submit a compound vector; per-op results in reply.outs."""
-        reply = await self.client._submit(self.pool_id, MOSDOp(
-            pool=self.pool_id, oid=oid, ops=list(op.ops),
-        ))
+        reply = await self.client._submit(
+            self.pool_id, self._msg(oid, ops=list(op.ops)))
         if reply.result != 0:
             raise RadosError(-reply.result, f"operate {oid!r}")
         return reply
+
+    async def rollback(self, oid: str, snapid: int) -> None:
+        """selfmanaged_snap_rollback: restore head from snap."""
+        from ceph_tpu.msg.messages import OP_ROLLBACK
+
+        await self._op1(oid, "rollback", op=OP_ROLLBACK, off=snapid)
+
+    async def list_snaps(self, oid: str) -> dict:
+        """Object SnapSet dump (CEPH_OSD_OP_LIST_SNAPS)."""
+        import json as _json
+
+        from ceph_tpu.msg.messages import OP_LIST_SNAPS
+
+        reply = await self._op1(oid, "list_snaps", op=OP_LIST_SNAPS)
+        return _json.loads(reply.data)
 
     async def write_full(self, oid: str, data: bytes) -> None:
         await self._op1(oid, "write_full", op=OP_WRITE_FULL, data=bytes(data))

@@ -549,6 +549,16 @@ class Monitor:
             om.erasure_code_profiles[op["name"]] = dict(op["profile"])
         elif kind == "pool_create":
             self._apply_pool_create(op)
+        elif kind == "snap_alloc":
+            pool = om.pools[op["pool"]]
+            pool.snap_seq = max(pool.snap_seq, op["snapid"])
+            if op.get("name"):
+                pool.pool_snaps[op["name"]] = op["snapid"]
+        elif kind == "snap_rm":
+            pool = om.pools[op["pool"]]
+            pool.removed_snaps.add(op["snapid"])
+            if op.get("name"):
+                pool.pool_snaps.pop(op["name"], None)
         elif kind == "upmap":
             from ceph_tpu.osd.types import pg_t
 
@@ -602,6 +612,16 @@ class Monitor:
             except ConnectionError:
                 continue  # lost quorum mid-sweep; retry next tick
 
+    def _snap_alloc_lock(self, pool_id: int):
+        locks = getattr(self, "_snap_locks", None)
+        if locks is None:
+            locks = self._snap_locks = {}
+        if pool_id not in locks:
+            import asyncio as _asyncio
+
+            locks[pool_id] = _asyncio.Lock()
+        return locks[pool_id]
+
     # -- commands (the MonCommands.h slice) ----------------------------
 
     async def _command(self, cmd: dict[str, str]) -> tuple[int, str, bytes]:
@@ -612,6 +632,9 @@ class Monitor:
         mutating = prefix in (
             "osd erasure-code-profile set", "osd pool create",
             "osd down", "osd out", "osd balance",
+            "osd pool selfmanaged-snap create",
+            "osd pool selfmanaged-snap rm",
+            "osd pool mksnap", "osd pool rmsnap",
         )
         if mutating and not self.is_leader:
             leader = self.paxos.leader if self.paxos.leader is not None else -1
@@ -631,6 +654,50 @@ class Monitor:
                 return 0, f"profile {name} set", b""
             if prefix == "osd pool create":
                 return await self._pool_create(cmd)
+            if prefix == "osd pool selfmanaged-snap create":
+                pid = self._pool_ids[cmd["pool"]]
+                # serialize id allocation: two concurrent creates must
+                # not both read snap_seq before either commits
+                async with self._snap_alloc_lock(pid):
+                    snapid = self.osdmap.pools[pid].snap_seq + 1
+                    await self._propose({
+                        "op": "snap_alloc", "pool": pid, "snapid": snapid,
+                    })
+                return 0, f"snap {snapid}", json.dumps(
+                    {"snapid": snapid}).encode()
+            if prefix == "osd pool selfmanaged-snap rm":
+                pid = self._pool_ids[cmd["pool"]]
+                snapid = int(cmd["snapid"])
+                if snapid not in self.osdmap.pools[pid].removed_snaps:
+                    await self._propose({
+                        "op": "snap_rm", "pool": pid, "snapid": snapid,
+                    })
+                return 0, f"snap {snapid} removed", b""
+            if prefix == "osd pool mksnap":
+                pid = self._pool_ids[cmd["pool"]]
+                name = cmd["snap"]
+                async with self._snap_alloc_lock(pid):
+                    pool = self.osdmap.pools[pid]
+                    if name in pool.pool_snaps:
+                        return -errno.EEXIST, f"snap {name} exists", b""
+                    snapid = pool.snap_seq + 1
+                    await self._propose({
+                        "op": "snap_alloc", "pool": pid, "snapid": snapid,
+                        "name": name,
+                    })
+                return 0, f"created pool snap {name}", json.dumps(
+                    {"snapid": snapid}).encode()
+            if prefix == "osd pool rmsnap":
+                pid = self._pool_ids[cmd["pool"]]
+                name = cmd["snap"]
+                pool = self.osdmap.pools[pid]
+                if name not in pool.pool_snaps:
+                    return -errno.ENOENT, f"no snap {name}", b""
+                await self._propose({
+                    "op": "snap_rm", "pool": pid,
+                    "snapid": pool.pool_snaps[name], "name": name,
+                })
+                return 0, f"removed pool snap {name}", b""
             if prefix == "osd down":
                 osd = int(cmd["id"])
                 if self.osdmap.is_up(osd):

@@ -224,10 +224,17 @@ OP_NOTIFY = 22
 # Object-class call (cls dispatch, src/objclass/)
 OP_CALL = 23
 
+OP_ROLLBACK = 24     # CEPH_OSD_OP_ROLLBACK: restore head from a snap
+OP_LIST_SNAPS = 25   # CEPH_OSD_OP_LIST_SNAPS: dump the object's SnapSet
+# internal effect op (primary -> replica/shard): clone head -> clone
+# object before applying the rest of the vector (make_writeable COW);
+# off = clone id, data = json list of covered snaps
+OP_SNAP_CLONE = 26
+
 WRITE_OPS = frozenset({
     OP_WRITE_FULL, OP_DELETE, OP_WRITE, OP_APPEND, OP_ZERO, OP_TRUNCATE,
     OP_CREATE, OP_SETXATTR, OP_RMXATTR, OP_OMAP_SETKEYS, OP_OMAP_RMKEYS,
-    OP_OMAP_CLEAR,
+    OP_OMAP_CLEAR, OP_ROLLBACK, OP_SNAP_CLONE,
 })
 
 
@@ -292,9 +299,18 @@ class MOSDOp(Message):
         op: int | None = None, off: int = 0, length: int = 0,
         data: bytes = b"", epoch: int = 0,
         ops: list[OSDOp] | None = None, reqid: str = "",
+        snap_seq: int = 0, snaps: list[int] | None = None,
+        snapid: int | None = None,
     ):
         self.tid, self.pool, self.oid = tid, pool, oid
         self.epoch = epoch
+        # write SnapContext (MOSDOp snapc: seq + existing snaps,
+        # newest first) and read snap id (CEPH_NOSNAP = head)
+        from ceph_tpu.osd.snaps import NOSNAP
+
+        self.snap_seq = snap_seq
+        self.snaps = snaps or []
+        self.snapid = NOSNAP if snapid is None else snapid
         # stable across client resends (osd_reqid_t): the OSD's pg-log
         # dup detection answers a retried non-idempotent op instead of
         # re-applying it
@@ -327,12 +343,21 @@ class MOSDOp(Message):
             o.encode(enc)
         enc.u32(self.epoch)
         enc.str_(self.reqid)
+        enc.u64(self.snap_seq)
+        enc.u32(len(self.snaps))
+        for s in self.snaps:
+            enc.u64(s)
+        enc.u64(self.snapid)
 
     @classmethod
     def decode_payload(cls, dec):
         tid, pool, oid = dec.u64(), dec.i64(), dec.str_()
         ops = [OSDOp.decode(dec) for _ in range(dec.u32())]
-        return cls(tid, pool, oid, epoch=dec.u32(), ops=ops, reqid=dec.str_())
+        msg = cls(tid, pool, oid, epoch=dec.u32(), ops=ops, reqid=dec.str_())
+        msg.snap_seq = dec.u64()
+        msg.snaps = [dec.u64() for _ in range(dec.u32())]
+        msg.snapid = dec.u64()
+        return msg
 
 
 class MOSDOpReply(Message):
@@ -389,10 +414,26 @@ class MOSDECSubOpWrite(Message):
         data: bytes = b"", attrs: dict[str, bytes] | None = None,
         epoch: int = 0, truncate: int = -1, delete: bool = False,
         version=None, guard=None, rmattrs: list[str] | None = None,
-        reqid: str = "",
+        reqid: str = "", clone_snap: int = 0, clone_snaps: bytes = b"",
+        prev_version=None, guarded: bool = False,
     ):
+        from ceph_tpu.osd.pglog import ZERO
+
         self.tid, self.pg, self.shard, self.from_osd = tid, pg, shard, from_osd
         self.oid, self.off, self.data = oid, off, data
+        # COW directive: before applying the payload, clone the local
+        # head shard to (oid, snap=clone_snap); clone_snaps is the json
+        # covered-snaps list stored on the clone (make_writeable twin)
+        self.clone_snap = clone_snap
+        self.clone_snaps = clone_snaps
+        # stale-shard write guard: when ``guarded``, the shard applies
+        # only if its local object version equals ``prev_version`` (the
+        # primary's base) — a shard that missed earlier writes must be
+        # recovered first, not stamped current by a partial write (the
+        # reference blocks writes on missing objects until recovery,
+        # PrimaryLogPG::is_missing_object wait)
+        self.prev_version = prev_version if prev_version is not None else ZERO
+        self.guarded = guarded
         self.attrs = attrs or {}
         self.epoch, self.truncate, self.delete = epoch, truncate, delete
         # attr names to remove (rmxattr; e.g. hinfo drop on RMW)
@@ -425,6 +466,10 @@ class MOSDECSubOpWrite(Message):
         for n in self.rmattrs:
             enc.str_(n)
         enc.str_(self.reqid)
+        enc.u64(self.clone_snap)
+        enc.bytes_(self.clone_snaps)
+        _enc_ev(enc, self.prev_version)
+        enc.bool_(self.guarded)
 
     @classmethod
     def decode_payload(cls, dec):
@@ -437,6 +482,10 @@ class MOSDECSubOpWrite(Message):
         )
         msg.rmattrs = [dec.str_() for _ in range(dec.u32())]
         msg.reqid = dec.str_()
+        msg.clone_snap = dec.u64()
+        msg.clone_snaps = dec.bytes_()
+        msg.prev_version = _dec_ev(dec)
+        msg.guarded = dec.bool_()
         return msg
 
 
@@ -480,11 +529,16 @@ class MOSDECSubOpRead(Message):
         from_osd: int = 0, oid: str = "", off: int = 0, length: int = 0,
         want_attrs: bool = False, epoch: int = 0,
         extents: list[tuple[int, int]] | None = None,
+        snap: int | None = None,
     ):
+        from ceph_tpu.osd.snaps import NOSNAP
+
         self.tid, self.pg, self.shard, self.from_osd = tid, pg, shard, from_osd
         self.oid, self.off, self.length = oid, off, length
         self.want_attrs, self.epoch = want_attrs, epoch
         self.extents = extents or []
+        # which snap object of oid to read (NOSNAP = head shard)
+        self.snap = NOSNAP if snap is None else snap
 
     def encode_payload(self, enc):
         enc.u64(self.tid)
@@ -499,6 +553,7 @@ class MOSDECSubOpRead(Message):
         for o, ln in self.extents:
             enc.u64(o)
             enc.u64(ln)
+        enc.u64(self.snap)
 
     @classmethod
     def decode_payload(cls, dec):
@@ -511,6 +566,7 @@ class MOSDECSubOpRead(Message):
         msg.extents = [
             (dec.u64(), dec.u64()) for _ in range(dec.u32())
         ]
+        msg.snap = dec.u64()
         return msg
 
 
@@ -636,11 +692,14 @@ class MOSDPGPush(Message):
     def __init__(
         self, pg: pg_t = pg_t(0, 0), shard: int = -1, from_osd: int = 0,
         pushes: list[tuple[str, bytes, dict[str, bytes]]] | None = None,
-        epoch: int = 0,
+        epoch: int = 0, force: bool = False,
     ):
         self.pg, self.shard, self.from_osd = pg, shard, from_osd
         self.pushes = pushes or []
         self.epoch = epoch
+        # divergent rollback: overwrite even a newer local version (the
+        # newer write is being rolled back; its log entry is stripped)
+        self.force = force
 
     def encode_payload(self, enc):
         _enc_pg(enc, self.pg, self.shard)
@@ -651,6 +710,7 @@ class MOSDPGPush(Message):
             enc.str_(oid)
             enc.bytes_(data)
             _enc_map_str_bytes(enc, attrs)
+        enc.bool_(self.force)
 
     @classmethod
     def decode_payload(cls, dec):
@@ -661,7 +721,9 @@ class MOSDPGPush(Message):
             (dec.str_(), dec.bytes_(), _dec_map_str_bytes(dec))
             for _ in range(dec.u32())
         ]
-        return cls(pg, shard, from_osd, pushes, epoch)
+        msg = cls(pg, shard, from_osd, pushes, epoch)
+        msg.force = dec.bool_()
+        return msg
 
 
 class MOSDPGPushReply(Message):

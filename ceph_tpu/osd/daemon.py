@@ -82,8 +82,11 @@ from ceph_tpu.msg.messages import (
     OP_OMAP_GETVALSBYKEYS,
     OP_OMAP_RMKEYS,
     OP_OMAP_SETKEYS,
+    OP_LIST_SNAPS,
     OP_READ,
     OP_RMXATTR,
+    OP_ROLLBACK,
+    OP_SNAP_CLONE,
     OP_SETXATTR,
     OP_STAT,
     OP_TRUNCATE,
@@ -107,6 +110,16 @@ from ceph_tpu.osd.pglog import (
     PGLog,
     eversion_t,
     pg_log_entry_t,
+)
+from ceph_tpu.osd.snaps import (
+    NOSNAP,
+    SNAPS_ATTR,
+    SS_ATTR,
+    WHITEOUT_ATTR,
+    SnapContext,
+    SnapSet,
+    decode_snaps,
+    encode_snaps,
 )
 from ceph_tpu.osd.types import PgPool, pg_t
 from ceph_tpu.store import MemStore, Transaction, coll_t, ghobject_t
@@ -212,6 +225,7 @@ class OSDDaemon:
         # here clients re-watch after a primary change)
         self._watchers: dict[tuple[int, str], dict[tuple, object]] = {}
         self._notify_waiters: dict[tuple, asyncio.Future] = {}
+        self._trim_tasks: set = set()
         self._ec_cache: dict[str, object] = {}
         self._pg_logs: dict[coll_t, PGLog] = {}
         self._beacon_task: asyncio.Task | None = None
@@ -578,9 +592,11 @@ class OSDDaemon:
     async def _handle_map(self, msg: MOSDMap) -> None:
         # copy-on-write swap: code that captured self.osdmap mid-pass
         # keeps a stable snapshot (recovery, in-flight ops)
+        old_map = self.osdmap
         new_map, gap = apply_map_message(self.osdmap, msg.maps, msg.incs)
         if new_map is not None:
             self.osdmap = new_map
+            self._maybe_snap_trim(old_map, new_map)
         if gap:
             # ask the mon for the missing range (or a full map)
             await self._request_map_fill()
@@ -610,6 +626,87 @@ class OSDDaemon:
                 pass  # mon hunt will re-boot us
         if self._recovery_task is None or self._recovery_task.done():
             self._recovery_task = asyncio.ensure_future(self._recover_all())
+
+    def _maybe_snap_trim(self, old_map, new_map) -> None:
+        """Schedule the snap trimmer for pools whose removed_snaps grew
+        (the reference's SnapTrimmer/SnapMapper worker role)."""
+        for pid, pool in new_map.pools.items():
+            old_pool = old_map.pools.get(pid) if old_map else None
+            old_removed = old_pool.removed_snaps if old_pool else set()
+            if pool.removed_snaps - old_removed:
+                task = asyncio.ensure_future(self._snap_trim(pool))
+                # the loop keeps only weak refs to tasks: hold one so a
+                # half-finished trim can't be garbage-collected
+                self._trim_tasks.add(task)
+                task.add_done_callback(self._trim_tasks.discard)
+
+    async def _snap_trim(self, pool) -> None:
+        """Purge clones whose every covered snap is removed; update or
+        drop the head SnapSet; reap whiteout heads with no clones left.
+        Runs on every OSD against its local store — replicas hold the
+        same objects, so local deterministic trimming converges."""
+        import dataclasses
+
+        removed = pool.removed_snaps
+        try:
+            colls = [
+                c for c in self.store.list_collections() if c.pool == pool.id
+            ]
+        except Exception:
+            return
+        for c in colls:
+            try:
+                objs = self.store.collection_list(c)
+            except FileNotFoundError:
+                continue
+            for o in objs:
+                if o.snap < 0:  # head (ghobject default snap = -2)
+                    continue
+                async with self._obj_lock(pool.id, o.name):
+                    try:
+                        raw = self.store.getattr(c, o, SNAPS_ATTR)
+                    except (KeyError, FileNotFoundError):
+                        continue
+                    snaps = decode_snaps(raw)
+                    live = [sn for sn in snaps if sn not in removed]
+                    if live == snaps:
+                        continue
+                    t = Transaction()
+                    head = dataclasses.replace(o, snap=ghobject_t("").snap)
+                    if live:
+                        t.setattrs(c, o, {SNAPS_ATTR: encode_snaps(live)})
+                        # keep the head SnapSet's covered list in step
+                        ss = SnapSet.from_bytes(
+                            self._getattr_quiet(c, head, SS_ATTR))
+                        cl = ss.clone_by_id(o.snap)
+                        if cl is not None and cl.snaps != live:
+                            cl.snaps = list(live)
+                            t.setattrs(c, head, {SS_ATTR: ss.to_bytes()})
+                    else:
+                        t.remove(c, o)
+                        ss = SnapSet.from_bytes(
+                            self._getattr_quiet(c, head, SS_ATTR))
+                        ss.drop_clone(o.snap)
+                        if self.store.exists(c, head):
+                            if not ss.clones and self._is_whiteout(c, head):
+                                t.remove(c, head)
+                            else:
+                                t.setattrs(c, head, {SS_ATTR: ss.to_bytes()})
+                    try:
+                        if getattr(self.store, "blocking_commit", False):
+                            await asyncio.to_thread(
+                                self.store.queue_transaction, t)
+                        else:
+                            self.store.queue_transaction(t)
+                    except (FileNotFoundError, FileExistsError):
+                        pass  # raced a concurrent op; next trim rescans
+                await asyncio.sleep(0)
+
+    def _getattr_quiet(self, c, o, name) -> bytes | None:
+        try:
+            return self.store.getattr(c, o, name)
+        except (KeyError, FileNotFoundError):
+            return None
 
     async def _request_map_fill(self) -> None:
         try:
@@ -674,6 +771,9 @@ class OSDDaemon:
         if any(o.op in (OP_WATCH, OP_UNWATCH, OP_NOTIFY) for o in msg.ops):
             return await self._watch_notify_vector(pool, pg, msg)
         if msg.is_write():
+            if msg.snapid != NOSNAP:
+                return MOSDOpReply(
+                    tid=msg.tid, result=-errno.EROFS, epoch=self.epoch)
             async with self._obj_lock(pool.id, msg.oid):
                 if pool.is_erasure():
                     ec = self._ec_for(pool)
@@ -717,21 +817,37 @@ class OSDDaemon:
     async def _ec_fan_out_write(
         self, pool, pg, live, oid, shard_payloads, attrs, version,
         *, off: int = 0, truncate: int = -1, rmattrs: list[str] | None = None,
-        reqid: str = "",
+        reqid: str = "", prev_version=None, _retried: bool = False,
+        clone_snap: int = 0, clone_snaps: bytes = b"",
     ) -> int:
         """Fan one versioned shard write out to the live set; returns 0
         or the first failing shard's errno (the ECBackend ECSubWrite
-        fan-out, src/osd/ECBackend.cc:943)."""
+        fan-out, src/osd/ECBackend.cc:943).
+
+        ``prev_version`` (None = unguarded) is the base version this
+        write was computed against: every shard must be AT that version
+        or the write is refused with ESTALE — a shard that missed
+        earlier writes is reconciled (recovery roll-forward) and the
+        fan-out retried once, mirroring the reference's write-blocks-on-
+        missing-object rule (PrimaryLogPG::is_missing_object wait)."""
+        guarded = prev_version is not None
         waits = []
+        estale = False
         for shard, osd in live:
             payload = shard_payloads.get(shard, b"")
             if not isinstance(payload, bytes):
                 payload = payload.tobytes()
             if osd == self.id:
+                c = self._shard_coll(pool, pg, shard)
+                o = ghobject_t(oid, shard=shard)
+                if guarded and self._object_version(c, o) != prev_version:
+                    estale = True
+                    continue
                 await self._apply_shard_write_async(
                     pool, pg, shard, oid, payload, attrs, version=version,
                     off=off, truncate=truncate, rmattrs=rmattrs,
-                    reqid=reqid,
+                    reqid=reqid, clone_snap=clone_snap,
+                    clone_snaps=clone_snaps,
                 )
             else:
                 tid = next(self._tids)
@@ -740,11 +856,45 @@ class OSDDaemon:
                     oid=oid, off=off, data=payload, attrs=attrs,
                     epoch=self.epoch, truncate=truncate, version=version,
                     rmattrs=rmattrs or [], reqid=reqid,
+                    prev_version=prev_version, guarded=guarded,
+                    clone_snap=clone_snap, clone_snaps=clone_snaps,
                 ), tid))
+        first_err = 0
         if waits:
             for rep in await asyncio.gather(*waits):
-                if rep.result != 0:
-                    return rep.result
+                if rep.result == -errno.ESTALE:
+                    estale = True
+                elif rep.result != 0 and first_err == 0:
+                    first_err = rep.result
+        if first_err:
+            return first_err
+        if estale:
+            if _retried:
+                return -errno.EAGAIN
+            # roll the lagging shard(s) forward, then retry once; if the
+            # object state moved past our base meanwhile, the client
+            # must redo the RMW from the new base
+            pairs = [(s, o) for s, o in live]
+            try:
+                await self._reconcile_object(
+                    pool, pg, pairs, oid, have_lock=True)
+            except Exception:
+                log.exception(
+                    "osd.%d: pre-write reconcile of %s failed", self.id, oid)
+                return -errno.EAGAIN
+            acting_like = [CRUSH_ITEM_NONE] * pool.size
+            for s, o in live:
+                acting_like[s] = o
+            served = await self._ec_served_version(
+                pool, pg, acting_like, oid)
+            if served != prev_version:
+                return -errno.EAGAIN
+            return await self._ec_fan_out_write(
+                pool, pg, live, oid, shard_payloads, attrs, version,
+                off=off, truncate=truncate, rmattrs=rmattrs, reqid=reqid,
+                prev_version=prev_version, _retried=True,
+                clone_snap=clone_snap, clone_snaps=clone_snaps,
+            )
         return 0
 
     async def _ec_write_vector(
@@ -757,10 +907,13 @@ class OSDDaemon:
         + ExtentCache) re-designed as a single batched read → mutate →
         re-encode → fan-out pass."""
         ops = msg.ops
+        snapc = self._effective_snapc(pool, msg)
+        if snapc.snaps and not snapc.valid():
+            return MOSDOpReply(tid=msg.tid, result=-errno.EINVAL, epoch=self.epoch)
         if any(o.op == OP_DELETE for o in ops):
             if len(ops) != 1:
                 return MOSDOpReply(tid=msg.tid, result=-errno.EINVAL, epoch=self.epoch)
-            return await self._ec_delete(pool, pg, acting, msg)
+            return await self._ec_delete(pool, pg, acting, msg, snapc)
         lv = self._ec_live(pool, acting)
         if lv is None:
             return MOSDOpReply(tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
@@ -770,24 +923,78 @@ class OSDDaemon:
         # pg-log reqid dup lookup in PrimaryLogPG::do_op)
         lg = self._pg_log(self._shard_coll(pool, pg, my_shard))
         if msg.reqid and msg.reqid in lg.reqids:
-            return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
+            # the log claims this op already applied — but a fan-out
+            # that died mid-write may have reached fewer than k shards
+            # (the retry exists BECAUSE something failed).  Verify the
+            # logged version is actually served before vouching for it;
+            # if not, reconcile (roll forward if >= k shards carry it,
+            # else divergent-rollback) and re-apply when rolled back.
+            logged_v = lg.reqids[msg.reqid]
+            served = await self._ec_served_version(
+                pool, pg, acting, msg.oid, lg)
+            if served is not None and served >= logged_v:
+                return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
+            pairs = self._pg_members(pool, acting)
+            try:
+                await self._reconcile_object(
+                    pool, pg, pairs, msg.oid, have_lock=True)
+            except Exception:
+                log.exception(
+                    "osd.%d: dup-retry reconcile of %s failed", self.id,
+                    msg.oid)
+            served = await self._ec_served_version(
+                pool, pg, acting, msg.oid, lg)
+            if served is not None and served >= logged_v:
+                return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
+            if msg.reqid in lg.reqids:
+                # reconcile did not strip it (e.g. zombie entry adopted
+                # from a peer log): drop it here so the op re-applies
+                t0 = Transaction()
+                self._ensure_coll(t0, self._shard_coll(pool, pg, my_shard))
+                lg.rollback_divergent(t0, msg.oid, served or ZERO)
+                if t0.ops:
+                    if getattr(self.store, "blocking_commit", False):
+                        await asyncio.to_thread(
+                            self.store.queue_transaction, t0)
+                    else:
+                        self.store.queue_transaction(t0)
+            # fall through: apply the vector afresh
         for o in ops:
             if o.op in (OP_OMAP_SETKEYS, OP_OMAP_RMKEYS, OP_OMAP_CLEAR):
                 # EC pools have no omap (reference restriction:
                 # pool_requires_alignment / MODE_EC forbids omap ops)
                 return MOSDOpReply(tid=msg.tid, result=-errno.EOPNOTSUPP, epoch=self.epoch)
 
-        # -- current object state (skipped for a leading WRITE_FULL) ----
+        # -- current object state (skipped for a leading WRITE_FULL
+        # when no snapshots are in play) ----
         exists, cur_size = False, 0
-        if ops[0].op != OP_WRITE_FULL:
+        cur_v = ZERO  # stale-shard write guard base (see _ec_fan_out_write)
+        ss = SnapSet()
+        local_ss_raw = self._getattr_quiet(
+            self._shard_coll(pool, pg, my_shard),
+            ghobject_t(msg.oid, shard=my_shard), SS_ATTR)
+        if ops[0].op != OP_WRITE_FULL or snapc.snaps or local_ss_raw:
             try:
-                cur_size, _attrs, _ = await self._ec_fetch(
-                    pool, pg, acting, msg.oid, ec, want_data=False
-                )
-                exists = True
+                exists, _wo, cur_size, cur_v, ss, _attrs = \
+                    await self._ec_head_state(pool, pg, acting, msg.oid)
             except ECFetchError as e:
-                if e.errno != errno.ENOENT:
-                    return MOSDOpReply(tid=msg.tid, result=-e.errno, epoch=self.epoch)
+                return MOSDOpReply(
+                    tid=msg.tid, result=-e.errno, epoch=self.epoch)
+        else:
+            # whole-object replace: the primary's own shard version is
+            # the guard base; a mismatch on any shard reconciles first
+            cur_v = self._object_version(
+                self._shard_coll(pool, pg, my_shard),
+                ghobject_t(msg.oid, shard=my_shard))
+
+        # make_writeable: clone-on-write under a newer SnapContext
+        clone_snap_arg, clone_snaps_arg = 0, b""
+        if exists and ss.needs_cow(snapc):
+            cl = ss.make_clone(snapc, cur_size)
+            clone_snap_arg = cl.id
+            clone_snaps_arg = encode_snaps(cl.snaps)
+        else:
+            ss.advance_seq(snapc)
 
         # -- fold the vector into (full | edits) + size + attr deltas ---
         full: np.ndarray | None = None
@@ -829,6 +1036,29 @@ class OSDDaemon:
                 attr_sets[USER_XATTR_PREFIX + o.name] = bytes(o.data)
             elif o.op == OP_RMXATTR:
                 attr_rms.append(USER_XATTR_PREFIX + o.name)
+            elif o.op == OP_ROLLBACK:
+                # restore head from the clone serving o.off
+                # (PrimaryLogPG::_rollback_to, EC flavor)
+                target = ss.resolve(o.off)
+                if target is None or (target == NOSNAP and not exists):
+                    return MOSDOpReply(
+                        tid=msg.tid, result=-errno.ENOENT,
+                        epoch=self.epoch)
+                if target == NOSNAP:
+                    continue  # head already serves that snap
+                try:
+                    csz, cattrs, cchunks = await self._ec_fetch(
+                        pool, pg, acting, msg.oid, ec, snap=target)
+                except ECFetchError as e:
+                    return MOSDOpReply(
+                        tid=msg.tid, result=-e.errno, epoch=self.epoch)
+                logical = await self._ecu_decode_concat(sinfo, ec, cchunks)
+                full = np.asarray(logical[:csz], np.uint8)
+                edits, size = [], csz
+                for name, v in (cattrs or {}).items():
+                    if name.startswith(USER_XATTR_PREFIX):
+                        attr_sets[name] = v
+                touched = exists = True
             else:
                 return MOSDOpReply(tid=msg.tid, result=-errno.EOPNOTSUPP, epoch=self.epoch)
 
@@ -838,6 +1068,9 @@ class OSDDaemon:
             VERSION_ATTR: _v_bytes(version),
             **attr_sets,
         }
+        if ss.seq or ss.clones:
+            base_attrs[SS_ATTR] = ss.to_bytes()
+        base_attrs[WHITEOUT_ATTR] = b"0"
 
         # -- xattr-only vector: metadata write, no data churn -----------
         if not touched and full is None and not edits:
@@ -845,7 +1078,8 @@ class OSDDaemon:
                 base_attrs[SIZE_ATTR] = b"0"
             r = await self._ec_fan_out_write(
                 pool, pg, live, msg.oid, {}, base_attrs, version,
-                rmattrs=attr_rms, reqid=msg.reqid,
+                rmattrs=attr_rms, reqid=msg.reqid, prev_version=cur_v,
+                clone_snap=clone_snap_arg, clone_snaps=clone_snaps_arg,
             )
             return MOSDOpReply(tid=msg.tid, result=r, epoch=self.epoch)
 
@@ -873,7 +1107,8 @@ class OSDDaemon:
             r = await self._ec_fan_out_write(
                 pool, pg, live, msg.oid, shards, base_attrs, version,
                 off=0, truncate=new_shard_len, rmattrs=attr_rms,
-                reqid=msg.reqid,
+                reqid=msg.reqid, prev_version=cur_v,
+                clone_snap=clone_snap_arg, clone_snaps=clone_snaps_arg,
             )
             return MOSDOpReply(tid=msg.tid, result=r, epoch=self.epoch)
 
@@ -908,7 +1143,8 @@ class OSDDaemon:
                 rmattrs=attr_rms + (
                     [HINFO_ATTR] if exists and size != cur_size else []
                 ),
-                reqid=msg.reqid,
+                reqid=msg.reqid, prev_version=cur_v,
+                clone_snap=clone_snap_arg, clone_snaps=clone_snaps_arg,
             )
             return MOSDOpReply(tid=msg.tid, result=r, epoch=self.epoch)
         d_lo = min(d[0] for d in dirty)
@@ -942,6 +1178,8 @@ class OSDDaemon:
             off=sinfo.logical_to_prev_chunk_offset(d_lo),
             truncate=new_shard_len,
             rmattrs=attr_rms + [HINFO_ATTR], reqid=msg.reqid,
+            prev_version=cur_v,
+            clone_snap=clone_snap_arg, clone_snaps=clone_snaps_arg,
         )
         return MOSDOpReply(tid=msg.tid, result=r, epoch=self.epoch)
 
@@ -965,6 +1203,7 @@ class OSDDaemon:
         delete=False, version: eversion_t = ZERO,
         off: int = 0, truncate: int | None = None,
         rmattrs: list[str] | None = None, reqid: str = "",
+        clone_snap: int = 0, clone_snaps: bytes = b"",
     ) -> None:
         """Same, but journaling stores fsync: run their commit on a
         worker thread so one OSD's disk flush never stalls the whole
@@ -972,7 +1211,7 @@ class OSDDaemon:
         finisher threads for the same reason)."""
         t = self._shard_write_txn(
             pool, pg, shard, oid, payload, attrs, delete, version,
-            off, truncate, rmattrs, reqid,
+            off, truncate, rmattrs, reqid, clone_snap, clone_snaps,
         )
         if getattr(self.store, "blocking_commit", False):
             await asyncio.to_thread(self.store.queue_transaction, t)
@@ -983,15 +1222,23 @@ class OSDDaemon:
         self, pool, pg, shard, oid, payload, attrs, delete, version,
         off: int = 0, truncate: int | None = None,
         rmattrs: list[str] | None = None, reqid: str = "",
+        clone_snap: int = 0, clone_snaps: bytes = b"",
     ) -> Transaction:
         """``truncate`` semantics: None keeps legacy whole-replace
         (truncate to len(payload)); -1 leaves the length alone (ranged
         RMW writes and metadata-only writes); >= 0 sets the exact shard
-        length after the write (store truncate zero-fills on extend)."""
+        length after the write (store truncate zero-fills on extend).
+        ``clone_snap`` != 0 snapshots the local head shard into
+        (oid, snap=clone_snap) before applying (make_writeable COW)."""
         c = self._shard_coll(pool, pg, shard)
         o = ghobject_t(oid, shard=shard)
         t = Transaction()
         self._ensure_coll(t, c)
+        if clone_snap:
+            cl = ghobject_t(oid, snap=clone_snap, shard=shard)
+            if self.store.exists(c, o) and not self.store.exists(c, cl):
+                t.clone(c, o, cl)
+                t.setattrs(c, cl, {SNAPS_ATTR: clone_snaps})
         if delete:
             if self.store.exists(c, o):
                 t.remove(c, o)
@@ -1019,9 +1266,50 @@ class OSDDaemon:
                 lg.trim(t, self._log_keep)
         return t
 
+    async def _ec_head_state(self, pool, pg, acting, oid):
+        """Probe the EC head object: (exists, whiteout, size, version,
+        SnapSet, attrs).  exists is False for a whiteout head (data-
+        plane absent) but the SnapSet still anchors its clones."""
+        ec = self._ec_for(pool)
+        try:
+            sz, attrs, _ = await self._ec_fetch(
+                pool, pg, acting, oid, ec, want_data=False)
+        except ECFetchError as e:
+            if e.errno != errno.ENOENT:
+                raise  # degraded, not absent: callers surface the errno
+            return False, False, 0, ZERO, SnapSet(), {}
+        ss = SnapSet.from_bytes(attrs.get(SS_ATTR))
+        wo = attrs.get(WHITEOUT_ATTR) == b"1"
+        v = _v_parse(attrs.get(VERSION_ATTR))
+        return (not wo), wo, (0 if wo else sz), v, ss, attrs
+
+    async def _ec_served_version(
+        self, pool, pg, acting, oid, lg=None
+    ) -> "eversion_t | None":
+        """The object version a consistent k-shard subset currently
+        serves (None = nothing decodable right now).  An absent object
+        whose newest log entry is a DELETE counts as served at the
+        delete's version (the write wasn't lost — it was superseded)."""
+        ec = self._ec_for(pool)
+        try:
+            _sz, attrs, _ = await self._ec_fetch(
+                pool, pg, acting, oid, ec, want_data=False)
+        except ECFetchError as e:
+            if e.errno != errno.ENOENT:
+                return None
+            if lg is not None:
+                for v in sorted(lg.entries, reverse=True):
+                    if lg.entries[v].oid == oid:
+                        if lg.entries[v].op == DELETE:
+                            return v
+                        break
+            return ZERO
+        return _v_parse(attrs.get(VERSION_ATTR))
+
     async def _ec_fetch(
         self, pool, pg, acting, oid, ec, *,
         chunk_off: int = 0, chunk_len: int = 0, want_data: bool = True,
+        snap: int = NOSNAP,
     ):
         """Version-consistent EC shard fetch — the ECCommon read
         pipeline (reference src/osd/ECCommon.cc:440-445 fans ECSubRead
@@ -1052,14 +1340,15 @@ class OSDDaemon:
                 reads = (
                     self._read_shard_quiet(
                         pool, pg, s, usable[s], oid,
-                        off=chunk_off, length=chunk_len,
+                        off=chunk_off, length=chunk_len, snap=snap,
                     )
                     for s in need_shards
                 )
             else:
                 reads = (
                     self._read_shard_quiet(
-                        pool, pg, s, usable[s], oid, off=0, length=1
+                        pool, pg, s, usable[s], oid, off=0, length=1,
+                        snap=snap,
                     )
                     for s in need_shards
                 )
@@ -1104,6 +1393,28 @@ class OSDDaemon:
         shard snapshot: ranged reads fetch only the covering stripes
         (objecter-style extent math) and xattrs ride the same attrs."""
         ops = msg.ops
+        try:
+            if any(o.op == OP_LIST_SNAPS for o in ops):
+                _ex, _wo, _sz, _v, ss, _a = await self._ec_head_state(
+                    pool, pg, acting, msg.oid)
+                return MOSDOpReply(
+                    tid=msg.tid, result=0, epoch=self.epoch,
+                    data=ss.to_bytes())
+            read_snap = NOSNAP
+            if msg.snapid != NOSNAP:
+                # find_object_context: route the read at a clone
+                _ex, _wo, _sz, _v, ss, _a = await self._ec_head_state(
+                    pool, pg, acting, msg.oid)
+                target = ss.resolve(msg.snapid)
+                if target is None or (target == NOSNAP and (
+                        msg.snapid <= ss.seq or not _ex)):
+                    return MOSDOpReply(
+                        tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch)
+                if target != NOSNAP:
+                    read_snap = target
+        except ECFetchError as e:
+            return MOSDOpReply(
+                tid=msg.tid, result=-e.errno, epoch=self.epoch)
         reads = [o for o in ops if o.op == OP_READ]
         chunk_off = chunk_len = 0
         if reads:
@@ -1116,10 +1427,13 @@ class OSDDaemon:
             size, attrs, chunks = await self._ec_fetch(
                 pool, pg, acting, msg.oid, ec,
                 chunk_off=chunk_off, chunk_len=chunk_len,
-                want_data=bool(reads),
+                want_data=bool(reads), snap=read_snap,
             )
         except ECFetchError as e:
             return MOSDOpReply(tid=msg.tid, result=-e.errno, epoch=self.epoch)
+        if read_snap == NOSNAP and attrs.get(WHITEOUT_ATTR) == b"1":
+            return MOSDOpReply(
+                tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch)
         logical = None
         base = 0
         if reads and chunks and any(len(v) for v in chunks.values()):
@@ -1161,27 +1475,29 @@ class OSDDaemon:
 
     async def _read_shard_quiet(
         self, pool, pg, shard, osd, oid, *, off: int = 0, length: int = 0,
-        extents: list[tuple[int, int]] | None = None,
+        extents: list[tuple[int, int]] | None = None, snap: int = NOSNAP,
     ):
         """_read_shard with transport failures mapped to EIO."""
         try:
             return await self._read_shard(
                 pool, pg, shard, osd, oid, off=off, length=length,
-                extents=extents,
+                extents=extents, snap=snap,
             )
         except (OSError, asyncio.TimeoutError, ConnectionError):
             return None, None, errno.EIO
 
     async def _read_shard(
         self, pool, pg, shard, osd, oid, *, off: int = 0, length: int = 0,
-        extents: list[tuple[int, int]] | None = None,
+        extents: list[tuple[int, int]] | None = None, snap: int = NOSNAP,
     ):
         """Ranged chunk read of one shard: (payload, attrs, errno).
         ``length == 0`` reads to the shard end.  ``extents`` returns
-        the concatenation of multiple byte runs (sub-chunk repair)."""
+        the concatenation of multiple byte runs (sub-chunk repair).
+        ``snap`` != NOSNAP reads the clone shard object instead."""
         if osd == self.id:
             c = self._shard_coll(pool, pg, shard)
-            o = ghobject_t(oid, shard=shard)
+            o = (ghobject_t(oid, shard=shard) if snap == NOSNAP
+                 else ghobject_t(oid, snap=snap, shard=shard))
             if not self.store.exists(c, o):
                 return None, None, errno.ENOENT
             if extents:
@@ -1197,13 +1513,13 @@ class OSDDaemon:
         rep = await self._sub_op(osd, MOSDECSubOpRead(
             tid=tid, pg=pg, shard=shard, from_osd=self.id, oid=oid,
             off=off, length=length, want_attrs=True, epoch=self.epoch,
-            extents=extents or [],
+            extents=extents or [], snap=snap,
         ), tid)
         if rep.result != 0:
             return None, None, -rep.result
         return rep.data, rep.attrs, 0
 
-    async def _ec_delete(self, pool, pg, acting, msg) -> MOSDOpReply:
+    async def _ec_delete(self, pool, pg, acting, msg, snapc=None) -> MOSDOpReply:
         my_shard = next(
             (s for s, o in enumerate(acting) if o == self.id), None
         )
@@ -1214,6 +1530,50 @@ class OSDDaemon:
         lg = self._pg_log(self._shard_coll(pool, pg, my_shard))
         if msg.reqid and msg.reqid in lg.reqids:
             return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
+        # snapshots: a delete under a newer SnapContext clones first;
+        # if clones anchor to this name, leave a whiteout head (the
+        # snapdir role) instead of removing the shard objects
+        if snapc is not None and (snapc.snaps or self._getattr_quiet(
+                self._shard_coll(pool, pg, my_shard),
+                ghobject_t(msg.oid, shard=my_shard), SS_ATTR)):
+            try:
+                exists, _wo, cur_size, cur_v, ss, _ = \
+                    await self._ec_head_state(pool, pg, acting, msg.oid)
+            except ECFetchError as e:
+                return MOSDOpReply(
+                    tid=msg.tid, result=-e.errno, epoch=self.epoch)
+            if not exists and ss.clones:
+                # already a whiteout (or absent) but clones anchor here:
+                # a second DELETE must not remove the snapdir head
+                return MOSDOpReply(
+                    tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch)
+            clone_snap_arg, clone_snaps_arg = 0, b""
+            if exists and ss.needs_cow(snapc):
+                cl = ss.make_clone(snapc, cur_size)
+                clone_snap_arg = cl.id
+                clone_snaps_arg = encode_snaps(cl.snaps)
+            else:
+                ss.advance_seq(snapc)
+            if ss.clones and exists:
+                lv = self._ec_live(pool, acting)
+                if lv is None:
+                    return MOSDOpReply(
+                        tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
+                live, _ = lv
+                version = self._next_version(
+                    self._shard_coll(pool, pg, my_shard))
+                wo_attrs = {
+                    SIZE_ATTR: b"0",
+                    VERSION_ATTR: _v_bytes(version),
+                    WHITEOUT_ATTR: b"1",
+                    SS_ATTR: ss.to_bytes(),
+                }
+                r = await self._ec_fan_out_write(
+                    pool, pg, live, msg.oid, {}, wo_attrs, version,
+                    truncate=0, reqid=msg.reqid, prev_version=cur_v,
+                    clone_snap=clone_snap_arg, clone_snaps=clone_snaps_arg,
+                )
+                return MOSDOpReply(tid=msg.tid, result=r, epoch=self.epoch)
         version = self._next_version(self._shard_coll(pool, pg, my_shard))
         waits = []
         for shard, osd in enumerate(acting):
@@ -1245,12 +1605,22 @@ class OSDDaemon:
                 c = self._shard_coll(pool, msg.pg, msg.shard)
                 o = ghobject_t(msg.oid, shard=msg.shard)
                 skip = self._object_version(c, o) > msg.guard
-            if not skip:
+            if msg.guarded and not skip:
+                c = self._shard_coll(pool, msg.pg, msg.shard)
+                o = ghobject_t(msg.oid, shard=msg.shard)
+                if self._object_version(c, o) != msg.prev_version:
+                    # this shard missed earlier writes (or holds a
+                    # divergent newer one): recovery must reconcile it
+                    # before it may accept new versions, or a partial
+                    # write would stamp stale data current
+                    result = -errno.ESTALE
+            if not skip and result == 0:
                 await self._apply_shard_write_async(
                     pool, msg.pg, msg.shard, msg.oid, msg.data, msg.attrs,
                     delete=msg.delete, version=msg.version,
                     off=msg.off, truncate=msg.truncate,
                     rmattrs=msg.rmattrs, reqid=msg.reqid,
+                    clone_snap=msg.clone_snap, clone_snaps=msg.clone_snaps,
                 )
         except OSError as e:
             result = -(e.errno or errno.EIO)
@@ -1262,7 +1632,8 @@ class OSDDaemon:
     async def _handle_sub_read(self, msg: MOSDECSubOpRead) -> None:
         pool = self.osdmap.get_pg_pool(msg.pg.pool)
         c = self._shard_coll(pool, msg.pg, msg.shard)
-        o = ghobject_t(msg.oid, shard=msg.shard)
+        o = (ghobject_t(msg.oid, shard=msg.shard) if msg.snap == NOSNAP
+             else ghobject_t(msg.oid, snap=msg.snap, shard=msg.shard))
         if not self.store.exists(c, o):
             rep = MOSDECSubOpReadReply(
                 tid=msg.tid, pg=msg.pg, shard=msg.shard, from_osd=self.id,
@@ -1361,11 +1732,68 @@ class OSDDaemon:
 
     # -- replicated backend -------------------------------------------
 
+    # -- snapshots (make_writeable / find_object_context twins) --------
+
+    def _load_snapset(self, c: coll_t, oid: str) -> SnapSet:
+        try:
+            return SnapSet.from_bytes(
+                self.store.getattr(c, ghobject_t(oid), SS_ATTR))
+        except (KeyError, FileNotFoundError):
+            return SnapSet()
+
+    def _is_whiteout(self, c: coll_t, o: ghobject_t) -> bool:
+        try:
+            return self.store.getattr(c, o, WHITEOUT_ATTR) == b"1"
+        except (KeyError, FileNotFoundError):
+            return False
+
+    @staticmethod
+    def _effective_snapc(pool, msg) -> SnapContext:
+        """Client self-managed context, else the pool-snap context
+        (pg_pool_t::get_snap_context fallback)."""
+        if msg.snaps:
+            return SnapContext(msg.snap_seq, list(msg.snaps))
+        return pool.get_snap_context()
+
+    def _resolve_read_object(
+        self, c: coll_t, oid: str, snapid: int
+    ) -> tuple[ghobject_t, int] | int:
+        """find_object_context: map (oid, snapid) to the store object
+        serving that snap.  Returns (ghobject, errno 0) or an errno."""
+        head = ghobject_t(oid)
+        if snapid == NOSNAP:
+            if not self.store.exists(c, head) or self._is_whiteout(c, head):
+                return errno.ENOENT
+            return head, 0
+        ss = self._load_snapset(c, oid)
+        target = ss.resolve(snapid)
+        if target is None:
+            return errno.ENOENT  # no clone covers it: absent at that snap
+        if target == NOSNAP:
+            # no clone covers it: the head serves the read only if no
+            # write happened since the snap (snapid > seq); otherwise
+            # the snap's content is gone (trimmed or never existed)
+            if snapid <= ss.seq:
+                return errno.ENOENT
+            if not self.store.exists(c, head) or self._is_whiteout(c, head):
+                return errno.ENOENT
+            return head, 0
+        clone = ghobject_t(oid, snap=target)
+        if not self.store.exists(c, clone):
+            return errno.ENOENT
+        return clone, 0
+
     async def _rep_read_vector(self, pool, pg, acting, msg) -> MOSDOpReply:
         c = self._shard_coll(pool, pg, NO_SHARD)
-        o = ghobject_t(msg.oid)
-        if not self.store.exists(c, o):
-            return MOSDOpReply(tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch)
+        if any(o.op == OP_LIST_SNAPS for o in msg.ops):
+            ss = self._load_snapset(c, msg.oid)
+            return MOSDOpReply(
+                tid=msg.tid, result=0, epoch=self.epoch, data=ss.to_bytes())
+        resolved = self._resolve_read_object(c, msg.oid, msg.snapid)
+        if isinstance(resolved, int):
+            return MOSDOpReply(
+                tid=msg.tid, result=-resolved, epoch=self.epoch)
+        o, _ = resolved
         size = self.store.stat(c, o)
         outs: list[tuple[int, bytes, dict[str, bytes]]] = []
         first_read: bytes | None = None
@@ -1410,15 +1838,15 @@ class OSDDaemon:
         )
 
     def _rep_effects(
-        self, c: coll_t, o: ghobject_t, ops
+        self, c: coll_t, o: ghobject_t, ops, ss: SnapSet | None = None
     ) -> tuple[list, int, bool] | int:
         """Resolve a client write vector into a deterministic effect
         vector + final size (the primary's role before MOSDRepOp ships
         the transaction in the reference).  Returns an errno on guard
-        failure."""
+        failure.  ``ss`` (the object's SnapSet) serves ROLLBACK."""
         from ceph_tpu.msg.messages import OSDOp
 
-        exists = self.store.exists(c, o)
+        exists = self.store.exists(c, o) and not self._is_whiteout(c, o)
         size = self.store.stat(c, o) if exists else 0
         effects: list[OSDOp] = []
         outs: list[tuple[int, bytes, dict]] = []
@@ -1480,8 +1908,37 @@ class OSDDaemon:
                 effects.append(OSDOp(OP_OMAP_CLEAR))
                 exists = True
             elif op.op == OP_DELETE:
+                if not exists:
+                    # absent or whiteout head: nothing to delete (a
+                    # second delete must not remove the snapdir anchor)
+                    return errno.ENOENT
                 effects.append(OSDOp(OP_DELETE))
                 exists, size = False, 0
+            elif op.op == OP_ROLLBACK:
+                # CEPH_OSD_OP_ROLLBACK (PrimaryLogPG::_rollback_to):
+                # restore head content from the clone serving op.off
+                target = ss.resolve(op.off) if ss is not None else NOSNAP
+                if target is None:
+                    return errno.ENOENT
+                if target == NOSNAP:
+                    if not exists:
+                        return errno.ENOENT
+                    continue  # head already serves that snap: no-op
+                clone = ghobject_t(o.name, snap=target)
+                if not self.store.exists(c, clone):
+                    return errno.ENOENT
+                data = bytes(self.store.read(c, clone))
+                effects.append(OSDOp(OP_WRITE_FULL, data=data))
+                effects.append(OSDOp(OP_OMAP_CLEAR))
+                kv = self.store.omap_get(c, clone)
+                if kv:
+                    effects.append(OSDOp(OP_OMAP_SETKEYS, kv=kv))
+                for name, v in self.store.getattrs(c, clone).items():
+                    if name.startswith(USER_XATTR_PREFIX):
+                        effects.append(OSDOp(
+                            OP_SETXATTR,
+                            name=name[len(USER_XATTR_PREFIX):], data=v))
+                size, exists = len(data), True
             else:
                 return errno.EOPNOTSUPP
         # an object deleted mid-vector and rewritten afterwards is not a
@@ -1523,6 +1980,14 @@ class OSDDaemon:
                 t.omap_rmkeys(c, o, op.keys)
             elif op.op == OP_OMAP_CLEAR:
                 t.omap_clear(c, o)
+            elif op.op == OP_SNAP_CLONE:
+                # make_writeable COW: snapshot the head into its clone
+                # before the rest of the vector mutates it
+                clone = ghobject_t(oid, snap=op.off)
+                if obj_exists and not self.store.exists(c, clone):
+                    t.clone(c, o, clone)
+                    t.setattrs(c, clone, {SNAPS_ATTR: op.data})
+                continue
             elif op.op == OP_DELETE:
                 if obj_exists:
                     t.remove(c, o)
@@ -1549,15 +2014,42 @@ class OSDDaemon:
         if msg.reqid and msg.reqid in lg.reqids:
             # duplicate of an applied op: answer without re-applying
             return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
-        resolved = self._rep_effects(c, o, msg.ops)
+        # make_writeable: clone-on-write under a newer SnapContext
+        from ceph_tpu.msg.messages import OSDOp
+
+        snapc = self._effective_snapc(pool, msg)
+        if snapc.snaps and not snapc.valid():
+            return MOSDOpReply(tid=msg.tid, result=-errno.EINVAL, epoch=self.epoch)
+        ss = self._load_snapset(c, msg.oid)
+        live_head = self.store.exists(c, o) and not self._is_whiteout(c, o)
+        cow: list[OSDOp] = []
+        if live_head and ss.needs_cow(snapc):
+            clone = ss.make_clone(snapc, self.store.stat(c, o))
+            cow.append(OSDOp(
+                OP_SNAP_CLONE, off=clone.id, data=encode_snaps(clone.snaps)))
+        else:
+            ss.advance_seq(snapc)
+        resolved = self._rep_effects(c, o, msg.ops, ss=ss)
         if isinstance(resolved, int):
             return MOSDOpReply(tid=msg.tid, result=-resolved, epoch=self.epoch)
         effects, size, delete, call_outs = resolved
+        effects = cow + effects
         version = self._next_version(c)
         attrs = {
             SIZE_ATTR: str(size).encode(),
             VERSION_ATTR: _v_bytes(version),
         }
+        if ss.seq or ss.clones:
+            attrs[SS_ATTR] = ss.to_bytes()
+        attrs[WHITEOUT_ATTR] = b"0"
+        if delete and ss.clones:
+            # clones still anchor to this name: leave a whiteout head
+            # (the reference's snapdir object role) instead of removing
+            delete = False
+            size = 0
+            effects.append(OSDOp(OP_CREATE))
+            attrs[SIZE_ATTR] = b"0"
+            attrs[WHITEOUT_ATTR] = b"1"
         t = self._rep_effect_txn(
             pool, pg, msg.oid, effects, attrs, version, delete,
             reqid=msg.reqid,
@@ -1790,12 +2282,27 @@ class OSDDaemon:
 
     async def _reconcile_object(
         self, pool: PgPool, pg: pg_t, pairs: list[tuple[int, int]], oid: str,
-        stray: bool = False,
+        stray: bool = False, have_lock: bool = False,
     ) -> None:
         """Bring one object to its newest version on every acting
         member: replay deletes, remove strays, reconstruct
         stale/missing shards from the members holding the newest
-        version."""
+        version.
+
+        Serializes against client writes via the object lock — probing
+        mid-write would see a partial fan-out and wrongly roll it back
+        (``have_lock`` for callers inside the write path that already
+        hold it)."""
+        if not have_lock:
+            async with self._obj_lock(pool.id, oid):
+                return await self._reconcile_object_locked(
+                    pool, pg, pairs, oid, stray)
+        return await self._reconcile_object_locked(pool, pg, pairs, oid, stray)
+
+    async def _reconcile_object_locked(
+        self, pool: PgPool, pg: pg_t, pairs: list[tuple[int, int]], oid: str,
+        stray: bool = False,
+    ) -> None:
         is_ec = pool.is_erasure()
         my_shard = next(s for s, o in pairs if o == self.id)
         lg = self._pg_log(self._shard_coll(pool, pg, my_shard))
@@ -1864,12 +2371,48 @@ class OSDDaemon:
         ec = self._ec_for(pool)
         sinfo = self._sinfo(ec)
         k = ec.get_data_chunk_count()
+        force_push = False
         if len(sources) < k:
-            log.error(
-                "osd.%d: %s/%s unrecoverable: %d/%d consistent shards",
-                self.id, pg, oid, len(sources), k,
+            # vmax is not reconstructible (a client write died mid
+            # fan-out): ROLL BACK to the newest version at least k
+            # shards agree on, overwriting the partial newer shards —
+            # the reference's divergent-entry rollback (PGLog merge_log)
+            # expressed at shard granularity.  The rolled-back write's
+            # log entries are stripped so a client retry re-applies it.
+            by_v: dict = {}
+            for (s, o), (p, v, _a) in state.items():
+                if p:
+                    by_v.setdefault(v, []).append((s, o))
+            candidates = [v for v, lst in by_v.items() if len(lst) >= k]
+            if not candidates:
+                log.error(
+                    "osd.%d: %s/%s unrecoverable: %d/%d consistent shards",
+                    self.id, pg, oid, len(sources), k,
+                )
+                return
+            v_star = max(candidates)
+            log.warning(
+                "osd.%d: %s/%s rolling back %s -> %s (partial write)",
+                self.id, pg, oid, vmax, v_star,
             )
-            return
+            vmax = v_star
+            sources = dict(by_v[v_star])
+            targets = [
+                (s, o) for (s, o), (p, v, _a) in state.items()
+                if not p or v != v_star
+            ]
+            src_attrs = next(
+                a for (s, o), (p, v, a) in state.items()
+                if p and v == v_star
+            )
+            force_push = True
+            t = Transaction()
+            self._ensure_coll(t, self._shard_coll(pool, pg, my_shard))
+            lg.rollback_divergent(t, oid, v_star)
+            if getattr(self.store, "blocking_commit", False):
+                await asyncio.to_thread(self.store.queue_transaction, t)
+            else:
+                self.store.queue_transaction(t)
         need = {s for s, _ in targets}
         # single-shard repair of a regenerating code: thread
         # minimum_to_decode's (sub-chunk offset, count) runs down to
@@ -1939,7 +2482,8 @@ class OSDDaemon:
             service=self.encode_service,
         )
         await asyncio.gather(*(
-            self._push(pool, pg, s, o, oid, rebuilt[s].tobytes(), src_attrs)
+            self._push(pool, pg, s, o, oid, rebuilt[s].tobytes(), src_attrs,
+                       force=force_push)
             for s, o in targets
         ), return_exceptions=True)  # dead targets retry on the next pass
 
@@ -2039,7 +2583,8 @@ class OSDDaemon:
             return None, None
         return rep.data, rep.attrs
 
-    async def _push(self, pool, pg, shard, osd, oid, payload, attrs) -> None:
+    async def _push(self, pool, pg, shard, osd, oid, payload, attrs,
+                    force: bool = False) -> None:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._push_waiters[(pg, shard, osd)] = fut
         try:
@@ -2047,6 +2592,7 @@ class OSDDaemon:
             await conn.send_message(MOSDPGPush(
                 pg=pg, shard=shard, from_osd=self.id,
                 pushes=[(oid, payload, attrs)], epoch=self.epoch,
+                force=force,
             ))
             await asyncio.wait_for(fut, SUBOP_TIMEOUT)
         finally:
@@ -2216,8 +2762,20 @@ class OSDDaemon:
             o = ghobject_t(oid, shard=msg.shard)
             local_v = self._object_version(c, o)
             pushed_v = _v_parse(attrs.get(VERSION_ATTR))
-            if local_v > pushed_v:
+            if local_v > pushed_v and not msg.force:
                 continue
+            if local_v > pushed_v:
+                # divergent rollback: the newer local write is being
+                # rolled back cluster-wide; strip its log entries so
+                # dup detection stops vouching for it
+                t0 = Transaction()
+                self._pg_log(c).rollback_divergent(t0, oid, pushed_v)
+                if t0.ops:
+                    if getattr(self.store, "blocking_commit", False):
+                        await asyncio.to_thread(
+                            self.store.queue_transaction, t0)
+                    else:
+                        self.store.queue_transaction(t0)
             # a push REPLACES the object: stale local attrs the source
             # doesn't carry (e.g. a hinfo dropped by an RMW this member
             # missed) must go, or deep scrub sees a phantom crc chain

@@ -191,7 +191,8 @@ def decode_crush(dec: Decoder) -> CrushMap:
 # -- pools ------------------------------------------------------------------
 
 def _encode_pool(enc: Encoder, p: PgPool) -> None:
-    with enc.versioned(1, 1):
+    # v2 appends snapshot state (pg_pool_t snap fields)
+    with enc.versioned(2, 1):
         enc.i64(p.id)
         enc.u8(p.type)
         enc.u32(p.size)
@@ -212,10 +213,18 @@ def _encode_pool(enc: Encoder, p: PgPool) -> None:
                 )
             enc.str_(k)
             enc.str_(v)
+        enc.u64(p.snap_seq)
+        enc.u32(len(p.removed_snaps))
+        for s in sorted(p.removed_snaps):
+            enc.u64(s)
+        enc.u32(len(p.pool_snaps))
+        for name in sorted(p.pool_snaps):
+            enc.str_(name)
+            enc.u64(p.pool_snaps[name])
 
 
 def _decode_pool(dec: Decoder) -> PgPool:
-    with dec.versioned():
+    with dec.versioned() as v:
         p = PgPool(
             id=dec.i64(), type=dec.u8(), size=dec.u32(), min_size=dec.u32(),
             crush_rule=dec.i32(), pg_num=dec.u32(), pgp_num=dec.u32(),
@@ -224,6 +233,10 @@ def _decode_pool(dec: Decoder) -> PgPool:
         for _ in range(dec.u32()):
             k = dec.str_()
             p.extra[k] = dec.str_()
+        if v >= 2:
+            p.snap_seq = dec.u64()
+            p.removed_snaps = {dec.u64() for _ in range(dec.u32())}
+            p.pool_snaps = {dec.str_(): dec.u64() for _ in range(dec.u32())}
     return p
 
 

@@ -172,6 +172,25 @@ class PGLog:
             INFO_KEY: self.info.encode(),
         })
 
+    def rollback_divergent(
+        self, t: Transaction, oid: str, to: "eversion_t"
+    ) -> None:
+        """Drop this object's entries newer than ``to`` — the writes
+        they recorded did not survive into the authoritative state
+        (reference PGLog divergent-entry handling in merge_log /
+        _merge_divergent_entries).  Their reqids must stop answering
+        dup detection so a client retry re-applies the op.
+        ``last_update`` is left alone: versions stay monotonic."""
+        drop = [
+            v for v, e in self.entries.items() if e.oid == oid and v > to
+        ]
+        for v in drop:
+            e = self.entries.pop(v)
+            if e.reqid:
+                self.reqids.pop(e.reqid, None)
+            t.touch(self.cid, self.meta)
+            t.omap_rmkeys(self.cid, self.meta, [LOG_KEY_PREFIX + v.key()])
+
     def trim(self, t: Transaction, keep: int) -> None:
         """Drop oldest entries beyond ``keep`` (osd_min_pg_log_entries
         semantics); log_tail advances to the oldest kept version."""

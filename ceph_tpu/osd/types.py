@@ -66,6 +66,14 @@ class PgPool:
     # erasure pools record their profile name; the profile itself lives
     # in the cluster map (OSDMonitor semantics)
     erasure_code_profile: str = ""
+    # snapshot state (pg_pool_t snap_seq / removed_snaps / snaps):
+    # snap_seq is the newest snap id ever allocated in this pool;
+    # removed_snaps feeds the OSD snap trimmer; pool_snaps maps
+    # ``osd pool mksnap`` names to their ids (self-managed snaps don't
+    # appear here)
+    snap_seq: int = 0
+    removed_snaps: set = field(default_factory=set)
+    pool_snaps: dict = field(default_factory=dict)
     # peering_crush_bucket_* / tiering fields intentionally omitted
     # until those subsystems exist.
     extra: dict = field(default_factory=dict)
@@ -102,6 +110,18 @@ class PgPool:
                 )
             )
         return ceph_stable_mod(pg.ps, self.pgp_num, self.pgp_num_mask) + pg.pool
+
+    def get_snap_context(self):
+        """Pool-snap SnapContext (pg_pool_t::get_snap_context): used for
+        writes from clients that did not set a self-managed context."""
+        from ceph_tpu.osd.snaps import SnapContext
+
+        live = sorted(
+            (s for s in self.pool_snaps.values()
+             if s not in self.removed_snaps),
+            reverse=True,
+        )
+        return SnapContext(seq=self.snap_seq if live else 0, snaps=live)
 
     def is_erasure(self) -> bool:
         return self.type == PoolType.ERASURE
