@@ -258,6 +258,31 @@ class RadosClient:
             return -errno.ETIMEDOUT, "command retries exhausted", b""
         return ack.code, ack.rs, ack.data
 
+    async def wait_clean(self, timeout: float = 30.0) -> dict:
+        """Poll the mon until every PG reports active+clean (the
+        qa-helper wait_for_clean contract, reference
+        qa/standalone/ceph-helpers.sh) — via the mon's aggregated pg
+        stats, not by probing OSDs.  Returns the final status blob."""
+        import json as _json
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        last = {}
+        while _time.monotonic() < deadline:
+            code, _rs, data = await self.command({"prefix": "status"})
+            if code == 0:
+                last = _json.loads(data)
+                pgs = last.get("pgs", {})
+                by_state = pgs.get("by_state", {})
+                if (
+                    pgs.get("num_pgs", 0) > 0
+                    and pgs.get("num_reported", 0) >= pgs["num_pgs"]
+                    and set(by_state) == {"active+clean"}
+                ):
+                    return last
+            await asyncio.sleep(0.2)
+        raise TimeoutError(f"cluster not clean after {timeout}s: {last.get('pgs')}")
+
     async def pool_create(
         self, name: str, pg_num: int = 8, pool_type: str = "replicated", **kw
     ) -> int:
@@ -327,8 +352,14 @@ class RadosClient:
             finally:
                 self._op_waiters.pop(op.tid, None)
             if reply.result == -errno.EAGAIN:
-                # peer had a different map; wait for something newer
+                # peer had a different map — or a transiently busy
+                # object (recovery/reconcile in flight).  When the map
+                # is NOT newer the wait returns immediately, so back
+                # off a little or 12 retries burn in milliseconds
+                # while the cluster converges.
                 await self._wait_new_map(min(om.epoch, reply.epoch - 1))
+                if self.osdmap.epoch <= om.epoch:
+                    await asyncio.sleep(min(0.05 * (_try + 1), 0.5))
                 last_err = errno.EAGAIN
                 continue
             return reply

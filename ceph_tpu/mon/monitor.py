@@ -7,9 +7,14 @@ boot/failure/out, serves map subscriptions, and executes admin commands
 the seam OSDMonitor::prepare_new_pool / crush_rule_create_erasure
 drives, OSDMonitor.cc:7339,7466-7523), osd down/out.
 
-Single-monitor for now: the Paxos quorum replicating this state is the
-control-plane milestone (SURVEY.md §7 step 5); the command and map
-semantics here are what Paxos will replicate.
+Every mutation is committed through the Paxos quorum (ceph_tpu/mon/
+paxos.py) before it takes effect, and the MonitorDBStore twin
+(ceph_tpu/mon/store.py) makes the committed state durable; mutating
+commands are leader-only and peons forward (PaxosService semantics).
+The monitor also aggregates the OSDs' per-PG stat reports (beacons
+carry them — the MPGStats/DaemonServer plane) and serves status /
+health / pg stat with real checks (OSD_DOWN, MON_DOWN, PG_DEGRADED;
+reference src/mon/HealthMonitor.cc, src/mon/MgrStatMonitor.cc).
 
 Failure handling: failure reports (MOSDFailure) mark the target down
 immediately (reference grace logic OSDMonitor::check_failure collapses
@@ -431,6 +436,8 @@ class Monitor:
         elif isinstance(msg, MOSDBeacon):
             if self.is_leader:
                 self._last_beacon[msg.osd] = time.monotonic()
+                if msg.pg_stats:
+                    self._ingest_pg_stats(msg.osd, msg.epoch, msg.pg_stats)
             else:
                 await self._forward_to_leader(msg)
         elif isinstance(msg, MOSDFailure):
@@ -612,6 +619,107 @@ class Monitor:
             except ConnectionError:
                 continue  # lost quorum mid-sweep; retry next tick
 
+    def _ingest_pg_stats(self, osd: int, epoch: int, raw: bytes) -> None:
+        """MgrStatMonitor/DaemonServer role: fold one OSD's per-PG
+        report into the cluster pg map (newest epoch wins per pg)."""
+        import json
+        import re
+
+        try:
+            stats = json.loads(raw)
+            if not isinstance(stats, dict):
+                return
+        except ValueError:
+            return
+        book = getattr(self, "_pg_stats", None)
+        if book is None:
+            book = self._pg_stats = {}
+        for pgid, st in stats.items():
+            # shape-check: a version-skewed OSD must not be able to
+            # poison the status plane
+            if not (isinstance(pgid, str) and re.fullmatch(r"\d+\.\d+", pgid)
+                    and isinstance(st, dict)
+                    and isinstance(st.get("state"), str)):
+                continue
+            cur = book.get(pgid)
+            if cur is None or cur.get("epoch", 0) <= epoch:
+                st = dict(st)
+                st["epoch"] = epoch
+                st["primary"] = osd
+                book[pgid] = st
+
+    def _pg_summary(self) -> dict:
+        """Aggregate pg states (the `ceph -s` pgs block)."""
+        book = getattr(self, "_pg_stats", {}) or {}
+        om = self.osdmap
+        expected = sum(p.pg_num for p in om.pools.values())
+        by_state: dict[str, int] = {}
+        objects = 0
+        for pgid, st in book.items():
+            pid = int(pgid.split(".")[0])
+            if pid not in om.pools:
+                continue
+            state = st.get("state", "unknown")
+            # a report from a primary that is now down is STALE until a
+            # new primary reports (reference pg_state stale semantics)
+            if not om.is_up(st.get("primary", -1)):
+                state = "stale"
+            by_state[state] = by_state.get(state, 0) + 1
+            objects += int(st.get("objects", 0))
+        reported = sum(by_state.values())
+        return {
+            "num_pgs": expected,
+            "num_reported": reported,
+            "by_state": by_state,
+            "num_objects": objects,
+        }
+
+    def _health_checks(self, pgsum: dict | None = None) -> dict:
+        """HealthMonitor role (reference src/mon/HealthMonitor.cc +
+        per-map checks): OSD_DOWN, MON_DOWN, PG_DEGRADED."""
+        om = self.osdmap
+        checks: dict[str, dict] = {}
+        # down+IN only: a drained (down+out) osd is not a warning
+        # (HealthMonitor counts num_down_in_osds)
+        down = [
+            o for o in range(om.max_osd)
+            if om.exists(o) and not om.is_up(o) and not om.is_out(o)
+        ]
+        if down:
+            checks["OSD_DOWN"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(down)} osds down",
+                "detail": [f"osd.{o} is down" for o in down],
+            }
+        if self.n_mons > 1:
+            q = sorted(self.paxos.quorum)
+            if len(q) < self.n_mons:
+                missing = [r for r in range(self.n_mons) if r not in q]
+                checks["MON_DOWN"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": (
+                        f"{len(missing)}/{self.n_mons} mons out of quorum"
+                    ),
+                    "detail": [f"mon.{r} out of quorum" for r in missing],
+                }
+        if pgsum is None:
+            pgsum = self._pg_summary()
+        bad = {
+            st: n for st, n in pgsum["by_state"].items()
+            if "degraded" in st or "recovering" in st or "stale" in st
+        }
+        if bad:
+            checks["PG_DEGRADED"] = {
+                "severity": "HEALTH_WARN",
+                "summary": (
+                    f"{sum(bad.values())} pgs not clean: "
+                    + ", ".join(f"{n} {st}" for st, n in sorted(bad.items()))
+                ),
+                "detail": [],
+            }
+        status = "HEALTH_OK" if not checks else "HEALTH_WARN"
+        return {"status": status, "checks": checks}
+
     def _snap_alloc_lock(self, pool_id: int):
         locks = getattr(self, "_snap_locks", None)
         if locks is None:
@@ -635,6 +743,10 @@ class Monitor:
             "osd pool selfmanaged-snap create",
             "osd pool selfmanaged-snap rm",
             "osd pool mksnap", "osd pool rmsnap",
+            # not mutations, but only the leader ingests pg stats and
+            # knows the live quorum: redirect so peons don't serve an
+            # empty status plane
+            "status", "health", "pg stat",
         )
         if mutating and not self.is_leader:
             leader = self.paxos.leader if self.paxos.leader is not None else -1
@@ -745,6 +857,7 @@ class Monitor:
                 return await self._scrub(cmd, deep=prefix == "pg deep-scrub")
             if prefix == "status":
                 om = self.osdmap
+                pgsum = self._pg_summary()
                 up = sum(om.is_up(o) for o in range(om.max_osd))
                 inn = sum(
                     not om.is_out(o) for o in range(om.max_osd) if om.exists(o)
@@ -754,12 +867,23 @@ class Monitor:
                     "num_osds": sum(om.exists(o) for o in range(om.max_osd)),
                     "num_up_osds": up,
                     "num_in_osds": inn,
+                    "quorum": sorted(self.paxos.quorum),
                     "pools": {
                         str(pid): {"name": name, "pg_num": om.pools[pid].pg_num}
                         for name, pid in self._pool_ids.items()
                     },
+                    "pgs": pgsum,
+                    "health": self._health_checks(pgsum),
                 }).encode()
                 return 0, "", data
+            if prefix == "health":
+                h = self._health_checks()
+                return 0, h["status"], json.dumps(h).encode()
+            if prefix == "pg stat":
+                book = getattr(self, "_pg_stats", {}) or {}
+                return 0, "", json.dumps({
+                    "pg_stats": book, "summary": self._pg_summary(),
+                }).encode()
             return -errno.EINVAL, f"unknown command {prefix!r}", b""
         except KeyError as e:
             return -errno.EINVAL, f"missing arg {e}", b""

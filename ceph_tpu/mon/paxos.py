@@ -11,13 +11,15 @@ sequence of numbered values over the quorum:
     begin(pn, v, value) -> peons record the pending value + accept
     commit(v)    -> everyone applies value v
 
-Durability model: paxos state (accepted_pn, the committed ``values``
-log, last_committed) is held in RAM; the committed log is the catch-up
-source for rebooted/partitioned members, so a *majority* restart loses
-any state the hosting monitor has not persisted itself.  Durable mon
-state is the monitor layer's job (persist committed values before
-apply), mirroring the reference's MonitorDBStore split (Paxos.h:174
-writes through MonitorDBStore::Transaction).
+Durability model: this class keeps paxos state (accepted_pn, the
+committed ``values`` log, last_committed) in RAM and the committed log
+is the catch-up source for rebooted/partitioned members; the monitor
+layer persists committed values through MonStore (ceph_tpu/mon/
+store.py — snapshot + committed tail over an ObjectStore) before they
+apply, mirroring the reference's MonitorDBStore split (Paxos.h:174
+writes through MonitorDBStore::Transaction).  A restarted monitor
+replays its MonStore and rejoins; state survives full-quorum restarts
+when members run on durable stores.
 
 Values are opaque blobs; the monitor replicates its *state-mutating
 commands* (osd boot/failure/out, pool create, profile set) and applies

@@ -72,20 +72,25 @@ class MOSDBoot(Message):
 
 
 class MOSDBeacon(Message):
-    """osd -> mon liveness beacon (src/messages/MOSDBeacon.h)."""
+    """osd -> mon liveness beacon (src/messages/MOSDBeacon.h), carrying
+    per-PG stats for the PGs this OSD leads — the MPGStats/DaemonServer
+    reporting plane (reference src/messages/MPGStats.h, src/mgr/
+    DaemonServer.cc) folded onto the beacon cadence."""
 
     TYPE = 97
 
-    def __init__(self, osd: int = 0, epoch: int = 0):
+    def __init__(self, osd: int = 0, epoch: int = 0, pg_stats: bytes = b""):
         self.osd, self.epoch = osd, epoch
+        self.pg_stats = pg_stats  # json: {"pool.ps": {state, objects}}
 
     def encode_payload(self, enc):
         enc.i32(self.osd)
         enc.u32(self.epoch)
+        enc.bytes_(self.pg_stats)
 
     @classmethod
     def decode_payload(cls, dec):
-        return cls(dec.i32(), dec.u32())
+        return cls(dec.i32(), dec.u32(), dec.bytes_())
 
 
 class MOSDFailure(Message):

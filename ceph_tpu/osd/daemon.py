@@ -226,6 +226,12 @@ class OSDDaemon:
         self._watchers: dict[tuple[int, str], dict[tuple, object]] = {}
         self._notify_waiters: dict[tuple, asyncio.Future] = {}
         self._trim_tasks: set = set()
+        self._recovering_pgs: set[tuple[int, int]] = set()
+        # (pool, ps) -> newest epoch whose recovery pass completed for
+        # that pg: a pg is only reported clean once the pass has
+        # verified it under the current map (completeness, not just
+        # map up-ness)
+        self._clean_epoch: dict[tuple[int, int], int] = {}
         self._ec_cache: dict[str, object] = {}
         self._pg_logs: dict[coll_t, PGLog] = {}
         self._beacon_task: asyncio.Task | None = None
@@ -338,11 +344,61 @@ class OSDDaemon:
         while not self.stopping:
             await asyncio.sleep(self.beacon_interval)
             try:
+                stats = b""
+                try:
+                    stats = self._collect_pg_stats()
+                except Exception:
+                    log.exception("osd.%d: pg-stat collection failed", self.id)
                 await self._mon_conn.send_message(
-                    MOSDBeacon(osd=self.id, epoch=self.epoch)
+                    MOSDBeacon(osd=self.id, epoch=self.epoch,
+                               pg_stats=stats)
                 )
             except ConnectionError:
                 continue  # mon died; the rehome task is hunting
+
+    def _collect_pg_stats(self) -> bytes:
+        """Per-PG state for the PGs this OSD leads — the MPGStats
+        report (reference src/mgr/DaemonServer.cc aggregation source).
+        States mirror the reference's pg_state_t vocabulary at the
+        granularity this OSD can see: active+clean, active+degraded
+        (acting set has holes or down members), active+recovering."""
+        import json as _json
+
+        om = self.osdmap
+        if om is None:
+            return b""
+        out = {}
+        for pid, pool in om.pools.items():
+            for ps in range(pool.pg_num):
+                pg = pg_t(pid, ps)
+                _u, _up, acting, primary = om.pg_to_up_acting_osds(
+                    pg, folded=True)
+                if primary != self.id:
+                    continue
+                degraded = any(
+                    o == CRUSH_ITEM_NONE or not om.is_up(o) for o in acting
+                )
+                state = "active"
+                if (pid, ps) in self._recovering_pgs:
+                    state += "+recovering"
+                elif degraded:
+                    state += "+degraded"
+                elif self._clean_epoch.get((pid, ps), -1) < om.epoch:
+                    # the recovery pass has not verified this pg under
+                    # the current map yet: data completeness unknown
+                    state += "+peering"
+                else:
+                    state += "+clean"
+                my_shard = next(
+                    (s for s, o in enumerate(acting) if o == self.id),
+                    None,
+                )
+                n_obj = 0
+                if my_shard is not None:
+                    shard = my_shard if pool.is_erasure() else NO_SHARD
+                    n_obj = len(self._local_objects(pool, pg, shard))
+                out[f"{pid}.{ps}"] = {"state": state, "objects": n_obj}
+        return _json.dumps(out).encode()
 
     @property
     def epoch(self) -> int:
@@ -2134,7 +2190,12 @@ class OSDDaemon:
                         )
                         if primary != self.id:
                             continue
-                        await self._recover_pg(pool, pg, acting)
+                        self._recovering_pgs.add((pid, ps))
+                        try:
+                            await self._recover_pg(pool, pg, acting)
+                            self._clean_epoch[(pid, ps)] = done_epoch
+                        finally:
+                            self._recovering_pgs.discard((pid, ps))
             except asyncio.CancelledError:
                 raise
             except Exception:

@@ -141,8 +141,10 @@ class TestRadosModel:
                     model_run(c, io, rng, 60, oracle),
                     thrasher(c, random.Random(99), 6, min_up),
                 )
-                # settle: recovery converges, then every object checks out
-                await asyncio.sleep(1.5)
+                # settle: wait for all-PGs-active+clean THROUGH the mon
+                # (the wait_for_clean contract), then every object
+                # checks out
+                await c.client.wait_clean(timeout=45)
                 for oid, data in oracle.objects.items():
                     assert await io.read(oid) == bytes(data)
                 # deep scrub every pg: no inconsistencies survive churn

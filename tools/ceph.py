@@ -87,6 +87,10 @@ async def amain(args, extra: list[str]) -> int:
             code, rs, data = await client.command({
                 "prefix": f"pg {extra[0]}", "pgid": extra[1],
             })
+        elif verb == "pg" and extra[:1] == ["stat"]:
+            code, rs, data = await client.command({"prefix": "pg stat"})
+        elif verb == "health":
+            code, rs, data = await client.command({"prefix": "health"})
         else:
             print(f"unknown command: {verb} {' '.join(extra)}", file=sys.stderr)
             return 2
