@@ -13,6 +13,7 @@ reference src/erasure-code/ErasureCode.cc:70-102).
 from __future__ import annotations
 
 from ceph_tpu.crush.types import (
+    RULE_TYPE_MSR_INDEP,
     Bucket,
     BucketAlg,
     CrushMap,
@@ -181,7 +182,7 @@ def create_ec_rule(
     return rid
 
 
-def add_osd_multi_per_domain_rule(
+def add_two_level_indep_rule(
     map_: CrushMap,
     root_id: int,
     failure_domain_type: int,
@@ -190,9 +191,11 @@ def add_osd_multi_per_domain_rule(
     rule_id: int | None = None,
     num_domains: int = 0,
 ) -> int:
-    """CrushWrapper::add_indep_multi_osd_per_failure_domain_rule — the
-    LRC-style two-level indep rule: choose indep <num_domains> domains,
-    then chooseleaf indep <num_per_domain> osds in each."""
+    """Classic (pre-MSR) two-level indep rule: choose indep
+    <num_domains> domains then chooseleaf indep <num_per_domain> osds —
+    kept for LRC layer rules and the reference-pinned golden vectors;
+    EC profiles with crush-osds-per-failure-domain now get the MSR rule
+    (add_osd_multi_per_domain_rule), as the reference does."""
     if rule_id is None:
         rule_id = max(map_.rules.keys(), default=-1) + 1
     map_.rules[rule_id] = Rule(rule_type=rule_type, steps=[
@@ -200,6 +203,34 @@ def add_osd_multi_per_domain_rule(
         RuleStep(RuleOp.TAKE, root_id, 0),
         RuleStep(RuleOp.CHOOSE_INDEP, num_domains, failure_domain_type),
         RuleStep(RuleOp.CHOOSELEAF_INDEP, num_per_domain, 0),
+        RuleStep(RuleOp.EMIT, 0, 0),
+    ])
+    return rule_id
+
+
+def add_osd_multi_per_domain_rule(
+    map_: CrushMap,
+    root_id: int,
+    failure_domain_type: int,
+    num_per_domain: int,
+    rule_type: int | None = None,
+    rule_id: int | None = None,
+    num_domains: int = 0,
+) -> int:
+    """CrushWrapper::add_indep_multi_osd_per_failure_domain_rule
+    (CrushWrapper.cc:2376,2466): an MSR rule — take root; choosemsr
+    <num_domains> <failure-domain>; choosemsr <num_per_domain> osd;
+    emit.  MSR descent retries the whole path on a rejected leaf, so
+    an out OSD can remap to ANOTHER failure domain even with several
+    OSDs per domain (wide EC on small clusters, mapper.c:1633-1720)."""
+    if rule_type is None:
+        rule_type = RULE_TYPE_MSR_INDEP
+    if rule_id is None:
+        rule_id = max(map_.rules.keys(), default=-1) + 1
+    map_.rules[rule_id] = Rule(rule_type=rule_type, steps=[
+        RuleStep(RuleOp.TAKE, root_id, 0),
+        RuleStep(RuleOp.CHOOSE_MSR, num_domains, failure_domain_type),
+        RuleStep(RuleOp.CHOOSE_MSR, num_per_domain, 0),
         RuleStep(RuleOp.EMIT, 0, 0),
     ])
     return rule_id

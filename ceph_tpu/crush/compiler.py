@@ -33,6 +33,8 @@ def decompile(m: CrushMap) -> str:
             "chooseleaf_descend_once": m.tunables.chooseleaf_descend_once,
             "chooseleaf_vary_r": m.tunables.chooseleaf_vary_r,
             "chooseleaf_stable": m.tunables.chooseleaf_stable,
+            "msr_descents": m.tunables.msr_descents,
+            "msr_collision_tries": m.tunables.msr_collision_tries,
         },
         "types": {str(tid): name for tid, name in sorted(m.types.items())},
         "devices": [

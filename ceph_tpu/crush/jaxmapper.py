@@ -558,6 +558,13 @@ class BatchedRuleMapper:
         for s in self.rule.steps:
             if s.op == RuleOp.SET_CHOOSE_LOCAL_FALLBACK_TRIES and s.arg1 > 0:
                 raise UnsupportedMap("rule sets local_fallback_tries")
+            if s.op in (RuleOp.CHOOSE_MSR, RuleOp.SET_MSR_DESCENTS,
+                        RuleOp.SET_MSR_COLLISION_TRIES):
+                # MSR descent retries the whole path on a rejected leaf
+                # with data-dependent backtracking depth — expressed
+                # scalar for now; osd/remap.py transparently routes MSR
+                # rules through the scalar pipeline
+                raise UnsupportedMap("MSR rules take the scalar pipeline")
             if s.op not in (
                 RuleOp.NOOP, RuleOp.TAKE, RuleOp.EMIT,
                 RuleOp.CHOOSE_FIRSTN, RuleOp.CHOOSE_INDEP,

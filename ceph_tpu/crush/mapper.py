@@ -21,6 +21,8 @@ import numpy as np
 
 from ceph_tpu.crush._ln_tables import LL_TBL, RH_LH_TBL
 from ceph_tpu.crush.types import (
+    RULE_TYPE_MSR_FIRSTN,
+    RULE_TYPE_MSR_INDEP,
     CRUSH_ITEM_NONE,
     CRUSH_ITEM_UNDEF,
     Bucket,
@@ -373,6 +375,296 @@ def _choose_indep(
             out2[rep] = CRUSH_ITEM_NONE
 
 
+# ---------------------------------------------------------------------------
+# MSR (multi-step-retry) rules — crush_msr_do_rule (mapper.c:1723-1930)
+# ---------------------------------------------------------------------------
+#
+# msr_firstn / msr_indep rules retry the WHOLE descent when a leaf is
+# rejected, so marking an OSD out can remap to a different failure
+# domain even when the rule places several OSDs per domain (wide EC on
+# small clusters — mapper.c:1633-1720 commentary).  Statement-level
+# transliteration like the classic interpreter above: bit-identical
+# placements are pinned by golden vectors compiled from the reference C
+# (tools/golden/crush_oracle.c).
+
+def _msr_scan_config_steps(rule: Rule) -> tuple[int, int | None, int | None]:
+    """mapper.c:1088 — returns (next stepno, descents, collision_tries)."""
+    descents = tries = None
+    for stepno, step in enumerate(rule.steps):
+        if step.op == RuleOp.SET_MSR_DESCENTS:
+            descents = step.arg1
+        elif step.op == RuleOp.SET_MSR_COLLISION_TRIES:
+            tries = step.arg1
+        else:
+            return stepno, descents, tries
+    return len(rule.steps), descents, tries
+
+
+def _msr_scan_next(
+    rule: Rule, result_max: int, stepno: int
+) -> tuple[int, int] | None:
+    """mapper.c:1139 — (total_children, emit stepno) or None (invalid)."""
+    if stepno + 1 >= len(rule.steps):
+        return None
+    if rule.steps[stepno].op != RuleOp.TAKE:
+        return None
+    stepno += 1
+    total_children = 1
+    while stepno < len(rule.steps):
+        step = rule.steps[stepno]
+        if step.op == RuleOp.EMIT:
+            break
+        if step.op != RuleOp.CHOOSE_MSR:
+            return None
+        total_children *= step.arg1 if step.arg1 else result_max
+        stepno += 1
+    if stepno >= len(rule.steps):
+        return None
+    return total_children, stepno
+
+
+def _msr_retry_value(
+    result_max: int, index: int, tryno: int, local_tryno: int
+) -> int:
+    """mapper.c:1249 crush_msr_get_retry_value."""
+    return (((tryno * result_max) + index) << 16) + local_tryno
+
+
+def _msr_descend(
+    map_: CrushMap, work: _Work, bucket: Bucket, type_: int,
+    x: int, result_max: int, tryno: int, local_tryno: int, index: int,
+    choose_args: dict[int, ChooseArg] | None,
+) -> int | None:
+    """mapper.c:1274 — descend until a device or a bucket of type_.
+
+    Returns None on a map-integrity failure (empty bucket, dangling
+    child id, out-of-range device) — the classic interpreter's bad-item
+    guards (mapper.c reject paths); the caller treats it as a collision
+    and retries."""
+    while True:
+        if bucket.size == 0:
+            return None
+        arg = (choose_args or {}).get(bucket.id)
+        candidate = crush_bucket_choose(
+            bucket, work, x,
+            _msr_retry_value(result_max, index, tryno, local_tryno),
+            arg, index,
+        )
+        if candidate >= 0:
+            if candidate >= map_.max_devices:
+                return None  # dangling device id
+            return candidate
+        nxt = map_.buckets.get(candidate)
+        if nxt is None:
+            return None  # dangling child bucket id
+        bucket = nxt
+        if bucket.type == type_:
+            return bucket.id
+
+
+def _msr_valid_candidate(
+    vec: list[int],
+    exclude_start: int, exclude_end: int,
+    include_start: int, include_end: int,
+    candidate: int,
+) -> bool:
+    """mapper.c:1331 — already-in-stride ok; used by another stride no."""
+    for i in range(exclude_start, exclude_end):
+        if vec[i] == candidate:
+            return include_start <= i < include_end
+    return True
+
+
+def _msr_push_used(
+    vec: list[int], stride_start: int, stride_end: int, candidate: int
+) -> bool:
+    """mapper.c:1388."""
+    for i in range(stride_start, stride_end):
+        if vec[i] == candidate:
+            return False
+        if vec[i] == CRUSH_ITEM_UNDEF:
+            vec[i] = candidate
+            return True
+    raise AssertionError("impossible")
+
+
+def _msr_pop_used(
+    vec: list[int], stride_start: int, stride_end: int, candidate: int
+) -> None:
+    """mapper.c:1425."""
+    for i in range(stride_end - 1, stride_start - 1, -1):
+        if vec[i] != CRUSH_ITEM_UNDEF:
+            assert vec[i] == candidate
+            vec[i] = CRUSH_ITEM_UNDEF
+            return
+    raise AssertionError("impossible")
+
+
+class _MsrOutput:
+    """mapper.c:1067 crush_msr_output."""
+
+    def __init__(self, result_max: int):
+        self.out = [CRUSH_ITEM_NONE] * result_max
+        self.returned_so_far = 0
+
+    def emit(self, rule_type: int, position: int, result: int) -> None:
+        if rule_type == RULE_TYPE_MSR_FIRSTN:
+            self.out[self.returned_so_far] = result
+            self.returned_so_far += 1
+        else:
+            self.out[position] = result
+            self.returned_so_far += 1
+
+
+def _msr_choose(
+    map_: CrushMap, rule: Rule, work: _Work, step_vecs: list[list[int]],
+    output: _MsrOutput, bucket: Bucket, total_descendants: int,
+    start_index: int, end_index: int,
+    current_stepno: int, start_stepno: int, end_stepno: int,
+    tryno: int, x: int, result_max: int, weights: list[int],
+    collision_tries: int, choose_args: dict[int, ChooseArg] | None,
+) -> int:
+    """mapper.c:1507 crush_msr_choose — one descent pass for one
+    CHOOSE_MSR step over its strides."""
+    curstep = rule.steps[current_stepno]
+    assert curstep.op == RuleOp.CHOOSE_MSR
+    num_strides = curstep.arg1 if curstep.arg1 else result_max
+    assert total_descendants % num_strides == 0
+    stride_length = total_descendants // num_strides
+    vec = step_vecs[current_stepno - start_stepno]
+    leaf_vec = step_vecs[end_stepno - start_stepno - 1]
+
+    undo = [CRUSH_ITEM_UNDEF] * num_strides
+    mapped = 0
+    stride_index = 0
+    stride_start = start_index
+    while stride_start < end_index:
+        stride_end = min(stride_start + stride_length, end_index)
+        if all(
+            leaf_vec[i] != CRUSH_ITEM_UNDEF
+            for i in range(stride_start, stride_end)
+        ):
+            stride_start += stride_length
+            stride_index += 1
+            continue
+        found = False
+        candidate = 0
+        for local_tryno in range(collision_tries):
+            candidate = _msr_descend(
+                map_, work, bucket, curstep.arg2, x, result_max,
+                tryno, local_tryno, stride_index, choose_args,
+            )
+            if candidate is None:
+                continue  # map-integrity reject: retry like a collision
+            if _msr_valid_candidate(
+                vec, start_index, end_index,
+                stride_start, stride_end, candidate,
+            ):
+                found = True
+                break
+        if not found:
+            stride_start += stride_length
+            stride_index += 1
+            continue
+        if curstep.arg2 == 0:  # leaf step
+            if stride_length != 1 or current_stepno + 1 != end_stepno:
+                pass  # malformed rule: skip stride
+            elif is_out(map_, weights, candidate, x):
+                pass  # crush_msr_do_rule retries, msr_descents permitting
+            else:
+                pushed = _msr_push_used(
+                    vec, stride_start, stride_end, candidate)
+                assert pushed
+                output.emit(rule.rule_type, stride_start, candidate)
+                mapped += 1
+        else:  # interior step
+            if current_stepno + 1 >= end_stepno or candidate >= 0:
+                pass  # malformed rule / device where an interior type
+                      # was requested: skip the stride
+            else:
+                child_bucket = map_.buckets[candidate]
+                child_mapped = _msr_choose(
+                    map_, rule, work, step_vecs, output, child_bucket,
+                    stride_length, stride_start, stride_end,
+                    current_stepno + 1, start_stepno, end_stepno,
+                    tryno, x, result_max, weights, collision_tries,
+                    choose_args,
+                )
+                pushed = _msr_push_used(
+                    vec, stride_start, stride_end, candidate)
+                if pushed and child_mapped == 0:
+                    undo[stride_index] = candidate
+                else:
+                    mapped += child_mapped
+        stride_start += stride_length
+        stride_index += 1
+
+    stride_index = 0
+    stride_start = start_index
+    while stride_start < end_index:
+        if undo[stride_index] != CRUSH_ITEM_UNDEF:
+            stride_end = min(stride_start + stride_length, end_index)
+            _msr_pop_used(
+                vec, stride_start, stride_end, undo[stride_index])
+        stride_start += stride_length
+        stride_index += 1
+    return mapped
+
+
+def _msr_do_rule(
+    map_: CrushMap, rule: Rule, x: int, result_max: int,
+    weights: list[int], choose_args: dict[int, ChooseArg] | None,
+) -> list[int]:
+    """mapper.c:1809 crush_msr_do_rule."""
+    t = map_.tunables
+    start_stepno, descents, collision_tries = _msr_scan_config_steps(rule)
+    if descents is None:
+        descents = t.msr_descents
+    if collision_tries is None:
+        collision_tries = t.msr_collision_tries
+
+    work = _Work()
+    output = _MsrOutput(result_max)
+    start_index = 0
+    while start_stepno < len(rule.steps):
+        scan = _msr_scan_next(rule, result_max, start_stepno)
+        if scan is None:
+            return []  # invalid rule: "return whatever we have" (= none)
+        total_children, emit_stepno = scan
+        take_step = rule.steps[start_stepno]
+        assert take_step.op == RuleOp.TAKE
+        if take_step.arg1 >= 0:
+            if start_stepno + 1 != emit_stepno:
+                return []
+            output.emit(rule.rule_type, start_index, take_step.arg1)
+        else:
+            root_bucket = map_.buckets[take_step.arg1]
+            start_stepno += 1
+            n_steps = emit_stepno - start_stepno
+            step_vecs = [
+                [CRUSH_ITEM_UNDEF] * result_max for _ in range(n_steps)
+            ]
+            end_index = min(start_index + total_children, result_max)
+            return_limit = output.returned_so_far + (end_index - start_index)
+            tries_so_far = 0
+            while (tries_so_far < descents
+                   and output.returned_so_far < return_limit):
+                _msr_choose(
+                    map_, rule, work, step_vecs, output, root_bucket,
+                    total_children, start_index, end_index,
+                    start_stepno, start_stepno, emit_stepno,
+                    tries_so_far, x, result_max, weights,
+                    collision_tries, choose_args,
+                )
+                tries_so_far += 1
+            start_index = end_index
+        start_stepno = emit_stepno + 1
+
+    if rule.rule_type == RULE_TYPE_MSR_FIRSTN:
+        return output.out[: output.returned_so_far]
+    return output.out
+
+
 def crush_do_rule(
     map_: CrushMap,
     ruleno: int,
@@ -402,6 +694,10 @@ def crush_do_rule(
             w if map_.device_classes.get(osd) == rule.device_class else 0
             for osd, w in enumerate(weights)
         ]
+    if rule.rule_type in (RULE_TYPE_MSR_FIRSTN, RULE_TYPE_MSR_INDEP):
+        return _msr_do_rule(
+            map_, rule, x, result_max, weights, choose_args)
+
     t = map_.tunables
     work = _Work()
 

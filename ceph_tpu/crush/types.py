@@ -40,7 +40,18 @@ class RuleOp(enum.IntEnum):
     SET_CHOOSE_LOCAL_FALLBACK_TRIES = 11
     SET_CHOOSELEAF_VARY_R = 12
     SET_CHOOSELEAF_STABLE = 13
+    SET_MSR_DESCENTS = 14
+    SET_MSR_COLLISION_TRIES = 15
+    CHOOSE_MSR = 16
 
+
+# rule types (crush.h crush_rule_type): 1/3 are the classic
+# replicated/erasure interpreter rules; 4/5 are multi-step-retry rules
+# served by crush_msr_do_rule (mapper.c:1809)
+RULE_TYPE_REPLICATED = 1
+RULE_TYPE_ERASURE = 3
+RULE_TYPE_MSR_FIRSTN = 4
+RULE_TYPE_MSR_INDEP = 5
 
 CRUSH_ITEM_UNDEF = 0x7FFFFFFE  # mid-choose reservation (crush.h)
 CRUSH_ITEM_NONE = 0x7FFFFFFF   # permanent hole, EC positional
@@ -100,6 +111,10 @@ class Tunables:
     chooseleaf_descend_once: int = 1
     chooseleaf_vary_r: int = 1
     chooseleaf_stable: int = 1
+    # MSR rule tunables (crush.h msr_descents/msr_collision_tries;
+    # defaults CrushWrapper::set_default_msr_tunables)
+    msr_descents: int = 100
+    msr_collision_tries: int = 100
 
 
 @dataclass

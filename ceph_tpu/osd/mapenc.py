@@ -72,7 +72,8 @@ def _dec_choose_args(dec: Decoder) -> dict[int, ChooseArg]:
 # -- crush ------------------------------------------------------------------
 
 def encode_crush(enc: Encoder, m: CrushMap) -> None:
-    with enc.versioned(1, 1):
+    # v2 appends the MSR tunables (crush.h msr_descents/collision_tries)
+    with enc.versioned(2, 1):
         enc.u32(m.max_devices)
         enc.u32(len(m.buckets))
         for bid in sorted(m.buckets):
@@ -116,6 +117,7 @@ def encode_crush(enc: Encoder, m: CrushMap) -> None:
             t.choose_local_tries, t.choose_local_fallback_tries,
             t.choose_total_tries, t.chooseleaf_descend_once,
             t.chooseleaf_vary_r, t.chooseleaf_stable,
+            t.msr_descents, t.msr_collision_tries,
         ):
             enc.u32(v)
         _enc_choose_args(enc, m.choose_args)
@@ -135,7 +137,7 @@ def encode_crush(enc: Encoder, m: CrushMap) -> None:
 
 def decode_crush(dec: Decoder) -> CrushMap:
     m = CrushMap(types={})
-    with dec.versioned():
+    with dec.versioned() as _crush_v:
         m.max_devices = dec.u32()
         for _ in range(dec.u32()):
             bid = dec.i32()
@@ -175,6 +177,9 @@ def decode_crush(dec: Decoder) -> CrushMap:
             chooseleaf_vary_r=dec.u32(),
             chooseleaf_stable=dec.u32(),
         )
+        if _crush_v >= 2:
+            m.tunables.msr_descents = dec.u32()
+            m.tunables.msr_collision_tries = dec.u32()
         m.choose_args = _dec_choose_args(dec)
         for _ in range(dec.u32()):
             name = dec.str_()

@@ -91,10 +91,26 @@ class TestBatchedRules:
 
     def test_two_step_lrc_rule(self, deep_map):
         m, root = deep_map
-        B.add_osd_multi_per_domain_rule(
+        B.add_two_level_indep_rule(
             m, root.id, 3, num_per_domain=2, num_domains=4, rule_id=13
         )
         assert_rule_matches(m, 13, 8, XS)
+
+    def test_msr_rule_scalar_fallback(self, deep_map):
+        """MSR rules are served by the scalar pipeline: the batched
+        compiler refuses them (UnsupportedMap) and the cluster remap
+        engine falls back transparently (osd/remap.py)."""
+        import pytest as _pytest
+
+        from ceph_tpu.crush import jaxmapper as J
+
+        m, root = deep_map
+        B.add_osd_multi_per_domain_rule(
+            m, root.id, 3, num_per_domain=2, num_domains=4, rule_id=21
+        )
+        cc = J.compile_map(m)
+        with _pytest.raises(J.UnsupportedMap):
+            J.BatchedRuleMapper(cc, 21, 8)
 
     def test_choose_firstn_osd_direct(self, deep_map):
         m, root = deep_map

@@ -300,3 +300,45 @@ class TestChurn:
 
 if __name__ == "__main__":
     raise SystemExit(pytest.main([__file__, "-q"]))
+
+
+class TestMsrPools:
+    def test_ec_pool_on_msr_rule_maps_positionally(self):
+        """An EC pool whose profile sets crush-osds-per-failure-domain
+        gets an MSR rule (reference ErasureCode::create_rule ->
+        add_indep_multi_osd_per_failure_domain_rule) and the mapping
+        pipeline serves it: full-size positional sets, <= osds-per-
+        domain OSDs from any single failure domain."""
+        from ceph_tpu.crush import builder as B
+        from ceph_tpu.crush.types import CrushMap
+        from ceph_tpu.osd.osdmap import OSDMap
+        from ceph_tpu.osd.types import PgPool, PoolType, pg_t
+
+        crush = CrushMap()
+        B.build_hierarchy(crush, osds_per_host=4, n_hosts=4)
+        om = OSDMap(crush=crush)
+        for o in range(16):
+            om.new_osd(o, weight=0x10000, up=True)
+        rid = B.create_ec_rule(
+            crush, "msr86", failure_domain="host",
+            num_failure_domains=4, osds_per_failure_domain=3,
+        )
+        om.pools[1] = PgPool(
+            id=1, type=PoolType.ERASURE, size=12, min_size=8,
+            crush_rule=rid, pg_num=32, pgp_num=32,
+        )
+        host_of = {}
+        for b in crush.buckets.values():
+            if b.type == 1:
+                for o in b.items:
+                    if o >= 0:
+                        host_of[o] = b.id
+        for ps in range(32):
+            up, _, acting, primary = om.pg_to_up_acting_osds(pg_t(1, ps))
+            assert len(acting) == 12
+            assert all(o >= 0 for o in acting), acting
+            assert len(set(acting)) == 12
+            per_host: dict = {}
+            for o in acting:
+                per_host[host_of[o]] = per_host.get(host_of[o], 0) + 1
+            assert max(per_host.values()) <= 3, per_host

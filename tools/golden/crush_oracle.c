@@ -21,6 +21,9 @@ static void set_jewel_tunables(struct crush_map *m) {
     m->chooseleaf_descend_once = 1;
     m->chooseleaf_vary_r = 1;
     m->chooseleaf_stable = 1;
+    /* CrushWrapper::set_default_msr_tunables (crush_create leaves 0) */
+    m->msr_descents = 100;
+    m->msr_collision_tries = 100;
 }
 
 /* root -> n_hosts hosts -> osds_per_host osds, all weight 1.0 */
@@ -136,6 +139,44 @@ int main(void) {
             weight[3] = 0; weight[7] = 0x8000;
             snprintf(label, sizeof label, "%s_two_level_degraded", algs[a].name);
             run(m, r4, 64, 6, weight, 20, label, 0);
+        }
+        /* MSR rules (crush_msr_do_rule, mapper.c:1809): take root,
+         * choosemsr N host, choosemsr K osd, emit -- the wide-EC
+         * multi-osd-per-failure-domain shape
+         * (CrushWrapper::add_indep_multi_osd_per_failure_domain_rule) */
+        {
+            for (int i = 0; i < 20; i++) weight[i] = 0x10000;
+            struct crush_rule *r = crush_make_rule(4, 5 /*MSR_INDEP*/);
+            crush_rule_set_step(r, 0, CRUSH_RULE_TAKE, root, 0);
+            crush_rule_set_step(r, 1, CRUSH_RULE_CHOOSE_MSR, 4, 1);
+            crush_rule_set_step(r, 2, CRUSH_RULE_CHOOSE_MSR, 2, 0);
+            crush_rule_set_step(r, 3, CRUSH_RULE_EMIT, 0, 0);
+            int r5 = crush_add_rule(m, r, -1);
+            snprintf(label, sizeof label, "%s_msr_indep", algs[a].name);
+            run(m, r5, 64, 8, weight, 20, label, 0);
+            weight[3] = 0; weight[7] = 0x8000; weight[12] = 0;
+            snprintf(label, sizeof label, "%s_msr_indep_degraded",
+                     algs[a].name);
+            run(m, r5, 64, 8, weight, 20, label, 0);
+
+            /* firstn flavor + choosemsr 0 (result_max domains) + config
+             * steps overriding the tries */
+            struct crush_rule *rf = crush_make_rule(6, 4 /*MSR_FIRSTN*/);
+            crush_rule_set_step(rf, 0, CRUSH_RULE_SET_MSR_DESCENTS, 8, 0);
+            crush_rule_set_step(rf, 1, CRUSH_RULE_SET_MSR_COLLISION_TRIES,
+                                16, 0);
+            crush_rule_set_step(rf, 2, CRUSH_RULE_TAKE, root, 0);
+            crush_rule_set_step(rf, 3, CRUSH_RULE_CHOOSE_MSR, 0, 1);
+            crush_rule_set_step(rf, 4, CRUSH_RULE_CHOOSE_MSR, 1, 0);
+            crush_rule_set_step(rf, 5, CRUSH_RULE_EMIT, 0, 0);
+            int r6 = crush_add_rule(m, rf, -1);
+            for (int i = 0; i < 20; i++) weight[i] = 0x10000;
+            snprintf(label, sizeof label, "%s_msr_firstn", algs[a].name);
+            run(m, r6, 64, 3, weight, 20, label, 0);
+            weight[0] = 0; weight[4] = 0; weight[8] = 0; weight[9] = 0;
+            snprintf(label, sizeof label, "%s_msr_firstn_degraded",
+                     algs[a].name);
+            run(m, r6, 64, 3, weight, 20, label, 0);
         }
         crush_destroy(m);
     }
