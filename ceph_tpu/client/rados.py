@@ -59,7 +59,10 @@ from ceph_tpu.osd.osdmap import OSDMap
 log = logging.getLogger("ceph_tpu.client")
 
 OP_TIMEOUT = 30.0
-MAX_RETRIES = 12
+# the reference Objecter resends indefinitely as maps advance; bounded
+# here but generous — under heavy co-tenant CPU contention a recovering
+# cluster can legitimately answer EAGAIN for a while
+MAX_RETRIES = 25
 
 
 class RadosError(OSError):

@@ -100,6 +100,19 @@ declare(
            see_also=("osd_max_pg_log_entries",)),
     Option("osd_recovery_max_active", int, 4, LEVEL_ADVANCED,
            "concurrent recovery reconciliations per osd", min=1),
+    Option("osd_scrub_interval", float, 86400.0, LEVEL_ADVANCED,
+           "seconds between scheduled shallow scrubs per PG (0 "
+           "disables background scrub; reference osd_scrub_min_interval "
+           "role)", min=0.0),
+    Option("osd_deep_scrub_interval", float, 7 * 86400.0, LEVEL_ADVANCED,
+           "seconds between scheduled deep scrubs per PG (reference "
+           "osd_deep_scrub_interval)", min=0.0),
+    Option("osd_scrub_chunk_max", int, 25, LEVEL_ADVANCED,
+           "objects verified per scrub chunk before yielding to client "
+           "I/O (reference osd_scrub_chunk_max)", min=1),
+    Option("osd_scrub_sleep", float, 0.0, LEVEL_ADVANCED,
+           "pause between scrub chunks (reference osd_scrub_sleep)",
+           min=0.0),
     Option("osd_erasure_code_plugins", str, "jax jerasure isa clay shec lrc",
            LEVEL_ADVANCED, "plugins preloaded at osd start"),
     Option("ms_inject_socket_failures", int, 0, LEVEL_DEV,

@@ -853,8 +853,10 @@ class Monitor:
                 return 0, f"{len(items)} upmap items installed", json.dumps(
                     {"swaps": len(items)}
                 ).encode()
-            if prefix in ("pg scrub", "pg deep-scrub"):
-                return await self._scrub(cmd, deep=prefix == "pg deep-scrub")
+            if prefix in ("pg scrub", "pg deep-scrub", "pg repair"):
+                return await self._scrub(
+                    cmd, deep=prefix != "pg scrub",
+                    repair=prefix == "pg repair")
             if prefix == "status":
                 om = self.osdmap
                 pgsum = self._pg_summary()
@@ -891,7 +893,8 @@ class Monitor:
             eno = getattr(e, "errno", None) or errno.EINVAL
             return -eno, str(e) or type(e).__name__, b""
 
-    async def _scrub(self, cmd: dict[str, str], deep: bool) -> tuple[int, str, bytes]:
+    async def _scrub(self, cmd: dict[str, str], deep: bool,
+                     repair: bool = False) -> tuple[int, str, bytes]:
         """Forward a scrub request to the PG's primary and return its
         report (OSDMonitor scrub command -> MOSDScrub to the OSD)."""
         import errno
@@ -917,7 +920,8 @@ class Monitor:
         self._scrub_waiters[tid] = fut
         try:
             await conn.send_message(
-                MOSDScrub(tid=tid, pool=pool_id, ps=ps, deep=deep)
+                MOSDScrub(tid=tid, pool=pool_id, ps=ps, deep=deep,
+                          repair=repair)
             )
             # shorter than the client command timeout (30s): a slow
             # scrub returns an error here instead of the client

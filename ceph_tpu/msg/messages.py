@@ -987,22 +987,26 @@ class MOSDPing(Message):
 
 class MOSDScrub(Message):
     """mon -> primary OSD: scrub one PG (deep compares payload crcs vs
-    the HashInfo chains)."""
+    the HashInfo chains; repair reconstructs bad shards afterwards —
+    the `ceph pg repair` verb)."""
 
     TYPE = 118
 
-    def __init__(self, tid: int = 0, pool: int = 0, ps: int = 0, deep: bool = False):
+    def __init__(self, tid: int = 0, pool: int = 0, ps: int = 0,
+                 deep: bool = False, repair: bool = False):
         self.tid, self.pool, self.ps, self.deep = tid, pool, ps, deep
+        self.repair = repair
 
     def encode_payload(self, enc):
         enc.u64(self.tid)
         enc.i64(self.pool)
         enc.u32(self.ps)
         enc.bool_(self.deep)
+        enc.bool_(self.repair)
 
     @classmethod
     def decode_payload(cls, dec):
-        return cls(dec.u64(), dec.i64(), dec.u32(), dec.bool_())
+        return cls(dec.u64(), dec.i64(), dec.u32(), dec.bool_(), dec.bool_())
 
 
 class MOSDScrubReply(Message):

@@ -1,0 +1,138 @@
+"""Background scrub scheduling + pg repair (VERDICT r2 #7; reference
+src/osd/scrubber/osd_scrub_sched.cc periodic chunked scrubs and
+scrub_backend authoritative-copy repair)."""
+
+import asyncio
+import json
+
+import numpy as np
+
+from ceph_tpu.store import coll_t, ghobject_t
+from tests.integration.test_mini_cluster import Cluster, run
+
+
+def _corrupt_one_shard(c, io, oid):
+    """Flip bytes of one stored EC shard on disk; returns (osd, shard)."""
+    from ceph_tpu.osd.daemon import object_to_pg
+
+    om = c.client.osdmap
+    pool = om.get_pg_pool(io.pool_id)
+    pg = object_to_pg(pool, oid)
+    folded = pool.raw_pg_to_pg(pg)
+    _, _, acting, primary = om.pg_to_up_acting_osds(pg)
+    victim_shard = next(
+        s for s, o in enumerate(acting) if o != primary and o >= 0)
+    osd = c.osds[acting[victim_shard]]
+    cl = coll_t(pool.id, folded.ps, victim_shard)
+    o = ghobject_t(oid, shard=victim_shard)
+    data = bytearray(osd.store.read(cl, o))
+    data[: min(64, len(data))] = b"\xde" * min(64, len(data))
+    from ceph_tpu.store import Transaction
+
+    osd.store.queue_transaction(Transaction().write(cl, o, 0, bytes(data)))
+    return acting[victim_shard], victim_shard, folded
+
+
+class TestScrubRepair:
+    def test_scheduled_scrub_finds_and_repair_fixes(self):
+        """Corrupt a shard on disk: the BACKGROUND deep scrub finds it
+        (no scrub command issued), then `pg repair` reconstructs the
+        shard from parity and a re-scrub is clean."""
+        conf = {
+            "osd_scrub_interval": 0.5,
+            "osd_deep_scrub_interval": 0.5,
+            "osd_scrub_chunk_max": 2,
+        }
+
+        async def go():
+            async with Cluster(n_osds=6, osd_conf=conf) as c:
+                await c.client.ec_profile_set(
+                    "p", {"plugin": "jax", "k": "3", "m": "2",
+                          "crush-failure-domain": "host"})
+                await c.client.pool_create(
+                    "sp", pg_num=4, pool_type="erasure",
+                    erasure_code_profile="p")
+                io = c.client.ioctx("sp")
+                payload = np.random.default_rng(3).integers(
+                    0, 256, 40000, dtype=np.uint8).tobytes()
+                await io.write_full("victim", payload)
+                await c.client.wait_clean(timeout=30)
+
+                bad_osd, bad_shard, folded = _corrupt_one_shard(
+                    c, io, "victim")
+
+                # the scheduled deep scrub must notice without any
+                # command (poll its stamps via a fresh deep-scrub read
+                # of the report through the mon)
+                found = False
+                for _ in range(80):
+                    primary_osd = next(
+                        o for o in c.osds if o is not None
+                        and (io.pool_id, folded.ps) in o._scrub_stamps)
+                    stamps = primary_osd._scrub_stamps[
+                        (io.pool_id, folded.ps)]
+                    if stamps[1] > 0:
+                        found = True
+                        break
+                    await asyncio.sleep(0.25)
+                assert found, "background deep scrub never ran"
+
+                # the damage is visible to a deep scrub...
+                code, _, data = await c.client.command({
+                    "prefix": "pg deep-scrub",
+                    "pgid": f"{io.pool_id}.{folded.ps}"})
+                assert code == 0
+                rep = json.loads(data)
+                kinds = {i["kind"] for i in rep["inconsistencies"]}
+                assert kinds & {"deep-crc", "deep-parity"}, rep
+
+                # ...and `pg repair` reconstructs the shard from parity
+                code, _, data = await c.client.command({
+                    "prefix": "pg repair",
+                    "pgid": f"{io.pool_id}.{folded.ps}"})
+                assert code == 0
+                rep = json.loads(data)
+                assert rep["repaired"] == ["victim"], rep
+                assert rep["inconsistencies"] == [], rep
+
+                # the object reads clean and a fresh deep scrub agrees
+                assert await io.read("victim") == payload
+                code, _, data = await c.client.command({
+                    "prefix": "pg deep-scrub",
+                    "pgid": f"{io.pool_id}.{folded.ps}"})
+                assert json.loads(data)["inconsistencies"] == []
+
+        run(go())
+
+    def test_repair_replicated_majority(self):
+        """Replicated divergence: majority crc wins, minority repaired."""
+        async def go():
+            async with Cluster(n_osds=4) as c:
+                await c.client.pool_create("rp", pg_num=4, size=3)
+                io = c.client.ioctx("rp")
+                await io.write_full("obj", b"good data " * 500)
+                from ceph_tpu.osd.daemon import NO_SHARD, object_to_pg
+
+                om = c.client.osdmap
+                pool = om.get_pg_pool(io.pool_id)
+                pg = object_to_pg(pool, "obj")
+                folded = pool.raw_pg_to_pg(pg)
+                _, _, acting, primary = om.pg_to_up_acting_osds(pg)
+                bad = next(o for o in acting if o != primary)
+                cl = coll_t(pool.id, folded.ps, NO_SHARD)
+                from ceph_tpu.store import Transaction
+
+                c.osds[bad].store.queue_transaction(
+                    Transaction().write(
+                        cl, ghobject_t("obj"), 0, b"EVIL"))
+                code, _, data = await c.client.command({
+                    "prefix": "pg repair",
+                    "pgid": f"{io.pool_id}.{folded.ps}"})
+                assert code == 0
+                rep = json.loads(data)
+                assert rep["inconsistencies"] == [], rep
+                assert bytes(
+                    c.osds[bad].store.read(cl, ghobject_t("obj"))
+                ).startswith(b"good data")
+
+        run(go())

@@ -61,7 +61,7 @@ def bench_jerasure_cpu() -> None:
         best = min(best, (time.perf_counter() - t0) / n)
     _emit(
         "jerasure RS(4,2) 4MiB stripe encode, host CPU reference",
-        data.nbytes / best / 1e9, "GB/s", 1.0,
+        data.nbytes / best / 1e6, "MB/s", 1.0,
     )
 
 
@@ -102,18 +102,46 @@ def bench_decode_tpu() -> None:
     out = decode(sub)
     jax.block_until_ready(out)
     assert np.array_equal(np.asarray(out[0, :4096]), ref), "decode mismatch"
+    del out
 
-    rounds = 8 if on_tpu else 2
-    best = float("inf")
-    for r in range(rounds):
-        t0 = time.perf_counter()
-        out = decode(sub)
+    if not on_tpu:
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out = decode(sub)
+            jax.block_until_ready(out)
+            best = min(best, time.perf_counter() - t0)
+        gbs = (k * S) / best / 1e9
+    else:
+        # one-launch timed loop (PERF_LAB_r03.md: the tunneled chip
+        # pays ~100 ms relay per LAUNCH; fold the loop into one launch
+        # with an aliased carry, exactly like bench.py's encode)
+        from jax import lax
+
+        ITERS, TILE = 32, 262144
+
+        @jax.jit
+        def loop_decode(c, n):
+            acc = jnp.zeros((dbits.shape[0] // 8, c.shape[1]), jnp.uint8)
+
+            def body(i, acc):
+                return rk.gf_bitmatmul_pallas_acc(
+                    dbits, c, acc, jnp.array([i], jnp.int32), tile_s=TILE)
+
+            return lax.fori_loop(0, n, body, acc)
+
+        out = loop_decode(sub, jnp.int32(ITERS))
         jax.block_until_ready(out)
-        _ = np.asarray(out[0, :8])
-        best = min(best, time.perf_counter() - t0)
-        if on_tpu and r < rounds - 1:
-            time.sleep(4.0)
-    gbs = (k * S) / best / 1e9
+        best = float("inf")
+        for r in range(6):
+            t0 = time.perf_counter()
+            out = loop_decode(sub, jnp.int32(ITERS))
+            jax.block_until_ready(out)
+            _ = np.asarray(out[0, :8])
+            best = min(best, time.perf_counter() - t0)
+            if r < 5:
+                time.sleep(3.0)
+        gbs = (k * S * ITERS) / best / 1e9
     _emit(
         "RS(8,3) 1-erasure decode throughput, 1 chip",
         gbs, "GB/s (survivor bytes)", gbs / 40.0,
@@ -341,8 +369,10 @@ def bench_recovery() -> None:
     dt, total = asyncio.run(go())
     # roughly 1/n_osds of each object's shards lived on the victim; the
     # e2e figure is user data re-made available per second
+    n_osds = int(os.environ.get("BENCH_RECOVERY_OSDS", "16"))
     _emit(
-        "e2e EC(8,3) 1-OSD-down recovery (16 OSDs, 32 MiB user data)",
+        f"e2e EC(8,3) 1-OSD-down recovery ({n_osds} OSDs, "
+        f"{total // 2**20} MiB user data)",
         total / dt / 1e6, "MB/s to clean", 1.0,
     )
 

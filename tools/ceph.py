@@ -83,7 +83,7 @@ async def amain(args, extra: list[str]) -> int:
             code, rs, data = await client.command({
                 "prefix": f"osd {extra[0]}", "id": extra[1],
             })
-        elif verb == "pg" and extra[:1] in (["scrub"], ["deep-scrub"]):
+        elif verb == "pg" and extra[:1] in (["scrub"], ["deep-scrub"], ["repair"]):
             code, rs, data = await client.command({
                 "prefix": f"pg {extra[0]}", "pgid": extra[1],
             })
