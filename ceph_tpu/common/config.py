@@ -100,6 +100,10 @@ declare(
            see_also=("osd_max_pg_log_entries",)),
     Option("osd_recovery_max_active", int, 4, LEVEL_ADVANCED,
            "concurrent recovery reconciliations per osd", min=1),
+    Option("osd_ec_extent_cache_bytes", int, 32 * 1024 * 1024, LEVEL_ADVANCED,
+           "primary-side cache of recently written EC stripe ranges so "
+           "hot RMW overwrites skip the shard read (ExtentCache role, "
+           "reference src/osd/ExtentCache.h; 0 disables)", min=0),
     Option("osd_scrub_interval", float, 86400.0, LEVEL_ADVANCED,
            "seconds between scheduled shallow scrubs per PG (0 "
            "disables background scrub; reference osd_scrub_min_interval "
@@ -115,6 +119,16 @@ declare(
            min=0.0),
     Option("osd_erasure_code_plugins", str, "jax jerasure isa clay shec lrc",
            LEVEL_ADVANCED, "plugins preloaded at osd start"),
+    Option("ms_compress_mode", str, "none", LEVEL_ADVANCED,
+           "on-wire compression policy (reference ms_osd_compress_mode: "
+           "none = never, force = negotiate on every connection)",
+           enum=("none", "force")),
+    Option("ms_compress_algorithm", str, "zlib", LEVEL_ADVANCED,
+           "preferred on-wire compression algorithm (reference "
+           "ms_osd_compression_algorithm)"),
+    Option("ms_compress_min_size", int, 1024, LEVEL_ADVANCED,
+           "smallest message eligible for on-wire compression "
+           "(reference ms_osd_compress_min_size)", min=0),
     Option("ms_inject_socket_failures", int, 0, LEVEL_DEV,
            "inject a connection reset every N sent frames (0 = off); "
            "the reference's ms_inject_socket_failures "

@@ -15,11 +15,10 @@ parameters, chunk-size/alignment math (ErasureCodeJerasure.cc:80-103,
   ``packetsize`` rows) — see matrix_base for why that is the same TPU
   kernel.
 
-The bit-matrix techniques with w != 8 (liberation, blaum_roth,
-liber8tion) operate over GF(2^w) words and are provided by the
-``liberation`` technique family once GF(2^w) tables land; they raise
-EINVAL with a clear message for now (the reference's own default
-technique set — reed_sol_van — is fully covered).
+The GF(2^w) minimal-density bit-matrix techniques (liberation,
+blaum_roth, liber8tion) build their (2w, kw) 0/1 matrices in
+ceph_tpu.models.bitmatrices and ride the same packet-row bit-matmul
+machinery as the cauchy family (matrix_base rows_per_chunk=w).
 """
 
 from __future__ import annotations
@@ -206,30 +205,100 @@ class CauchyGood(CauchyBase):
         return cauchy_good_matrix(self.k, self.m)
 
 
+class Liberation(CauchyBase):
+    """technique=liberation (ErasureCodeJerasure.h:192-227): GF(2^w)
+    minimal-density bitmatrix RAID-6; w prime, k <= w, m == 2."""
+
+    DEFAULT_K = "2"
+    DEFAULT_M = "2"
+    DEFAULT_W = "7"
+    technique = "liberation"
+
+    def _parse_technique(self, profile: dict) -> None:
+        # liberation family: any valid w (checked in _bitmatrix), not
+        # just 8 — skip CauchyBase's w==8 pin but keep its packetsize
+        # handling
+        if self.m != 2:
+            raise ECError(
+                errno.EINVAL, f"{self.technique}: m={self.m} must be 2")
+        if self.k > self.w:
+            raise ECError(
+                errno.EINVAL,
+                f"{self.technique}: k={self.k} must be <= w={self.w}")
+        self.packetsize = self.to_int("packetsize", profile, DEFAULT_PACKETSIZE)
+        if self.packetsize % 4:
+            raise ECError(errno.EINVAL, "packetsize must be a multiple of 4")
+        self.per_chunk_alignment = self.to_bool(
+            "jerasure-per-chunk-alignment", profile, "false"
+        )
+
+    def _bitmatrix(self):
+        from ceph_tpu.models.bitmatrices import liberation_bitmatrix
+
+        try:
+            return liberation_bitmatrix(self.k, self.w)
+        except ValueError as e:
+            raise ECError(errno.EINVAL, str(e)) from e
+
+    def _prepare(self) -> None:
+        self.prepare(self._bitmatrix(), rows_per_chunk=self.w)
+
+
+class BlaumRoth(Liberation):
+    """technique=blaum_roth (ErasureCodeJerasure.h:229-238): w+1 prime."""
+
+    technique = "blaum_roth"
+
+    def _bitmatrix(self):
+        from ceph_tpu.models.bitmatrices import blaum_roth_bitmatrix
+
+        try:
+            return blaum_roth_bitmatrix(self.k, self.w)
+        except ValueError as e:
+            raise ECError(errno.EINVAL, str(e)) from e
+
+
+class Liber8tion(Liberation):
+    """technique=liber8tion (ErasureCodeJerasure.h:240-253): w == 8."""
+
+    DEFAULT_W = "8"
+    technique = "liber8tion"
+
+    def _parse_technique(self, profile: dict) -> None:
+        if self.w != 8:
+            raise ECError(
+                errno.EINVAL, f"liber8tion: w={self.w} must be 8")
+        super()._parse_technique(profile)
+
+    def _bitmatrix(self):
+        from ceph_tpu.models.bitmatrices import liber8tion_bitmatrix
+
+        try:
+            return liber8tion_bitmatrix(self.k)
+        except ValueError as e:
+            raise ECError(errno.EINVAL, str(e)) from e
+
+
 TECHNIQUES = {
     "reed_sol_van": ReedSolomonVandermonde,
     "reed_sol_r6_op": ReedSolomonRAID6,
     "cauchy_orig": CauchyOrig,
     "cauchy_good": CauchyGood,
+    "liberation": Liberation,
+    "blaum_roth": BlaumRoth,
+    "liber8tion": Liber8tion,
 }
-
-_UNSUPPORTED = ("liberation", "blaum_roth", "liber8tion")
 
 
 def _make(profile: dict):
     technique = profile.get("technique", "reed_sol_van")
-    if technique in _UNSUPPORTED:
-        raise ECError(
-            errno.EINVAL,
-            f"technique={technique} (GF(2^w) minimal-density bitmatrix family) "
-            "is not yet available in ceph_tpu",
-        )
     cls = TECHNIQUES.get(technique)
     if cls is None:
         raise ECError(
             errno.ENOENT,
             f"technique={technique} is not a valid coding technique. Choose one of "
-            "reed_sol_van, reed_sol_r6_op, cauchy_orig, cauchy_good",
+            "reed_sol_van, reed_sol_r6_op, cauchy_orig, cauchy_good, "
+            "liberation, blaum_roth, liber8tion",
         )
     profile.setdefault("technique", technique)
     return cls()

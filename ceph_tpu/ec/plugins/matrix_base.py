@@ -53,6 +53,11 @@ class MatrixErasureCode(ErasureCode):
     expansion with ``rows_per_chunk=w`` (packet codes).
     """
 
+    #: True when ANY k chunks decode the object (MDS property) —
+    #: consumers like the fast_read path rely on it; locally-repairable
+    #: and shingled codes override to False
+    mds_any_k = True
+
     def __init__(self) -> None:
         super().__init__()
         self.k = 0

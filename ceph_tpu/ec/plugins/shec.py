@@ -44,6 +44,9 @@ TABLE_CACHE_SIZE = 256
 
 
 class ErasureCodeShec(MatrixErasureCode):
+    # shingled local parities: not every k-subset decodes
+    mds_any_k = False
+
     """Reed-Solomon-Vandermonde shingled code (the reference's only
     SHEC family, ErasureCodeShecReedSolomonVandermonde)."""
 

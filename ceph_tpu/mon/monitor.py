@@ -93,9 +93,17 @@ class Monitor:
         self.n_mons = n_mons
         self.monmap: list[tuple[str, int]] = []
         self.osdmap = OSDMap(crush=crush or CrushMap())
+        conf0 = conf
+        if conf0 is None:
+            from ceph_tpu.common import ConfigProxy as _CP
+
+            conf0 = _CP()
         self.messenger = Messenger(
             ("mon", rank), self._dispatch, on_reset=self._on_reset,
             auth=auth,
+            compress_mode=conf0["ms_compress_mode"],
+            compress_algorithm=conf0["ms_compress_algorithm"],
+            compress_min_size=conf0["ms_compress_min_size"],
         )
         self.store = MonStore(store) if store is not None else None
         self.paxos = Paxos(
@@ -963,6 +971,7 @@ class Monitor:
             "size": int(cmd.get("size", "3")),
             "rule": cmd.get("rule", ""),
             "erasure_code_profile": cmd.get("erasure_code_profile", "default"),
+            "fast_read": cmd.get("fast_read", "") in ("1", "true", "yes"),
         })
         pid = self._pool_ids[name]
         return 0, f"pool {name!r} created", json.dumps({"pool_id": pid}).encode()
@@ -1011,6 +1020,10 @@ class Monitor:
                 min_size=max(1, op["size"] - 1), crush_rule=rule,
                 pg_num=op["pg_num"], pgp_num=op["pg_num"],
             )
+        if op.get("fast_read"):
+            # pool fast_read flag (pg_pool_t FLAG_..., ECCommon.cc:531
+            # read-all-decode-first-k)
+            pool.extra["fast_read"] = "1"
         om.pools[pid] = pool
         om.pool_names[pid] = name
         self._pool_ids[name] = pid

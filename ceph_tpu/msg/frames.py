@@ -40,6 +40,12 @@ class Tag:
     KEEPALIVE2_ACK = 15
     ACK = 16
     CLOSE = 18
+    # on-wire compression negotiation (frames_v2.h:60-61; the reference
+    # marks compressed frames via a preamble flag bit — here a distinct
+    # tag carries the same information)
+    COMPRESSION_REQUEST = 21
+    COMPRESSION_DONE = 22
+    MESSAGE_COMPRESSED = 23
 
 
 class FrameError(ConnectionError):

@@ -100,6 +100,8 @@ class Connection:
         self._reader_task: asyncio.Task | None = None
         # msgr2 SECURE mode: set by the auth handshake; None = crc mode
         self.crypto = None
+        # negotiated on-wire compressor (None = uncompressed)
+        self.compressor = None
 
     async def send_message(self, msg: Message) -> None:
         if self._closed:
@@ -118,32 +120,31 @@ class Connection:
         async with self._send_lock:
             self._seq += 1
             segs = encode_message(msg, self.messenger.entity, self._seq)
+            tag = frames.Tag.MESSAGE
+            if (
+                self.compressor is not None
+                and sum(len(s) for s in segs)
+                >= self.messenger.compress_min_size
+            ):
+                segs = [self.compressor.compress(s) for s in segs]
+                tag = frames.Tag.MESSAGE_COMPRESSED
             await frames.write_frame(
-                self.writer, frames.Tag.MESSAGE, segs, crypto=self.crypto
+                self.writer, tag, segs, crypto=self.crypto
             )
 
     async def _run(self) -> None:
         try:
+            # frames that arrived interleaved with the connect-side
+            # negotiation (see Messenger.connect) are handled first,
+            # in arrival order
+            for tag, segs in getattr(self, "_preread", ()):  # noqa: B020
+                await self._handle_frame(tag, segs)
+            self._preread = ()
             while not self._closed:
                 tag, segs = await frames.read_frame(
                     self.reader, crypto=self.crypto
                 )
-                if getattr(self, "_needs_auth_proof", False):
-                    # first frame decrypted+authenticated: the peer
-                    # holds the session key; NOW adopt it for routing
-                    self._needs_auth_proof = False
-                    await self.messenger._register(self)
-                if tag == frames.Tag.MESSAGE:
-                    msg = decode_message(segs)
-                    msg.conn = self
-                    await self.messenger._dispatch(msg)
-                elif tag == frames.Tag.KEEPALIVE2:
-                    await frames.write_frame(
-                        self.writer, frames.Tag.KEEPALIVE2_ACK, segs,
-                        crypto=self.crypto,
-                    )
-                elif tag == frames.Tag.CLOSE:
-                    break
+                await self._handle_frame(tag, segs)
         except (
             asyncio.IncompleteReadError, ConnectionError, OSError
         ) as e:
@@ -153,6 +154,49 @@ class Connection:
             pass  # cancelled by local close(); nothing to notify
         finally:
             await self.close(notify=True)
+
+    async def _handle_frame(self, tag: int, segs: list) -> None:
+        if getattr(self, "_needs_auth_proof", False):
+            # first frame decrypted+authenticated: the peer
+            # holds the session key; NOW adopt it for routing
+            self._needs_auth_proof = False
+            await self.messenger._register(self)
+        if tag in (frames.Tag.MESSAGE,
+                   frames.Tag.MESSAGE_COMPRESSED):
+            if tag == frames.Tag.MESSAGE_COMPRESSED:
+                if self.compressor is None:
+                    raise frames.FrameError(
+                        "compressed frame on an unnegotiated "
+                        "connection")
+                segs = [
+                    self.compressor.decompress(s) for s in segs
+                ]
+            msg = decode_message(segs)
+            msg.conn = self
+            await self.messenger._dispatch(msg)
+        elif tag == frames.Tag.COMPRESSION_REQUEST:
+            # inbound negotiation (compression_onwire.cc server
+            # role): pick the first of the peer's algorithms we
+            # have; empty reply = stay uncompressed
+            from ceph_tpu import compressor as _comp
+
+            offered = segs[0].decode().split(",") if segs[0] else []
+            picked = next(
+                (a for a in offered
+                 if a != "none" and a in _comp.available()), "")
+            await frames.write_frame(
+                self.writer, frames.Tag.COMPRESSION_DONE,
+                [picked.encode()], crypto=self.crypto,
+            )
+            if picked:
+                self.compressor = _comp.create(picked)
+        elif tag == frames.Tag.KEEPALIVE2:
+            await frames.write_frame(
+                self.writer, frames.Tag.KEEPALIVE2_ACK, segs,
+                crypto=self.crypto,
+            )
+        elif tag == frames.Tag.CLOSE:
+            raise ConnectionError("peer closed")
 
     async def close(self, notify: bool = False) -> None:
         if self._closed:
@@ -182,6 +226,9 @@ class Messenger:
         dispatcher: Callable[[Message], Awaitable[None]] | None = None,
         on_reset: Callable[[Connection], Awaitable[None]] | None = None,
         auth=None,
+        compress_mode: str = "none",
+        compress_algorithm: str = "zlib",
+        compress_min_size: int = 1024,
     ):
         self.entity = entity
         self.dispatcher = dispatcher
@@ -189,6 +236,13 @@ class Messenger:
         # AuthContext (ceph_tpu.msg.auth) => cephx handshake + SECURE
         # frames on every connection; None => legacy crc mode
         self.auth = auth
+        # on-wire compression (reference compression_onwire.cc +
+        # compressor_registry.cc): 'force' negotiates on every outbound
+        # connection; inbound always answers requests with the best
+        # mutually available algorithm
+        self.compress_mode = compress_mode
+        self.compress_algorithm = compress_algorithm
+        self.compress_min_size = compress_min_size
         self._server: asyncio.base_events.Server | None = None
         self._conns: dict[tuple[str, int], Connection] = {}  # by entity
         # every live connection needs a strong root: asyncio's
@@ -311,6 +365,38 @@ class Messenger:
         conn.peer = (dec.str_(), dec.i64())
         if self.auth is not None:
             await self._auth_connect(conn)
+        if self.compress_mode == "force":
+            # client-driven negotiation (COMPRESSION_REQUEST before the
+            # reader loop starts; the acceptor answers from its loop)
+            from ceph_tpu import compressor as _comp
+
+            offer = ",".join(
+                [self.compress_algorithm]
+                + [a for a in _comp.available()
+                   if a not in (self.compress_algorithm, "none")]
+            )
+            await frames.write_frame(
+                writer, frames.Tag.COMPRESSION_REQUEST,
+                [offer.encode()], crypto=conn.crypto,
+            )
+            # the acceptor registers us for routing before its reader
+            # loop answers the request, so its own traffic can arrive
+            # interleaved ahead of COMPRESSION_DONE: buffer it (the
+            # reader task drains _preread first)
+            preread = []
+            while True:
+                tag, segs = await frames.read_frame(
+                    reader, crypto=conn.crypto)
+                if tag == frames.Tag.COMPRESSION_DONE:
+                    break
+                preread.append((tag, segs))
+                if len(preread) > 256:
+                    raise frames.FrameError(
+                        "no COMPRESSION_DONE in 256 frames")
+            conn._preread = preread
+            picked = segs[0].decode()
+            if picked:
+                conn.compressor = _comp.create(picked)
         await self._register(conn)
         self._live.add(conn)
         conn._reader_task = asyncio.ensure_future(conn._run())

@@ -190,6 +190,9 @@ class OSDDaemon:
         self.messenger = Messenger(
             ("osd", osd_id), self._dispatch, on_reset=self._on_reset,
             auth=auth,
+            compress_mode=self.conf["ms_compress_mode"],
+            compress_algorithm=self.conf["ms_compress_algorithm"],
+            compress_min_size=self.conf["ms_compress_min_size"],
         )
         self.messenger.inject_socket_failures = self.conf[
             "ms_inject_socket_failures"
@@ -235,6 +238,15 @@ class OSDDaemon:
         # (pool, ps) -> (last shallow stamp, last deep stamp), monotonic
         self._scrub_stamps: dict[tuple[int, int], tuple[float, float]] = {}
         self._scrub_task: asyncio.Task | None = None
+        # primary-side EC stripe cache: (pool, oid) -> (object version,
+        # logical lo, bytes) of the most recent write — hot RMW
+        # overwrites skip the shard read (ExtentCache role, reference
+        # src/osd/ExtentCache.h; entries are version-guarded, so a
+        # primary change or missed write can never serve stale bytes)
+        from collections import OrderedDict as _OD
+
+        self._extent_cache: "dict[tuple[int, str], tuple]" = _OD()
+        self._extent_cache_bytes = 0
         self._ec_cache: dict[str, object] = {}
         self._pg_logs: dict[coll_t, PGLog] = {}
         self._beacon_task: asyncio.Task | None = None
@@ -560,6 +572,35 @@ class OSDDaemon:
                     svc.min_bytes = self.conf["osd_ec_farm_min_bytes"]
                     self._encode_service = svc
         return self._encode_service
+
+    def _extent_cache_get(self, pool_id, oid, version, lo, hi):
+        ent = self._extent_cache.get((pool_id, oid))
+        if ent is None:
+            return None
+        v, elo, arr = ent
+        if v != version or elo > lo or elo + len(arr) < hi:
+            return None
+        self._extent_cache.move_to_end((pool_id, oid))
+        self.perf.inc("ec_extent_cache_hit")
+        return arr[lo - elo : hi - elo]
+
+    def _extent_cache_put(self, pool_id, oid, version, lo, arr) -> None:
+        limit = self.conf["osd_ec_extent_cache_bytes"]
+        if limit <= 0 or len(arr) > limit:
+            return
+        old = self._extent_cache.pop((pool_id, oid), None)
+        if old is not None:
+            self._extent_cache_bytes -= len(old[2])
+        self._extent_cache[(pool_id, oid)] = (version, lo, arr)
+        self._extent_cache_bytes += len(arr)
+        while self._extent_cache_bytes > limit and self._extent_cache:
+            _k, ent = self._extent_cache.popitem(last=False)
+            self._extent_cache_bytes -= len(ent[2])
+
+    def _extent_cache_drop(self, pool_id, oid) -> None:
+        old = self._extent_cache.pop((pool_id, oid), None)
+        if old is not None:
+            self._extent_cache_bytes -= len(old[2])
 
     async def _ecu_encode(self, sinfo, ec, logical):
         """ecutil.encode via the farm (falls back inside)."""
@@ -1171,6 +1212,10 @@ class OSDDaemon:
                 reqid=msg.reqid, prev_version=cur_v,
                 clone_snap=clone_snap_arg, clone_snaps=clone_snaps_arg,
             )
+            if r == 0:
+                self._extent_cache_put(pool.id, msg.oid, version, 0, padded)
+            else:
+                self._extent_cache_drop(pool.id, msg.oid)
             return MOSDOpReply(tid=msg.tid, result=r, epoch=self.epoch)
 
         # -- RMW over the dirty stripe range ----------------------------
@@ -1214,17 +1259,25 @@ class OSDDaemon:
         buf = np.zeros(d_hi - d_lo, np.uint8)
         read_hi = min(d_hi, old_end)
         if exists and d_lo < read_hi:
-            c_lo = sinfo.logical_to_prev_chunk_offset(d_lo)
-            c_len = sinfo.logical_to_prev_chunk_offset(read_hi) - c_lo
-            try:
-                _sz, _a, chunks = await self._ec_fetch(
-                    pool, pg, acting, msg.oid, ec,
-                    chunk_off=c_lo, chunk_len=c_len,
-                )
-            except ECFetchError as e:
-                return MOSDOpReply(tid=msg.tid, result=-e.errno, epoch=self.epoch)
-            old_logical = await self._ecu_decode_concat(sinfo, ec, chunks)
-            buf[: len(old_logical)] = old_logical
+            cached = self._extent_cache_get(
+                pool.id, msg.oid, cur_v, d_lo, read_hi)
+            if cached is not None:
+                # hot stripe: the bytes we last wrote at cur_v ARE the
+                # on-disk content — skip the shard read entirely
+                buf[: read_hi - d_lo] = cached
+            else:
+                c_lo = sinfo.logical_to_prev_chunk_offset(d_lo)
+                c_len = sinfo.logical_to_prev_chunk_offset(read_hi) - c_lo
+                try:
+                    _sz, _a, chunks = await self._ec_fetch(
+                        pool, pg, acting, msg.oid, ec,
+                        chunk_off=c_lo, chunk_len=c_len,
+                        fast_read=pool.fast_read,
+                    )
+                except ECFetchError as e:
+                    return MOSDOpReply(tid=msg.tid, result=-e.errno, epoch=self.epoch)
+                old_logical = await self._ecu_decode_concat(sinfo, ec, chunks)
+                buf[: len(old_logical)] = old_logical
         for off, data in real_edits:
             lo = max(off, d_lo)
             hi = min(off + len(data), d_hi)
@@ -1242,6 +1295,10 @@ class OSDDaemon:
             prev_version=cur_v,
             clone_snap=clone_snap_arg, clone_snaps=clone_snaps_arg,
         )
+        if r == 0:
+            self._extent_cache_put(pool.id, msg.oid, version, d_lo, buf)
+        else:
+            self._extent_cache_drop(pool.id, msg.oid)
         return MOSDOpReply(tid=msg.tid, result=r, epoch=self.epoch)
 
     def _apply_shard_write(
@@ -1367,10 +1424,73 @@ class OSDDaemon:
             return ZERO
         return _v_parse(attrs.get(VERSION_ATTR))
 
+    async def _ec_fetch_fast(
+        self, pool, pg, acting, oid, ec, *,
+        chunk_off: int = 0, chunk_len: int = 0, snap: int = NOSNAP,
+    ):
+        """fast_read flavor (reference ECCommon.cc:531 + the fast_read
+        pool option): fan the ranged read to EVERY available shard at
+        once and complete from the first k version-consistent replies —
+        latency is the fastest k of n shards instead of a fixed-k read
+        plus retry rounds."""
+        import numpy as np
+
+        k = ec.get_data_chunk_count()
+        avail = {
+            shard: osd for shard, osd in enumerate(acting)
+            if osd != CRUSH_ITEM_NONE and self.osdmap.is_up(osd)
+        }
+        if len(avail) < k:
+            raise ECFetchError(errno.EIO)
+        async def read_one(s, o):
+            return s, await self._read_shard_quiet(
+                pool, pg, s, o, oid, off=chunk_off, length=chunk_len,
+                snap=snap,
+            )
+
+        tasks = [
+            asyncio.ensure_future(read_one(s, o)) for s, o in avail.items()
+        ]
+        got: dict[int, tuple] = {}
+        enoent = 0
+        try:
+            for fut in asyncio.as_completed(tasks):
+                shard, (payload, attrs, eno) = await fut
+                if payload is None:
+                    if eno == errno.ENOENT:
+                        enoent += 1
+                    continue
+                got[shard] = (payload, attrs or {})
+                # complete as soon as k shards agree on the newest
+                # version seen so far
+                versions = {
+                    s2: _v_parse(a.get(VERSION_ATTR))
+                    for s2, (_p, a) in got.items()
+                }
+                vmax = max(versions.values())
+                fresh = [s2 for s2, v in versions.items() if v == vmax]
+                if len(fresh) >= k:
+                    self.perf.inc("ec_fast_read")
+                    attrs = got[fresh[0]][1]
+                    chunks = {
+                        s2: np.frombuffer(got[s2][0], np.uint8)
+                        for s2 in fresh[:k]
+                    }
+                    if SIZE_ATTR not in attrs:
+                        raise ECFetchError(errno.ENOENT)
+                    return int(attrs[SIZE_ATTR]), attrs, chunks
+        finally:
+            for t in tasks:
+                if not t.done():
+                    t.cancel()
+        if enoent and enoent == len(tasks) - len(got):
+            raise ECFetchError(errno.ENOENT)
+        raise ECFetchError(errno.EIO)
+
     async def _ec_fetch(
         self, pool, pg, acting, oid, ec, *,
         chunk_off: int = 0, chunk_len: int = 0, want_data: bool = True,
-        snap: int = NOSNAP,
+        snap: int = NOSNAP, fast_read: bool = False,
     ):
         """Version-consistent EC shard fetch — the ECCommon read
         pipeline (reference src/osd/ECCommon.cc:440-445 fans ECSubRead
@@ -1383,6 +1503,24 @@ class OSDDaemon:
         Raises :class:`ECFetchError` with ENOENT for a fully-absent
         object, EIO otherwise.
         """
+        if (
+            fast_read and want_data
+            and getattr(ec, "mds_any_k", False)
+            and ec.get_sub_chunk_count() == 1
+        ):
+            # decode-from-any-k is only sound for MDS codes; non-MDS
+            # plugins (shec/lrc) and sub-chunk codes take the
+            # minimum_to_decode-driven path below
+            try:
+                return await self._ec_fetch_fast(
+                    pool, pg, acting, oid, ec,
+                    chunk_off=chunk_off, chunk_len=chunk_len, snap=snap,
+                )
+            except ECFetchError:
+                raise
+            except Exception:
+                log.exception(
+                    "osd.%d: fast_read fetch failed; normal path", self.id)
         k = ec.get_data_chunk_count()
         avail = {
             shard: osd for shard, osd in enumerate(acting)
@@ -1489,6 +1627,7 @@ class OSDDaemon:
                 pool, pg, acting, msg.oid, ec,
                 chunk_off=chunk_off, chunk_len=chunk_len,
                 want_data=bool(reads), snap=read_snap,
+                fast_read=pool.fast_read,
             )
         except ECFetchError as e:
             return MOSDOpReply(tid=msg.tid, result=-e.errno, epoch=self.epoch)
@@ -1635,6 +1774,7 @@ class OSDDaemon:
                     clone_snap=clone_snap_arg, clone_snaps=clone_snaps_arg,
                 )
                 return MOSDOpReply(tid=msg.tid, result=r, epoch=self.epoch)
+        self._extent_cache_drop(pool.id, msg.oid)
         version = self._next_version(self._shard_coll(pool, pg, my_shard))
         waits = []
         for shard, osd in enumerate(acting):
@@ -2966,8 +3106,14 @@ class OSDDaemon:
                             pg_t(pid, ps), folded=True)
                         if primary != self.id:
                             continue
-                        last, last_deep = self._scrub_stamps.get(
-                            (pid, ps), (0.0, 0.0))
+                        if (pid, ps) not in self._scrub_stamps:
+                            # stamps are in-RAM (the reference persists
+                            # them in pg info): seed at first sight so a
+                            # restart doesn't deep-scrub everything at
+                            # once — first scrub lands one interval out
+                            self._scrub_stamps[(pid, ps)] = (now, now)
+                            continue
+                        last, last_deep = self._scrub_stamps[(pid, ps)]
                         if deep_interval and now - last_deep > deep_interval:
                             due.append((last_deep, pid, ps, True))
                         elif now - last > interval:

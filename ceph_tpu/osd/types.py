@@ -111,6 +111,12 @@ class PgPool:
             )
         return ceph_stable_mod(pg.ps, self.pgp_num, self.pgp_num_mask) + pg.pool
 
+    @property
+    def fast_read(self) -> bool:
+        """Read every available shard and decode from the first k to
+        answer (pool fast_read flag; reference ECCommon.cc:531)."""
+        return self.extra.get("fast_read") == "1"
+
     def get_snap_context(self):
         """Pool-snap SnapContext (pg_pool_t::get_snap_context): used for
         writes from clients that did not set a self-managed context."""

@@ -331,3 +331,27 @@ class TestFaultInjection:
                     assert await io.read(f"o{i}") == bytes([i]) * 3000
 
         run(go())
+
+
+class TestCompressedTransport:
+    def test_cluster_io_with_forced_compression(self):
+        """Whole-cluster I/O with on-wire compression negotiated on
+        every inter-daemon connection (compression_onwire twin)."""
+        conf = {"ms_compress_mode": "force", "ms_compress_min_size": 128}
+
+        async def go():
+            async with Cluster(n_osds=4, osd_conf=conf) as c:
+                await c.client.pool_create("cp", pg_num=4, size=3)
+                io = c.client.ioctx("cp")
+                for oid, data in PAYLOADS.items():
+                    await io.write_full(oid, data)
+                for oid, data in PAYLOADS.items():
+                    assert await io.read(oid) == data
+                # at least one OSD-to-OSD connection actually negotiated
+                assert any(
+                    conn.compressor is not None
+                    for osd in c.osds if osd is not None
+                    for conn in osd.messenger._conns.values()
+                ), "no inter-daemon connection negotiated compression"
+
+        run(go())

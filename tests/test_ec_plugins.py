@@ -26,6 +26,9 @@ CODES = [
     ("jerasure", {"technique": "reed_sol_r6_op", "k": "4", "m": "2"}),
     ("jerasure", {"technique": "cauchy_orig", "k": "3", "m": "2", "packetsize": "8"}),
     ("jerasure", {"technique": "cauchy_good", "k": "4", "m": "2", "packetsize": "8"}),
+    ("jerasure", {"technique": "liberation", "k": "4", "m": "2", "w": "7", "packetsize": "8"}),
+    ("jerasure", {"technique": "blaum_roth", "k": "4", "m": "2", "w": "6", "packetsize": "8"}),
+    ("jerasure", {"technique": "liber8tion", "k": "5", "m": "2", "w": "8", "packetsize": "8"}),
     ("isa", {"technique": "reed_sol_van", "k": "4", "m": "2"}),
     ("isa", {"technique": "cauchy", "k": "8", "m": "3"}),
     ("jax", {"technique": "cauchy", "k": "8", "m": "3"}),
@@ -362,3 +365,58 @@ class TestStripesAPI:
         damaged[:, 1] = 0
         rec = np.asarray(ec.decode_stripes(jnp.asarray(damaged), (1,)))
         np.testing.assert_array_equal(rec[:, 0], full[:, 1])
+
+
+class TestBitmatrixTechniques:
+    """liberation / blaum_roth / liber8tion: GF(2^w) minimal-density
+    bitmatrix RAID-6 (reference ErasureCodeJerasure.h:192-253) —
+    roundtrip through every 1- and 2-erasure pattern."""
+
+    @pytest.mark.parametrize("technique,k,w", [
+        ("liberation", 2, 7), ("liberation", 5, 7), ("liberation", 4, 5),
+        ("blaum_roth", 2, 6), ("blaum_roth", 6, 6), ("blaum_roth", 4, 10),
+        ("liber8tion", 2, 8), ("liber8tion", 6, 8), ("liber8tion", 8, 8),
+    ])
+    def test_roundtrip_all_erasures(self, technique, k, w):
+        import itertools
+
+        ec = registry.factory("jerasure", {
+            "k": str(k), "m": "2", "w": str(w),
+            "technique": technique, "packetsize": "8",
+        })
+        assert ec.get_chunk_count() == k + 2
+        rng = np.random.default_rng(1)
+        size = ec.get_chunk_size(10000) * k
+        data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        encoded = ec.encode(set(range(k + 2)), data)
+        for pattern in itertools.chain(
+            itertools.combinations(range(k + 2), 1),
+            itertools.combinations(range(k + 2), 2),
+        ):
+            avail = {s: c for s, c in encoded.items() if s not in pattern}
+            decoded = ec.decode(set(pattern), avail, len(encoded[0]))
+            for s in pattern:
+                assert np.array_equal(decoded[s], encoded[s]), (
+                    technique, pattern, s)
+
+    def test_parameter_contracts(self):
+        # w must be prime for liberation
+        with pytest.raises(Exception):
+            registry.factory("jerasure", {
+                "k": "2", "m": "2", "w": "6", "technique": "liberation"})
+        # k <= w
+        with pytest.raises(Exception):
+            registry.factory("jerasure", {
+                "k": "6", "m": "2", "w": "5", "technique": "liberation"})
+        # m must be 2
+        with pytest.raises(Exception):
+            registry.factory("jerasure", {
+                "k": "3", "m": "3", "w": "7", "technique": "liberation"})
+        # liber8tion pins w == 8
+        with pytest.raises(Exception):
+            registry.factory("jerasure", {
+                "k": "2", "m": "2", "w": "7", "technique": "liber8tion"})
+        # blaum_roth: w+1 prime (w=6 ok, w=8 not)
+        with pytest.raises(Exception):
+            registry.factory("jerasure", {
+                "k": "2", "m": "2", "w": "8", "technique": "blaum_roth"})

@@ -40,6 +40,10 @@ PROFILES = [
     ("jerasure", {"technique": "cauchy_orig", "k": "3", "m": "2", "packetsize": "8"}),
     ("jerasure", {"technique": "cauchy_good", "k": "4", "m": "2", "packetsize": "8"}),
     ("jerasure", {"technique": "cauchy_good", "k": "8", "m": "3", "packetsize": "32"}),
+    ("jerasure", {"technique": "liberation", "k": "4", "m": "2", "w": "7", "packetsize": "8"}),
+    ("jerasure", {"technique": "liberation", "k": "2", "m": "2", "w": "7", "packetsize": "4"}),
+    ("jerasure", {"technique": "blaum_roth", "k": "4", "m": "2", "w": "6", "packetsize": "8"}),
+    ("jerasure", {"technique": "liber8tion", "k": "6", "m": "2", "w": "8", "packetsize": "8"}),
     ("isa", {"technique": "reed_sol_van", "k": "4", "m": "2"}),
     ("isa", {"technique": "reed_sol_van", "k": "8", "m": "3"}),
     ("isa", {"technique": "cauchy", "k": "8", "m": "3"}),
