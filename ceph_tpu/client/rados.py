@@ -19,6 +19,7 @@ import logging
 import os
 
 from ceph_tpu.msg.messages import (
+    MConfig,
     MMonCommand,
     MMonCommandAck,
     MMonSubscribe,
@@ -180,6 +181,8 @@ class RadosClient:
             fut = self._op_waiters.get(msg.tid)
             if fut and not fut.done():
                 fut.set_result(msg)
+        elif isinstance(msg, MConfig):
+            pass  # clients carry no daemon config to apply (yet)
         elif isinstance(msg, MMonCommandAck):
             fut = self._cmd_waiters.get(msg.tid)
             if fut and not fut.done():
@@ -472,6 +475,15 @@ class IoCtx:
         self.snap_seq: int = 0
         self.snaps: list[int] = []
         self.read_snap: int = NOSNAP
+
+    def dup(self) -> "IoCtx":
+        """An independent handle on the same pool (librados ioctx
+        duplication): snap context and read snap are per-handle, so
+        e.g. each RBD image carries its own."""
+        io = IoCtx(self.client, self.pool_id)
+        io.snap_seq, io.snaps = self.snap_seq, list(self.snaps)
+        io.read_snap = self.read_snap
+        return io
 
     def set_snap_context(self, seq: int, snaps: list[int]) -> None:
         """selfmanaged_snap_set_write_ctx: snaps newest-first."""

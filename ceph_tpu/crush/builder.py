@@ -234,3 +234,28 @@ def add_osd_multi_per_domain_rule(
         RuleStep(RuleOp.EMIT, 0, 0),
     ])
     return rule_id
+
+
+def reweight_item(map_: CrushMap, item: int, weight: int) -> bool:
+    """CrushWrapper::adjust_item_weightf: set an item's CRUSH weight
+    (16.16 fixed) wherever it appears, propagating the delta up through
+    ancestor buckets.  Returns True when the item was found."""
+    found = False
+    for b in map_.buckets.values():
+        for i, it in enumerate(b.items):
+            if it == item:
+                delta = weight - b.item_weights[i]
+                b.item_weights[i] = weight
+                found = True
+                if delta:
+                    _propagate_weight(map_, b.id, delta)
+    return found
+
+
+def _propagate_weight(map_: CrushMap, child: int, delta: int) -> None:
+    for b in map_.buckets.values():
+        for i, it in enumerate(b.items):
+            if it == child:
+                b.item_weights[i] += delta
+                _propagate_weight(map_, b.id, delta)
+                return

@@ -232,11 +232,16 @@ class Liberation(CauchyBase):
             "jerasure-per-chunk-alignment", profile, "false"
         )
 
-    def _bitmatrix(self):
-        from ceph_tpu.models.bitmatrices import liberation_bitmatrix
+    _builder_name = "liberation_bitmatrix"
 
+    def _bitmatrix(self):
+        from ceph_tpu.models import bitmatrices
+
+        build = getattr(bitmatrices, self._builder_name)
+        args = (self.k,) if self._builder_name == "liber8tion_bitmatrix" \
+            else (self.k, self.w)
         try:
-            return liberation_bitmatrix(self.k, self.w)
+            return build(*args)
         except ValueError as e:
             raise ECError(errno.EINVAL, str(e)) from e
 
@@ -248,14 +253,14 @@ class BlaumRoth(Liberation):
     """technique=blaum_roth (ErasureCodeJerasure.h:229-238): w+1 prime."""
 
     technique = "blaum_roth"
+    _builder_name = "blaum_roth_bitmatrix"
 
-    def _bitmatrix(self):
-        from ceph_tpu.models.bitmatrices import blaum_roth_bitmatrix
-
-        try:
-            return blaum_roth_bitmatrix(self.k, self.w)
-        except ValueError as e:
-            raise ECError(errno.EINVAL, str(e)) from e
+    def _parse_technique(self, profile: dict) -> None:
+        super()._parse_technique(profile)
+        if self.w == 7:
+            # firefly back-compat w (w+1 = 8 not prime): the matrix is
+            # NOT MDS, so any-k consumers (fast_read) must not assume it
+            self.mds_any_k = False
 
 
 class Liber8tion(Liberation):
@@ -264,19 +269,13 @@ class Liber8tion(Liberation):
     DEFAULT_W = "8"
     technique = "liber8tion"
 
+    _builder_name = "liber8tion_bitmatrix"
+
     def _parse_technique(self, profile: dict) -> None:
         if self.w != 8:
             raise ECError(
                 errno.EINVAL, f"liber8tion: w={self.w} must be 8")
         super()._parse_technique(profile)
-
-    def _bitmatrix(self):
-        from ceph_tpu.models.bitmatrices import liber8tion_bitmatrix
-
-        try:
-            return liber8tion_bitmatrix(self.k)
-        except ValueError as e:
-            raise ECError(errno.EINVAL, str(e)) from e
 
 
 TECHNIQUES = {

@@ -34,6 +34,7 @@ import time
 from ceph_tpu.crush.types import CrushMap
 from ceph_tpu.ec import registry as ec_registry
 from ceph_tpu.msg.messages import (
+    MConfig,
     MMonCommand,
     MMonCommandAck,
     MMonSubscribe,
@@ -140,6 +141,10 @@ class Monitor:
         # reports older than this are from before the reboot
         self._up_from: dict[int, int] = {}
         self._pool_ids: dict[str, int] = {}
+        # ConfigMonitor database: section ('global', 'osd', 'osd.3',
+        # 'mon', 'client') -> {option: value}; replicated via paxos and
+        # pushed to every subscriber as MConfig
+        self._config_db: dict[str, dict[str, str]] = {}
         self._next_pool = 1
         self._tids = itertools.count(1)
         self._scrub_waiters: dict[int, asyncio.Future] = {}
@@ -223,6 +228,7 @@ class Monitor:
                 str(k): v for k, v in self._osd_incarnation.items()
             },
             "up_from": {str(k): v for k, v in self._up_from.items()},
+            "config_db": self._config_db,
         }))
         return self._state_version, enc.bytes()
 
@@ -242,6 +248,8 @@ class Monitor:
         self._osd_incarnation = {
             int(k): v for k, v in aux["incarnations"].items()
         }
+        self._config_db = dict(aux.get("config_db", {}))
+        self._apply_config_locally()
         self._up_from = {
             int(k): v for k, v in aux.get("up_from", {}).items()
         }
@@ -453,6 +461,9 @@ class Monitor:
         elif isinstance(msg, MMonSubscribe):
             self._subscribers[msg.src] = msg.conn
             await msg.conn.send_message(self._maps_since(msg.start_epoch))
+            secs = self._config_sections_for(msg.src)
+            if secs:
+                await msg.conn.send_message(MConfig(sections=secs))
         elif isinstance(msg, MOSDScrubReply):
             fut = self._scrub_waiters.get(msg.tid)
             if fut and not fut.done():
@@ -564,6 +575,23 @@ class Monitor:
             om.erasure_code_profiles[op["name"]] = dict(op["profile"])
         elif kind == "pool_create":
             self._apply_pool_create(op)
+        elif kind == "config_set":
+            db = self._config_db.setdefault(op["who"], {})
+            db[op["name"]] = op["value"]
+            self._apply_config_locally()
+            await self._push_config()
+            return  # config changes don't mint osdmap epochs
+        elif kind == "config_rm":
+            self._config_db.get(op["who"], {}).pop(op["name"], None)
+            self._apply_config_locally()
+            await self._push_config()
+            return
+        elif kind == "crush_reweight":
+            from ceph_tpu.crush import builder as _builder
+
+            if not _builder.reweight_item(
+                    om.crush, op["item"], op["weight"]):
+                return  # unknown item: no epoch
         elif kind == "snap_alloc":
             pool = om.pools[op["pool"]]
             pool.snap_seq = max(pool.snap_seq, op["snapid"])
@@ -728,6 +756,32 @@ class Monitor:
         status = "HEALTH_OK" if not checks else "HEALTH_WARN"
         return {"status": status, "checks": checks}
 
+    def _config_sections_for(self, who: tuple[str, int]) -> dict:
+        """The sections addressing one entity, in precedence order
+        (global < type < type.id), pre-merged for the receiver."""
+        kind, ident = who
+        out: dict[str, dict[str, str]] = {}
+        for sec in ("global", kind, f"{kind}.{ident}"):
+            if sec in self._config_db:
+                out[sec] = dict(self._config_db[sec])
+        return out
+
+    def _apply_config_locally(self) -> None:
+        for sec in ("global", "mon", f"mon.{self.rank}"):
+            for name, value in self._config_db.get(sec, {}).items():
+                try:
+                    self.conf.set(name, value, source="mon")
+                except (KeyError, ValueError):
+                    pass
+
+    async def _push_config(self) -> None:
+        for peer, conn in list(self._subscribers.items()):
+            secs = self._config_sections_for(peer)
+            try:
+                await conn.send_message(MConfig(sections=secs))
+            except (ConnectionError, OSError):
+                self._subscribers.pop(peer, None)
+
     def _snap_alloc_lock(self, pool_id: int):
         locks = getattr(self, "_snap_locks", None)
         if locks is None:
@@ -751,6 +805,7 @@ class Monitor:
             "osd pool selfmanaged-snap create",
             "osd pool selfmanaged-snap rm",
             "osd pool mksnap", "osd pool rmsnap",
+            "config set", "config rm", "osd crush reweight",
             # not mutations, but only the leader ingests pg stats and
             # knows the live quorum: redirect so peons don't serve an
             # empty status plane
@@ -886,6 +941,61 @@ class Monitor:
                     "health": self._health_checks(pgsum),
                 }).encode()
                 return 0, "", data
+            if prefix == "config set":
+                who = cmd.get("who", "global")
+                name, value = cmd["name"], cmd["value"]
+                from ceph_tpu.common.config import OPTIONS
+
+                opt = OPTIONS.get(name)
+                if opt is None:
+                    return -errno.ENOENT, f"unknown option {name!r}", b""
+                try:
+                    opt.cast(value)
+                except (ValueError, TypeError) as e:
+                    return -errno.EINVAL, str(e), b""
+                await self._propose({
+                    "op": "config_set", "who": who,
+                    "name": name, "value": value,
+                })
+                return 0, f"set {who}/{name}", b""
+            if prefix == "config rm":
+                await self._propose({
+                    "op": "config_rm", "who": cmd.get("who", "global"),
+                    "name": cmd["name"],
+                })
+                return 0, "removed", b""
+            if prefix == "config dump":
+                return 0, "", json.dumps(self._config_db).encode()
+            if prefix == "config get":
+                who = cmd.get("who", "global")
+                kind = who.split(".")[0]
+                merged: dict[str, str] = {}
+                for sec in ("global", kind, who):
+                    merged.update(self._config_db.get(sec, {}))
+                if "name" in cmd:
+                    if cmd["name"] not in merged:
+                        return -errno.ENOENT, "not set", b""
+                    return 0, "", merged[cmd["name"]].encode()
+                return 0, "", json.dumps(merged).encode()
+            if prefix == "osd crush reweight":
+                name = cmd["name"]
+                om2 = self.osdmap
+                if name.startswith("osd."):
+                    item = int(name[4:])
+                elif name in om2.crush.bucket_names:
+                    item = om2.crush.bucket_names[name]
+                else:
+                    return -errno.ENOENT, f"no item {name!r}", b""
+                if not any(
+                    item in b.items for b in om2.crush.buckets.values()
+                ):
+                    return -errno.ENOENT, f"{name!r} not in the map", b""
+                weight = int(float(cmd["weight"]) * 0x10000)
+                await self._propose({
+                    "op": "crush_reweight", "item": item,
+                    "weight": weight,
+                })
+                return 0, f"reweighted {name} to {cmd['weight']}", b""
             if prefix == "health":
                 h = self._health_checks()
                 return 0, h["status"], json.dumps(h).encode()

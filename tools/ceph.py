@@ -91,6 +91,24 @@ async def amain(args, extra: list[str]) -> int:
             code, rs, data = await client.command({"prefix": "pg stat"})
         elif verb == "health":
             code, rs, data = await client.command({"prefix": "health"})
+        elif verb == "config" and extra[:1] == ["set"]:
+            code, rs, data = await client.command({
+                "prefix": "config set", "who": extra[1],
+                "name": extra[2], "value": extra[3]})
+        elif verb == "config" and extra[:1] == ["get"]:
+            cmd = {"prefix": "config get", "who": extra[1]}
+            if len(extra) > 2:
+                cmd["name"] = extra[2]
+            code, rs, data = await client.command(cmd)
+        elif verb == "config" and extra[:1] == ["rm"]:
+            code, rs, data = await client.command({
+                "prefix": "config rm", "who": extra[1], "name": extra[2]})
+        elif verb == "config" and extra[:1] == ["dump"]:
+            code, rs, data = await client.command({"prefix": "config dump"})
+        elif verb == "osd" and extra[:2] == ["crush", "reweight"]:
+            code, rs, data = await client.command({
+                "prefix": "osd crush reweight", "name": extra[2],
+                "weight": extra[3]})
         else:
             print(f"unknown command: {verb} {' '.join(extra)}", file=sys.stderr)
             return 2
