@@ -1,0 +1,116 @@
+"""Span tracing — the blkin/OpenTelemetry role (reference §5 aux).
+
+The reference stacks three generations of tracing (LTTng tracepoints,
+blkin/Zipkin spans, jaeger/opentelemetry — src/common/tracer.h, the
+OSD's global ``tracing::Tracer`` at src/osd/osd_tracer.cc:9, EC
+sub-reads opening child spans per shard at src/osd/ECCommon.cc:440-445).
+This module provides the same capability TPU-side: cheap always-on
+in-process spans with parent/child structure, correlated across
+processes by the client reqid, kept in a bounded ring and dumped over
+the admin socket (``dump_traces``).  When the ``opentelemetry`` package
+is importable, finished spans are exported there too; otherwise the
+ring is the sink (the environment ships no otel — the seam is the
+point, reference src/common/tracer.h gates on HAVE_JAEGER the same
+way).
+
+Usage::
+
+    tracer = get_tracer("osd.3")
+    with tracer.span("do_op", reqid=msg.reqid, oid=msg.oid) as sp:
+        ...
+        with tracer.span("ec_sub_write", parent=sp, shard=2):
+            ...
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+_RING_CAP = 2048
+
+
+@dataclass
+class Span:
+    name: str
+    span_id: int
+    parent_id: int | None
+    start: float
+    tags: dict = field(default_factory=dict)
+    duration: float | None = None
+
+    def tag(self, **kv) -> None:
+        self.tags.update(kv)
+
+    def dump(self) -> dict:
+        return {
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start": self.start,
+            "duration_ms": (
+                round(self.duration * 1e3, 3)
+                if self.duration is not None else None
+            ),
+            "tags": dict(self.tags),
+        }
+
+
+class Tracer:
+    """One per daemon (the osd_tracer.cc global's role)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._ids = itertools.count(1)
+        self._ring: deque[Span] = deque(maxlen=_RING_CAP)
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def span(self, name: str, parent: Span | None = None, **tags):
+        sp = Span(
+            name=name,
+            span_id=next(self._ids),
+            parent_id=parent.span_id if parent is not None else None,
+            start=time.time(),
+            tags=dict(tags),
+        )
+        t0 = time.perf_counter()
+        try:
+            yield sp
+        except BaseException as e:
+            sp.tags["error"] = type(e).__name__
+            raise
+        finally:
+            sp.duration = time.perf_counter() - t0
+            with self._lock:
+                self._ring.append(sp)
+
+    def dump(self, limit: int = 200) -> list[dict]:
+        with self._lock:
+            spans = list(self._ring)[-limit:]
+        return [s.dump() for s in spans]
+
+    def find(self, **tags) -> list[Span]:
+        """Test/forensics helper: spans whose tags contain all of
+        ``tags``."""
+        with self._lock:
+            return [
+                s for s in self._ring
+                if all(s.tags.get(k) == v for k, v in tags.items())
+            ]
+
+
+_TRACERS: dict[str, Tracer] = {}
+_REG_LOCK = threading.Lock()
+
+
+def get_tracer(name: str) -> Tracer:
+    with _REG_LOCK:
+        t = _TRACERS.get(name)
+        if t is None:
+            t = _TRACERS[name] = Tracer(name)
+        return t

@@ -200,6 +200,11 @@ class OSDDaemon:
         ]
         self.perf = get_perf_counters(f"osd.{osd_id}")
         from ceph_tpu.common import DoutLogger, OpTracker
+        from ceph_tpu.common.tracing import Tracer
+
+        # per-incarnation tracer: a restarted daemon must not inherit a
+        # dead daemon's span ring
+        self.tracer = Tracer(f"osd.{osd_id}")
 
         # slow-op forensics (TrackedOp.h:121) + per-subsystem dout
         self.op_tracker = OpTracker(
@@ -230,6 +235,12 @@ class OSDDaemon:
         self._watchers: dict[tuple[int, str], dict[tuple, object]] = {}
         self._notify_waiters: dict[tuple, asyncio.Future] = {}
         self._trim_tasks: set = set()
+        import contextvars
+
+        # root span of the client op executing in THIS task (ops run as
+        # concurrent tasks, so a plain attribute would cross-parent)
+        self._op_span = contextvars.ContextVar(
+            f"osd{osd_id}_op_span", default=None)
         self._recovering_pgs: set[tuple[int, int]] = set()
         # (pool, ps) -> newest epoch whose recovery pass completed for
         # that pg: a pg is only reported clean once the pass has
@@ -324,6 +335,10 @@ class OSDDaemon:
         sock.register(
             "dump_historic_slow_ops", "ops over the complaint threshold",
             lambda cmd: self.op_tracker.dump_historic_slow_ops(),
+        )
+        sock.register(
+            "dump_traces", "recent spans (blkin/otel role)",
+            lambda cmd: self.tracer.dump(),
         )
         sock.register(
             "config show", "effective configuration",
@@ -853,7 +868,16 @@ class OSDDaemon:
                 self.perf.inc("op_r")
             self.dlog.dout(4, "osd.%d: op %s", self.id, tracked.description)
             tracked.mark_event("executing")
-            reply = await self._execute_op(msg)
+            with self.tracer.span(
+                "do_op", reqid=msg.reqid, oid=msg.oid, pool=msg.pool,
+                ops=len(msg.ops),
+            ) as _sp:
+                token = self._op_span.set(_sp)
+                try:
+                    reply = await self._execute_op(msg)
+                finally:
+                    self._op_span.reset(token)
+                _sp.tag(result=reply.result)
             tracked.mark_event("replying")
             if reply.result == 0 and reply.data:
                 self.perf.inc("op_out_bytes", len(reply.data))
@@ -949,6 +973,7 @@ class OSDDaemon:
         fan-out retried once, mirroring the reference's write-blocks-on-
         missing-object rule (PrimaryLogPG::is_missing_object wait)."""
         guarded = prev_version is not None
+        parent_sp = self._op_span.get()
         waits = []
         estale = False
         for shard, osd in live:
@@ -969,14 +994,17 @@ class OSDDaemon:
                 )
             else:
                 tid = next(self._tids)
-                waits.append(self._sub_op(osd, MOSDECSubOpWrite(
-                    tid=tid, pg=pg, shard=shard, from_osd=self.id,
-                    oid=oid, off=off, data=payload, attrs=attrs,
-                    epoch=self.epoch, truncate=truncate, version=version,
-                    rmattrs=rmattrs or [], reqid=reqid,
-                    prev_version=prev_version, guarded=guarded,
-                    clone_snap=clone_snap, clone_snaps=clone_snaps,
-                ), tid))
+                waits.append(self._traced_sub_op(
+                    "ec_sub_write", parent_sp, shard, osd, reqid,
+                    self._sub_op(osd, MOSDECSubOpWrite(
+                        tid=tid, pg=pg, shard=shard, from_osd=self.id,
+                        oid=oid, off=off, data=payload, attrs=attrs,
+                        epoch=self.epoch, truncate=truncate,
+                        version=version,
+                        rmattrs=rmattrs or [], reqid=reqid,
+                        prev_version=prev_version, guarded=guarded,
+                        clone_snap=clone_snap, clone_snaps=clone_snaps,
+                    ), tid)))
         first_err = 0
         if waits:
             for rep in await asyncio.gather(*waits):
@@ -1440,6 +1468,14 @@ class OSDDaemon:
             return ZERO
         return _v_parse(attrs.get(VERSION_ATTR))
 
+    async def _traced_sub_op(self, name, parent, shard, osd, reqid, coro):
+        """Child span per shard sub-op (the reference opens jaeger
+        child spans per ECSubRead/Write, ECCommon.cc:440-445)."""
+        with self.tracer.span(
+            name, parent=parent, shard=shard, osd=osd, reqid=reqid,
+        ):
+            return await coro
+
     def _ec_avail(self, acting) -> dict[int, int]:
         """shard -> osd for the currently usable members of an acting
         set (shared by the normal and fast_read fetch paths)."""
@@ -1731,11 +1767,13 @@ class OSDDaemon:
                 )
             return data, self.store.getattrs(c, o), 0
         tid = next(self._tids)
-        rep = await self._sub_op(osd, MOSDECSubOpRead(
-            tid=tid, pg=pg, shard=shard, from_osd=self.id, oid=oid,
-            off=off, length=length, want_attrs=True, epoch=self.epoch,
-            extents=extents or [], snap=snap,
-        ), tid)
+        rep = await self._traced_sub_op(
+            "ec_sub_read", self._op_span.get(), shard, osd,
+            "", self._sub_op(osd, MOSDECSubOpRead(
+                tid=tid, pg=pg, shard=shard, from_osd=self.id, oid=oid,
+                off=off, length=length, want_attrs=True, epoch=self.epoch,
+                extents=extents or [], snap=snap,
+            ), tid))
         if rep.result != 0:
             return None, None, -rep.result
         return rep.data, rep.attrs, 0
@@ -2520,11 +2558,15 @@ class OSDDaemon:
         mid-write would see a partial fan-out and wrongly roll it back
         (``have_lock`` for callers inside the write path that already
         hold it)."""
-        if not have_lock:
-            async with self._obj_lock(pool.id, oid):
-                return await self._reconcile_object_locked(
-                    pool, pg, pairs, oid, stray)
-        return await self._reconcile_object_locked(pool, pg, pairs, oid, stray)
+        with self.tracer.span(
+            "recover_object", pg=str(pg), oid=oid,
+        ):
+            if not have_lock:
+                async with self._obj_lock(pool.id, oid):
+                    return await self._reconcile_object_locked(
+                        pool, pg, pairs, oid, stray)
+            return await self._reconcile_object_locked(
+                pool, pg, pairs, oid, stray)
 
     async def _reconcile_object_locked(
         self, pool: PgPool, pg: pg_t, pairs: list[tuple[int, int]], oid: str,

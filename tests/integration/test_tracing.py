@@ -1,0 +1,44 @@
+"""Span tracing at the §3 seam points (reference blkin/otel spans,
+src/osd/osd_tracer.cc + ECCommon.cc:440-445 per-shard child spans)."""
+
+from tests.integration.test_mini_cluster import Cluster, run
+
+
+class TestSpans:
+    def test_ec_write_opens_child_spans_per_shard(self):
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                await c.client.ec_profile_set(
+                    "p", {"plugin": "jax", "k": "3", "m": "2"})
+                await c.client.pool_create(
+                    "tp", pg_num=4, pool_type="erasure",
+                    erasure_code_profile="p")
+                io = c.client.ioctx("tp")
+                await io.write_full("traced", b"x" * 20000)
+                assert await io.read("traced") == b"x" * 20000
+
+                roots = []
+                for osd in c.osds:
+                    roots += [
+                        s for s in osd.tracer.find(oid="traced")
+                        if s.name == "do_op"
+                    ]
+                assert roots, "no do_op span recorded"
+                write_root = next(
+                    s for s in roots if s.tags.get("reqid"))
+                osd = next(
+                    o for o in c.osds
+                    if write_root in o.tracer.find(oid="traced"))
+                children = [
+                    s for s in osd.tracer.find(reqid=write_root.tags["reqid"])
+                    if s.name == "ec_sub_write"
+                    and s.parent_id == write_root.span_id
+                ]
+                # remote shards get child spans (primary applies locally)
+                assert len(children) >= 3, [s.dump() for s in children]
+                assert all(s.duration is not None for s in children)
+                # admin-socket shaped dump round-trips
+                dump = osd.tracer.dump()
+                assert any(d["name"] == "do_op" for d in dump)
+
+        run(go())
