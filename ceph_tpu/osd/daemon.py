@@ -1900,20 +1900,33 @@ class OSDDaemon:
                 result=-errno.ENOENT, epoch=self.epoch,
             )
         else:
-            if msg.extents:
-                data = b"".join(
-                    self.store.read(c, o, eo, ln) for eo, ln in msg.extents
+            try:
+                if msg.extents:
+                    data = b"".join(
+                        self.store.read(c, o, eo, ln)
+                        for eo, ln in msg.extents
+                    )
+                else:
+                    data = self.store.read(
+                        c, o, msg.off, None if msg.length == 0 else msg.length
+                    )
+                self.perf.inc("subop_read_bytes", len(data))
+                attrs = self.store.getattrs(c, o) if msg.want_attrs else {}
+                rep = MOSDECSubOpReadReply(
+                    tid=msg.tid, pg=msg.pg, shard=msg.shard,
+                    from_osd=self.id, result=0, data=data, attrs=attrs,
+                    epoch=self.epoch,
                 )
-            else:
-                data = self.store.read(
-                    c, o, msg.off, None if msg.length == 0 else msg.length
+            except OSError as e:
+                # e.g. a checksum-at-rest failure (BlockStore EIO): the
+                # primary excludes this shard and reconstructs from the
+                # others (the reference's shard-EIO path,
+                # ECBackend::handle_sub_read error handling)
+                rep = MOSDECSubOpReadReply(
+                    tid=msg.tid, pg=msg.pg, shard=msg.shard,
+                    from_osd=self.id, result=-(e.errno or 5),
+                    epoch=self.epoch,
                 )
-            self.perf.inc("subop_read_bytes", len(data))
-            attrs = self.store.getattrs(c, o) if msg.want_attrs else {}
-            rep = MOSDECSubOpReadReply(
-                tid=msg.tid, pg=msg.pg, shard=msg.shard, from_osd=self.id,
-                result=0, data=data, attrs=attrs, epoch=self.epoch,
-            )
         await msg.conn.send_message(rep)
 
     # -- watch/notify (PrimaryLogPG watch/notify + MWatchNotify) -------

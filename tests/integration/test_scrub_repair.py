@@ -11,8 +11,8 @@ from ceph_tpu.store import coll_t, ghobject_t
 from tests.integration.test_mini_cluster import Cluster, run
 
 
-def _corrupt_one_shard(c, io, oid):
-    """Flip bytes of one stored EC shard on disk; returns (osd, shard)."""
+def _locate_nonprimary_shard(c, io, oid):
+    """(osd_id, shard, folded_pg) of a non-primary shard of ``oid``."""
     from ceph_tpu.osd.daemon import object_to_pg
 
     om = c.client.osdmap
@@ -22,7 +22,15 @@ def _corrupt_one_shard(c, io, oid):
     _, _, acting, primary = om.pg_to_up_acting_osds(pg)
     victim_shard = next(
         s for s, o in enumerate(acting) if o != primary and o >= 0)
-    osd = c.osds[acting[victim_shard]]
+    return acting[victim_shard], victim_shard, folded
+
+
+def _corrupt_one_shard(c, io, oid):
+    """Flip bytes of one stored EC shard on disk; returns (osd, shard)."""
+    om = c.client.osdmap
+    pool = om.get_pg_pool(io.pool_id)
+    bad_osd, victim_shard, folded = _locate_nonprimary_shard(c, io, oid)
+    osd = c.osds[bad_osd]
     cl = coll_t(pool.id, folded.ps, victim_shard)
     o = ghobject_t(oid, shard=victim_shard)
     data = bytearray(osd.store.read(cl, o))
@@ -30,7 +38,7 @@ def _corrupt_one_shard(c, io, oid):
     from ceph_tpu.store import Transaction
 
     osd.store.queue_transaction(Transaction().write(cl, o, 0, bytes(data)))
-    return acting[victim_shard], victim_shard, folded
+    return bad_osd, victim_shard, folded
 
 
 class TestScrubRepair:
@@ -134,5 +142,54 @@ class TestScrubRepair:
                 assert bytes(
                     c.osds[bad].store.read(cl, ghobject_t("obj"))
                 ).startswith(b"good data")
+
+        run(go())
+
+
+class TestBlockStoreBitRot:
+    def test_bit_rot_on_disk_found_and_repaired(self, tmp_path):
+        """The full BlueStore-grade story: flip bits in an OSD's BLOCK
+        FILE under a live cluster -> the read fails its checksum-at-rest
+        -> deep scrub reports the shard -> pg repair reconstructs it
+        from parity -> reads and fsck come back clean."""
+        from ceph_tpu.store.blockstore import MIN_ALLOC, BlockStore
+
+        def factory(i):
+            s = BlockStore(str(tmp_path / f"osd{i}"))
+            s.mount()
+            return s
+
+        async def go():
+            async with Cluster(n_osds=6, store_factory=factory) as c:
+                await c.client.ec_profile_set(
+                    "p", {"plugin": "jax", "k": "3", "m": "2",
+                          "crush-failure-domain": "host"})
+                await c.client.pool_create(
+                    "bp", pg_num=4, pool_type="erasure",
+                    erasure_code_profile="p")
+                io = c.client.ioctx("bp")
+                payload = np.random.default_rng(5).integers(
+                    0, 256, 3 * MIN_ALLOC, dtype=np.uint8).tobytes()
+                await io.write_full("victim", payload)
+                await c.client.wait_clean(timeout=30)
+
+                bad_osd, bad_shard, folded = _locate_nonprimary_shard(
+                    c, io, "victim")
+                store = c.osds[bad_osd].store
+                # flip bytes inside the shard's blob on DISK
+                with open(store._block_path, "r+b") as f:
+                    f.seek(64)
+                    f.write(b"\xba\xad" * 16)
+                assert store.fsck(), "fsck must see the rot"
+
+                code, _, data = await c.client.command({
+                    "prefix": "pg repair",
+                    "pgid": f"{io.pool_id}.{folded.ps}"})
+                assert code == 0
+                rep = json.loads(data)
+                assert rep["repaired"] == ["victim"], rep
+                assert rep["inconsistencies"] == [], rep
+                assert await io.read("victim") == payload
+                assert store.fsck() == [], "repair must clear the rot"
 
         run(go())

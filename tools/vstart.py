@@ -40,6 +40,10 @@ async def amain(args) -> int:
             from ceph_tpu.store.kstore import KStore
 
             s = KStore(FileDB(os.path.join(args.data, name)))
+        elif getattr(args, "store", "file") == "block":
+            from ceph_tpu.store.blockstore import BlockStore
+
+            s = BlockStore(os.path.join(args.data, name))
         else:
             from ceph_tpu.store.filestore import FileStore
 
@@ -100,9 +104,11 @@ def main(argv=None) -> int:
              "cluster survives restart (default: volatile MemStores)",
     )
     ap.add_argument(
-        "--store", choices=("file", "kstore"), default="file",
+        "--store", choices=("file", "kstore", "block"), default="file",
         help="durable engine under --data: file = FileStore WAL, "
-             "kstore = objects-in-kv over FileDB (src/os/kstore twin)",
+             "kstore = objects-in-kv over FileDB (src/os/kstore twin), "
+             "block = BlockStore (extents + checksums-at-rest, the "
+             "BlueStore-grade engine)",
     )
     args = ap.parse_args(argv)
     try:
