@@ -1,0 +1,108 @@
+"""Deterministic fault injection — the FaultInjector twin.
+
+Behavioral twin of the reference's deterministic injection helper
+(src/common/fault_injector.h:28-60: ``FaultInjector<Key>`` with
+InjectAbort / InjectError / InjectDelay), complementing the
+probabilistic knobs the messenger already exposes
+(ms_inject_socket_failures / ms_inject_delay).  Code under test marks
+named injection points with :meth:`check`; tests arm specific points
+with an error, a delay, or an abort — deterministically, at exactly the
+chosen point, which is what makes crash/ordering bugs reproducible
+(the reference uses it for rgw/mon paths the thrashers can't steer).
+
+    FAULTS.inject("ec_fan_out", error=errno.EIO, count=1)
+    ...
+    await FAULTS.check("ec_fan_out")   # raises OSError(EIO) once
+
+Injection points are process-global and default to no-ops; ``count``
+bounds how many times a fault fires (None = until cleared).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+
+class InjectedError(OSError):
+    """Raised by an armed injection point (InjectError role)."""
+
+
+class InjectedAbort(BaseException):
+    """Raised for abort-style injections (InjectAbort role); derives
+    from BaseException so ordinary error containment can't swallow it —
+    like the reference's ceph_abort it must take the daemon down."""
+
+
+class FaultInjector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # key -> {"error": errno|None, "delay": s|None, "abort": bool,
+        #         "count": int|None, "fired": int}
+        self._points: dict[str, dict] = {}
+
+    def inject(
+        self, key: str, *, error: int | None = None,
+        delay: float | None = None, abort: bool = False,
+        count: int | None = 1,
+    ) -> None:
+        """Arm an injection point (InjectError/InjectDelay/InjectAbort)."""
+        with self._lock:
+            self._points[key] = {
+                "error": error, "delay": delay, "abort": abort,
+                "count": count, "fired": 0,
+            }
+
+    def clear(self, key: str | None = None) -> None:
+        with self._lock:
+            if key is None:
+                self._points.clear()
+            else:
+                self._points.pop(key, None)
+
+    def fired(self, key: str) -> int:
+        with self._lock:
+            p = self._points.get(key)
+            return p["fired"] if p else 0
+
+    def _take(self, key: str) -> dict | None:
+        with self._lock:
+            p = self._points.get(key)
+            if p is None:
+                return None
+            if p["count"] is not None and p["fired"] >= p["count"]:
+                return None
+            p["fired"] += 1
+            return dict(p)
+
+    async def check(self, key: str) -> None:
+        """Async injection point: delay, then error/abort if armed."""
+        p = self._take(key)
+        if p is None:
+            return
+        if p["delay"]:
+            await asyncio.sleep(p["delay"])
+        if p["abort"]:
+            raise InjectedAbort(key)
+        if p["error"] is not None:
+            raise InjectedError(p["error"], f"injected fault at {key!r}")
+
+    def check_sync(self, key: str) -> None:
+        """Synchronous variant (delay becomes a blocking sleep)."""
+        import time
+
+        p = self._take(key)
+        if p is None:
+            return
+        if p["delay"]:
+            time.sleep(p["delay"])
+        if p["abort"]:
+            raise InjectedAbort(key)
+        if p["error"] is not None:
+            raise InjectedError(p["error"], f"injected fault at {key!r}")
+
+
+#: process-global injector (the reference passes FaultInjector instances
+#: around; a global keeps marked points zero-cost in production where
+#: nothing is armed)
+FAULTS = FaultInjector()

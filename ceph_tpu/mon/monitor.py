@@ -691,14 +691,20 @@ class Monitor:
         expected = sum(p.pg_num for p in om.pools.values())
         by_state: dict[str, int] = {}
         objects = 0
+        primaries = self._pg_primaries(om)
         for pgid, st in book.items():
-            pid = int(pgid.split(".")[0])
+            pid_s, ps_s = pgid.split(".")
+            pid = int(pid_s)
             if pid not in om.pools:
                 continue
             state = st.get("state", "unknown")
-            # a report from a primary that is now down is STALE until a
-            # new primary reports (reference pg_state stale semantics)
-            if not om.is_up(st.get("primary", -1)):
+            # a report from a primary that is now down — or that is no
+            # longer THE primary after a remap — is STALE until the
+            # current primary reports (reference pg_state stale
+            # semantics: stats are per-interval)
+            reporter = st.get("primary", -1)
+            cur_primary = primaries.get((pid, int(ps_s)), -1)
+            if not om.is_up(reporter) or reporter != cur_primary:
                 state = "stale"
             by_state[state] = by_state.get(state, 0) + 1
             objects += int(st.get("objects", 0))
@@ -709,6 +715,25 @@ class Monitor:
             "by_state": by_state,
             "num_objects": objects,
         }
+
+    def _pg_primaries(self, om) -> dict[tuple[int, int], int]:
+        """pg -> current primary, CACHED PER EPOCH: status/health are
+        the hottest mon read path and a full CRUSH pass per call would
+        stall beacon dispatch (the balancer learned this the hard way
+        — see the to_thread note there)."""
+        from ceph_tpu.osd.types import pg_t as _pg_t
+
+        cache = getattr(self, "_primaries_cache", None)
+        if cache is not None and cache[0] == om.epoch:
+            return cache[1]
+        out: dict[tuple[int, int], int] = {}
+        for pid, pool in om.pools.items():
+            for ps in range(pool.pg_num):
+                _u, _up, _a, primary = om.pg_to_up_acting_osds(
+                    _pg_t(pid, ps), folded=True)
+                out[(pid, ps)] = primary
+        self._primaries_cache = (om.epoch, out)
+        return out
 
     def _health_checks(self, pgsum: dict | None = None) -> dict:
         """HealthMonitor role (reference src/mon/HealthMonitor.cc +
@@ -806,6 +831,7 @@ class Monitor:
             "osd pool selfmanaged-snap rm",
             "osd pool mksnap", "osd pool rmsnap",
             "config set", "config rm", "osd crush reweight",
+            "osd pg-upmap-items",
             # not mutations, but only the leader ingests pg stats and
             # knows the live quorum: redirect so peons don't serve an
             # empty status plane
@@ -977,6 +1003,34 @@ class Monitor:
                         return -errno.ENOENT, "not set", b""
                     return 0, "", merged[cmd["name"]].encode()
                 return 0, "", json.dumps(merged).encode()
+            if prefix == "osd pg-upmap-items":
+                # explicit placement override pairs (reference
+                # OSDMonitor osd pg-upmap-items): pgid from to [...]
+                pool_id, ps = cmd["pgid"].split(".", 1)
+                pool_id = int(pool_id)
+                ps = int(ps, 16) if ps.startswith("0x") else int(ps)
+                pool = self.osdmap.pools.get(pool_id)
+                if pool is None:
+                    return -errno.ENOENT, f"no pool {pool_id}", b""
+                if not 0 <= ps < pool.pg_num:
+                    return -errno.ENOENT, f"no pg {cmd['pgid']}", b""
+                pairs_raw = cmd["pairs"].split()
+                if len(pairs_raw) % 2:
+                    return -errno.EINVAL, "pairs must be from/to pairs", b""
+                items = [
+                    [int(pairs_raw[i]), int(pairs_raw[i + 1])]
+                    for i in range(0, len(pairs_raw), 2)
+                ]
+                for frm, to in items:
+                    if not (self.osdmap.exists(frm)
+                            and self.osdmap.exists(to)):
+                        return (-errno.ENOENT,
+                                f"osd {frm} or {to} does not exist", b"")
+                await self._propose({
+                    "op": "upmap",
+                    "items": [[pool_id, ps, items]],
+                })
+                return 0, f"upmap set on {cmd['pgid']}", b""
             if prefix == "osd crush reweight":
                 name = cmd["name"]
                 om2 = self.osdmap

@@ -137,6 +137,18 @@ VERSION_ATTR = "_v"  # object_info version (oi attr analogue)
 USER_XATTR_PREFIX = "u_"  # client xattrs, namespaced off internal attrs
 
 
+def _read_extents(store, c, o, extents) -> bytes:
+    """Serve a multi-run ranged read from ONE covering store read:
+    checksummed engines (BlockStore) verify each blob once instead of
+    once per run — CLAY sub-chunk repairs issue many runs per chunk."""
+    lo = min(eo for eo, _ln in extents)
+    hi = max(eo + ln for eo, ln in extents)
+    span = bytes(store.read(c, o, lo, hi - lo))
+    # per-run slices clamp at the object size exactly like the
+    # individual reads they replace (no padding)
+    return b"".join(span[eo - lo : eo - lo + ln] for eo, ln in extents)
+
+
 class ECFetchError(Exception):
     """A version-consistent EC fetch could not complete."""
 
@@ -247,6 +259,14 @@ class OSDDaemon:
         # verified it under the current map (completeness, not just
         # map up-ness)
         self._clean_epoch: dict[tuple[int, int], int] = {}
+        # past_intervals-lite (reference src/osd/osd_types.h:3270
+        # PastIntervals): per local PG, the acting sets of recent map
+        # intervals since the pg was last clean — recovery consults
+        # their still-up members as data SOURCES, so a fully-remapped
+        # PG can pull from its previous home.  Bounded; trimmed when
+        # the recovery pass completes clean.
+        self._past_acting: dict[tuple[int, int], list[list[int]]] = {}
+        self._past_acting_loaded = False
         # (pool, ps) -> (last shallow stamp, last deep stamp), monotonic
         self._scrub_stamps: dict[tuple[int, int], tuple[float, float]] = {}
         self._scrub_task: asyncio.Task | None = None
@@ -717,6 +737,7 @@ class OSDDaemon:
         if new_map is not None:
             self.osdmap = new_map
             self._maybe_snap_trim(old_map, new_map)
+            self._track_intervals(old_map, new_map)
         if gap:
             # ask the mon for the missing range (or a full map)
             await self._request_map_fill()
@@ -759,6 +780,119 @@ class OSDDaemon:
                     log.warning(
                         "osd.%d: ignoring mon config %s=%r", self.id,
                         name, value)
+
+    def _track_intervals(self, old_map, new_map) -> None:
+        """Record acting-set interval changes for PGs this OSD touches
+        (the PastIntervals bookkeeping): the PREVIOUS map is in hand at
+        map-change time, so even a member that just JOINED the acting
+        set learns where the PG lived before — the prior set a full
+        remap must pull from."""
+        if old_map is None:
+            return
+        # placement-inputs precheck: epochs minted by non-placement
+        # changes (pool create, profiles, config) can't move any pg —
+        # skip the per-pg mapping work entirely
+        if (
+            old_map.osd_state == new_map.osd_state
+            and old_map.osd_weight == new_map.osd_weight
+            and old_map.osd_primary_affinity == new_map.osd_primary_affinity
+            and old_map.pg_upmap == new_map.pg_upmap
+            and old_map.pg_upmap_items == new_map.pg_upmap_items
+            and old_map.pg_temp == new_map.pg_temp
+            and all(
+                p.pg_num == new_map.pools[pid].pg_num
+                and p.crush_rule == new_map.pools[pid].crush_rule
+                for pid, p in old_map.pools.items()
+                if pid in new_map.pools
+            )
+        ):
+            return
+        changed = False
+        if not self._past_acting_loaded:
+            self._load_past_acting()
+        for pid, pool in new_map.pools.items():
+            old_pool = old_map.pools.get(pid)
+            if old_pool is None:
+                continue
+            for ps in range(pool.pg_num):
+                pg = pg_t(pid, ps)
+                _u, _up, acting, _p = new_map.pg_to_up_acting_osds(
+                    pg, folded=True)
+                _u2, _up2, acting_old, _p2 = old_map.pg_to_up_acting_osds(
+                    pg, folded=True)
+                if acting_old == acting:
+                    continue
+                if self.id not in acting and self.id not in acting_old:
+                    continue
+                hist = self._past_acting.setdefault((pid, ps), [])
+                if not hist or hist[-1] != acting_old:
+                    hist.append(list(acting_old))
+                    del hist[:-16]  # bounded
+                    changed = True
+        if changed:
+            self._save_past_acting()
+
+    _META_COLL = coll_t(0, 0, -1)   # pool ids start at 1: reserved
+    _META_OID = "osd_past_intervals"
+
+    def _load_past_acting(self) -> None:
+        """Restart path: reload the recorded intervals so a primary
+        that reboots across a remap still knows the prior homes (the
+        reference persists PastIntervals in pg info the same way)."""
+        self._past_acting_loaded = True
+        import json as _json
+
+        try:
+            raw = self.store.read(
+                self._META_COLL, ghobject_t(self._META_OID))
+        except (FileNotFoundError, OSError):
+            return
+        try:
+            data = _json.loads(raw)
+        except ValueError:
+            return
+        for k, hist in data.items():
+            pid, ps = k.split(".")
+            self._past_acting[(int(pid), int(ps))] = hist
+
+    def _save_past_acting(self) -> None:
+        import json as _json
+
+        t = Transaction()
+        self._ensure_coll(t, self._META_COLL)
+        blob = _json.dumps({
+            f"{pid}.{ps}": hist
+            for (pid, ps), hist in self._past_acting.items()
+        }).encode()
+        t.touch(self._META_COLL, ghobject_t(self._META_OID))
+        t.truncate(self._META_COLL, ghobject_t(self._META_OID), len(blob))
+        t.write(self._META_COLL, ghobject_t(self._META_OID), 0, blob)
+        try:
+            self.store.queue_transaction(t)
+        except OSError:
+            log.exception("osd.%d: persisting past intervals failed", self.id)
+
+    def _prior_pairs(
+        self, pool, pg: pg_t, pairs: list[tuple[int, int]]
+    ) -> list[tuple[int, int]]:
+        """(shard, osd) candidates from past intervals: still-up
+        members not in the current acting set — potential data sources
+        (the prior_set role of PastIntervals)."""
+        if not self._past_acting_loaded:
+            self._load_past_acting()
+        key = (pg.pool, pg.ps)
+        current = {(s, o) for s, o in pairs}
+        out: list[tuple[int, int]] = []
+        seen = set()
+        for past in reversed(self._past_acting.get(key, [])):
+            for s, o in self._pg_members(pool, past):
+                if (s, o) in current or (s, o) in seen:
+                    continue
+                if o == CRUSH_ITEM_NONE or not self.osdmap.is_up(o):
+                    continue
+                seen.add((s, o))
+                out.append((s, o))
+        return out
 
     def _maybe_snap_trim(self, old_map, new_map) -> None:
         """Schedule the snap trimmer for pools whose removed_snaps grew
@@ -972,6 +1106,9 @@ class OSDDaemon:
         earlier writes is reconciled (recovery roll-forward) and the
         fan-out retried once, mirroring the reference's write-blocks-on-
         missing-object rule (PrimaryLogPG::is_missing_object wait)."""
+        from ceph_tpu.common.fault_injector import FAULTS
+
+        await FAULTS.check("osd.ec_fan_out")
         guarded = prev_version is not None
         parent_sp = self._op_span.get()
         waits = []
@@ -1758,9 +1895,7 @@ class OSDDaemon:
             if not self.store.exists(c, o):
                 return None, None, errno.ENOENT
             if extents:
-                data = b"".join(
-                    self.store.read(c, o, eo, ln) for eo, ln in extents
-                )
+                data = _read_extents(self.store, c, o, extents)
             else:
                 data = self.store.read(
                     c, o, off, None if length == 0 else length
@@ -1857,9 +1992,12 @@ class OSDDaemon:
         return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
 
     async def _handle_sub_write(self, msg: MOSDECSubOpWrite) -> None:
+        from ceph_tpu.common.fault_injector import FAULTS
+
         pool = self.osdmap.get_pg_pool(msg.pg.pool)
         result = 0
         try:
+            await FAULTS.check("osd.ec_sub_write_apply")
             skip = False
             if msg.guard > ZERO:
                 c = self._shard_coll(pool, msg.pg, msg.shard)
@@ -1902,10 +2040,7 @@ class OSDDaemon:
         else:
             try:
                 if msg.extents:
-                    data = b"".join(
-                        self.store.read(c, o, eo, ln)
-                        for eo, ln in msg.extents
-                    )
+                    data = _read_extents(self.store, c, o, msg.extents)
                 else:
                     data = self.store.read(
                         c, o, msg.off, None if msg.length == 0 else msg.length
@@ -2409,8 +2544,9 @@ class OSDDaemon:
                             continue
                         self._recovering_pgs.add((pid, ps))
                         try:
-                            await self._recover_pg(pool, pg, acting)
-                            self._clean_epoch[(pid, ps)] = done_epoch
+                            ok = await self._recover_pg(pool, pg, acting)
+                            if ok:
+                                self._clean_epoch[(pid, ps)] = done_epoch
                         finally:
                             self._recovering_pgs.discard((pid, ps))
             except asyncio.CancelledError:
@@ -2453,7 +2589,11 @@ class OSDDaemon:
         """
         pairs = self._pg_members(pool, acting)
         if self.id not in [o for _, o in pairs]:
-            return
+            return True
+        # prior-set (PastIntervals role): still-up members of previous
+        # acting sets serve as extra data SOURCES — a fully-remapped PG
+        # pulls from its old home
+        prior = self._prior_pairs(pool, pg, pairs)
         my_shard = next(s for s, o in pairs if o == self.id)
         myc = self._shard_coll(pool, pg, my_shard)
         lg = self._pg_log(myc)
@@ -2494,8 +2634,9 @@ class OSDDaemon:
             if not t.empty():
                 self.store.queue_transaction(t)
 
-        # scope
-        scope: set[str] | None = None if gapped else set()
+        # scope; prior intervals force the backfill enumeration — the
+        # data may live entirely on members our log knows nothing about
+        scope: set[str] | None = None if (gapped or prior) else set()
         if scope is not None:
             for info in peer_infos.values():
                 miss = lg.missing_from(info.last_update)
@@ -2521,7 +2662,10 @@ class OSDDaemon:
                 (my_shard, self.id): set(objs)
             }
             lus = {(my_shard, self.id): pre_adopt_lu}
-            for (s, o), info in list(peer_infos.items()):
+            prior_sets = [
+                ((s, o), None) for s, o in prior
+            ] + [(k, i) for k, i in peer_infos.items()]
+            for (s, o), info in prior_sets:
                 try:
                     full = await self._pg_query(
                         pool, pg, s, o, since=lg.info.last_update,
@@ -2530,22 +2674,44 @@ class OSDDaemon:
                 except (OSError, asyncio.TimeoutError, ConnectionError):
                     continue
                 lists[(s, o)] = {oid for oid, _v in full.objects}
-                lus[(s, o)] = info.last_update
+                lus[(s, o)] = (
+                    info.last_update if info is not None
+                    else full.last_update
+                )
                 objs |= lists[(s, o)]
+                if info is None and full.last_update > lg.info.last_update:
+                    # adopt the prior member's log delta so ops from
+                    # the foreign interval (e.g. DELETEs) replay here
+                    # instead of the old state resurrecting
+                    t2 = Transaction()
+                    self._ensure_coll(t2, myc)
+                    if full.log_tail > lg.info.last_update:
+                        lg.set_tail(t2, full.log_tail)
+                    for raw in full.entries:
+                        e = pg_log_entry_t.decode(raw)
+                        if e.version > lg.info.last_update:
+                            lg.append(t2, e)
+                            objs.add(e.oid)
+                    lg.trim(t2, self._log_keep)
+                    if not t2.empty():
+                        self.store.queue_transaction(t2)
             auth = max(lus, key=lambda k: lus[k])
             strays = objs - lists[auth]
         else:
             objs = scope
+        all_ok = True
         for oid in sorted(objs):
             try:
-                await self._reconcile_object(
-                    pool, pg, pairs, oid, stray=oid in strays
+                ok = await self._reconcile_object(
+                    pool, pg, pairs, oid, stray=oid in strays,
+                    prior_pairs=prior,
                 )
+                all_ok &= bool(ok)
             except (OSError, asyncio.TimeoutError, ConnectionError):
                 log.warning(
                     "osd.%d: reconcile %s/%s interrupted", self.id, pg, oid
                 )
-                return
+                return False
         # log sync
         for (s, o), info in peer_infos.items():
             if info.last_update >= lg.info.last_update:
@@ -2557,10 +2723,22 @@ class OSDDaemon:
                 await self._pg_log_send(pool, pg, s, o, entries, lg.info.log_tail)
             except (OSError, asyncio.TimeoutError, ConnectionError):
                 continue
+        # only a FULLY verified pass (every object confirmed on every
+        # target) may forget the prior intervals — a swallowed push
+        # failure must keep the old home reachable for the retry
+        if all_ok:
+            if self._past_acting.pop((pg.pool, pg.ps), None) is not None:
+                self._save_past_acting()
+        else:
+            log.warning(
+                "osd.%d: %s recovery pass incomplete; retaining past "
+                "intervals", self.id, pg)
+        return all_ok
 
     async def _reconcile_object(
         self, pool: PgPool, pg: pg_t, pairs: list[tuple[int, int]], oid: str,
         stray: bool = False, have_lock: bool = False,
+        prior_pairs: list[tuple[int, int]] | None = None,
     ) -> None:
         """Bring one object to its newest version on every acting
         member: replay deletes, remove strays, reconstruct
@@ -2577,14 +2755,20 @@ class OSDDaemon:
             if not have_lock:
                 async with self._obj_lock(pool.id, oid):
                     return await self._reconcile_object_locked(
-                        pool, pg, pairs, oid, stray)
+                        pool, pg, pairs, oid, stray, prior_pairs)
             return await self._reconcile_object_locked(
-                pool, pg, pairs, oid, stray)
+                pool, pg, pairs, oid, stray, prior_pairs)
 
     async def _reconcile_object_locked(
         self, pool: PgPool, pg: pg_t, pairs: list[tuple[int, int]], oid: str,
         stray: bool = False,
-    ) -> None:
+        prior_pairs: list[tuple[int, int]] | None = None,
+    ) -> bool:
+        """Returns True when the object verifiably reached every
+        target (False = retry on a later pass)."""
+        from ceph_tpu.common.fault_injector import FAULTS
+
+        await FAULTS.check("osd.recover_object")
         is_ec = pool.is_erasure()
         my_shard = next(s for s, o in pairs if o == self.id)
         lg = self._pg_log(self._shard_coll(pool, pg, my_shard))
@@ -2606,6 +2790,18 @@ class OSDDaemon:
                 state[(s, o)] = (
                     True, _v_parse((attrs or {}).get(VERSION_ATTR)), attrs or {}
                 )
+        # prior-interval members: extra SOURCES (never targets) — data
+        # a full remap left on the old acting set
+        prior_state: dict[tuple[int, int], tuple[bool, eversion_t, dict]] = {}
+        for s, o in prior_pairs or ():
+            try:
+                payload, attrs = await self._probe_shard(pool, pg, s, o, oid)
+            except (OSError, asyncio.TimeoutError, ConnectionError):
+                continue
+            if payload is not None:
+                prior_state[(s, o)] = (
+                    True, _v_parse((attrs or {}).get(VERSION_ATTR)), attrs or {}
+                )
 
         delete_entry = latest is not None and latest.op == DELETE
         if delete_entry or (stray and latest is None):
@@ -2615,28 +2811,30 @@ class OSDDaemon:
             for (s, o), (present, _v, _a) in state.items():
                 if present:
                     await self._recovery_delete(pool, pg, s, o, oid, guard)
-            return
+            return True
 
-        versions = [v for (p, v, _a) in state.values() if p]
+        all_state = {**prior_state, **state}
+        versions = [v for (p, v, _a) in all_state.values() if p]
         if not versions:
-            return  # nothing anywhere to recover from
+            return True  # nothing anywhere to recover from
         vmax = max(versions)
         sources = {
-            s: o for (s, o), (p, v, _a) in state.items() if p and v == vmax
+            s: o for (s, o), (p, v, _a) in all_state.items()
+            if p and v == vmax
         }
         targets = [
             (s, o) for (s, o), (p, v, _a) in state.items()
             if not p or v < vmax
         ]
         if not targets:
-            return
+            return True
         log.info(
             "osd.%d: recovering %s/%s to %s on %s", self.id, pg, oid,
             vmax, targets,
         )
         self.perf.inc("recovery_ops")
         src_attrs = next(
-            a for (s, o), (p, v, a) in state.items() if p and v == vmax
+            a for (s, o), (p, v, a) in all_state.items() if p and v == vmax
         )
         if not is_ec:
             s0, o0 = next(iter(sources.items()))
@@ -2644,12 +2842,13 @@ class OSDDaemon:
                 pool, pg, s0, o0, oid
             )
             if payload is None:
-                return
-            await asyncio.gather(*(
+                return False
+            results = await asyncio.gather(*(
                 self._push(pool, pg, s, o, oid, payload, src_attrs)
                 for s, o in targets
             ), return_exceptions=True)  # a dead target must not abort
-            return                      # the rest of the recovery pass
+            return not any(              # the rest of the recovery pass
+                isinstance(r, BaseException) for r in results)
         ec = self._ec_for(pool)
         sinfo = self._sinfo(ec)
         k = ec.get_data_chunk_count()
@@ -2662,7 +2861,7 @@ class OSDDaemon:
             # expressed at shard granularity.  The rolled-back write's
             # log entries are stripped so a client retry re-applies it.
             by_v: dict = {}
-            for (s, o), (p, v, _a) in state.items():
+            for (s, o), (p, v, _a) in all_state.items():
                 if p:
                     by_v.setdefault(v, []).append((s, o))
             candidates = [v for v, lst in by_v.items() if len(lst) >= k]
@@ -2671,7 +2870,7 @@ class OSDDaemon:
                     "osd.%d: %s/%s unrecoverable: %d/%d consistent shards",
                     self.id, pg, oid, len(sources), k,
                 )
-                return
+                return False
             v_star = max(candidates)
             log.warning(
                 "osd.%d: %s/%s rolling back %s -> %s (partial write)",
@@ -2684,7 +2883,7 @@ class OSDDaemon:
                 if not p or v != v_star
             ]
             src_attrs = next(
-                a for (s, o), (p, v, a) in state.items()
+                a for (s, o), (p, v, a) in all_state.items()
                 if p and v == v_star
             )
             force_push = True
@@ -2758,16 +2957,17 @@ class OSDDaemon:
                     "osd.%d: %s/%s recovery aborted: %d/%d source reads "
                     "succeeded", self.id, pg, oid, len(chunks), k,
                 )
-                return
+                return False
         rebuilt = await ecutil.decode_shards_async(
             sinfo, ec, chunks, need, packed_repair=used_packed,
             service=self.encode_service,
         )
-        await asyncio.gather(*(
+        results = await asyncio.gather(*(
             self._push(pool, pg, s, o, oid, rebuilt[s].tobytes(), src_attrs,
                        force=force_push)
             for s, o in targets
         ), return_exceptions=True)  # dead targets retry on the next pass
+        return not any(isinstance(r, BaseException) for r in results)
 
     async def _recovery_delete(
         self, pool, pg, shard, osd, oid, guard: eversion_t

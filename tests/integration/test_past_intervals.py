@@ -1,0 +1,96 @@
+"""Past-intervals-lite: a fully remapped PG pulls its data from the
+previous acting set (reference PastIntervals prior-set role,
+src/osd/osd_types.h:3270)."""
+
+import numpy as np
+
+from tests.integration.test_mini_cluster import Cluster, run
+
+
+class TestFullRemapRecovery:
+    def test_replicated_pg_survives_total_remap(self):
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                await c.client.pool_create("pi", pg_num=4, size=2)
+                io = c.client.ioctx("pi")
+                payloads = {
+                    f"o{i}": np.random.default_rng(i).integers(
+                        0, 256, 9000, dtype=np.uint8).tobytes()
+                    for i in range(8)
+                }
+                for oid, data in payloads.items():
+                    await io.write_full(oid, data)
+                await c.client.wait_clean(timeout=30)
+
+                # move EVERY pg of the pool to a disjoint acting set
+                om = c.client.osdmap
+                pool = om.get_pg_pool(io.pool_id)
+                epoch0 = om.epoch
+                from ceph_tpu.osd.types import pg_t
+
+                for ps in range(pool.pg_num):
+                    _, _, acting, _ = om.pg_to_up_acting_osds(
+                        pg_t(io.pool_id, ps), folded=True)
+                    spare = [o for o in range(6) if o not in acting]
+                    pairs = " ".join(
+                        f"{frm} {to}" for frm, to in zip(acting, spare))
+                    code, rs, _ = await c.client.command({
+                        "prefix": "osd pg-upmap-items",
+                        "pgid": f"{io.pool_id}.{ps}",
+                        "pairs": pairs})
+                    assert code == 0, rs
+                await c.wait_epoch(epoch0 + 1)
+                om2 = c.client.osdmap
+                for ps in range(pool.pg_num):
+                    _, _, a2, _ = om2.pg_to_up_acting_osds(
+                        pg_t(io.pool_id, ps), folded=True)
+                # the new homes must recover all data from the old ones
+                st = await c.client.wait_clean(timeout=60)
+                for oid, data in payloads.items():
+                    assert await io.read(oid) == data, oid
+
+        run(go())
+
+    def test_ec_pg_survives_total_remap(self):
+        """EC flavor: every positional shard pulls from its previous
+        home after a disjoint remap."""
+        async def go():
+            async with Cluster(n_osds=8) as c:
+                await c.client.ec_profile_set(
+                    "p", {"plugin": "jax", "k": "3", "m": "1",
+                          "crush-failure-domain": "host"})
+                await c.client.pool_create(
+                    "pie", pg_num=2, pool_type="erasure",
+                    erasure_code_profile="p")
+                io = c.client.ioctx("pie")
+                payloads = {
+                    f"e{i}": np.random.default_rng(100 + i).integers(
+                        0, 256, 30000, dtype=np.uint8).tobytes()
+                    for i in range(4)
+                }
+                for oid, data in payloads.items():
+                    await io.write_full(oid, data)
+                await c.client.wait_clean(timeout=30)
+
+                om = c.client.osdmap
+                pool = om.get_pg_pool(io.pool_id)
+                epoch0 = om.epoch
+                from ceph_tpu.osd.types import pg_t
+
+                for ps in range(pool.pg_num):
+                    _, _, acting, _ = om.pg_to_up_acting_osds(
+                        pg_t(io.pool_id, ps), folded=True)
+                    spare = [o for o in range(8) if o not in acting]
+                    pairs = " ".join(
+                        f"{frm} {to}" for frm, to in zip(acting, spare))
+                    code, rs, _ = await c.client.command({
+                        "prefix": "osd pg-upmap-items",
+                        "pgid": f"{io.pool_id}.{ps}",
+                        "pairs": pairs})
+                    assert code == 0, rs
+                await c.wait_epoch(epoch0 + 1)
+                await c.client.wait_clean(timeout=60)
+                for oid, data in payloads.items():
+                    assert await io.read(oid) == data, oid
+
+        run(go())
