@@ -100,6 +100,9 @@ declare(
            see_also=("osd_max_pg_log_entries",)),
     Option("osd_recovery_max_active", int, 4, LEVEL_ADVANCED,
            "concurrent recovery reconciliations per osd", min=1),
+    Option("mon_target_pg_per_osd", int, 100, LEVEL_ADVANCED,
+           "target PG replicas per OSD driving pg_autoscaler "
+           "recommendations (reference mon_target_pg_per_osd)", min=1),
     Option("osd_ec_extent_cache_bytes", int, 32 * 1024 * 1024, LEVEL_ADVANCED,
            "primary-side cache of recently written EC stripe ranges so "
            "hot RMW overwrites skip the shard read (ExtentCache role, "

@@ -723,16 +723,28 @@ class Monitor:
         — see the to_thread note there)."""
         from ceph_tpu.osd.types import pg_t as _pg_t
 
-        cache = getattr(self, "_primaries_cache", None)
-        if cache is not None and cache[0] == om.epoch:
-            return cache[1]
-        out: dict[tuple[int, int], int] = {}
-        for pid, pool in om.pools.items():
-            for ps in range(pool.pg_num):
+        cache_epoch, out, seen = getattr(
+            self, "_primaries_cache", (None, {}, set()))
+        if cache_epoch != om.epoch:
+            out, seen = {}, set()
+            self._primaries_cache = (om.epoch, out, seen)
+        # memoize per epoch, computing only the pgids actually present
+        # in the stats book (bounded by reports, not pools x pg_num) —
+        # lazily, so pgids whose first report lands mid-epoch still
+        # resolve; `seen` keeps warm calls near-O(1)
+        book = getattr(self, "_pg_stats", {}) or {}
+        if len(seen) != len(book):
+            for pgid in book:
+                if pgid in seen:
+                    continue
+                seen.add(pgid)
+                pid_s, ps_s = pgid.split(".")
+                pid, ps = int(pid_s), int(ps_s)
+                if pid not in om.pools:
+                    continue
                 _u, _up, _a, primary = om.pg_to_up_acting_osds(
                     _pg_t(pid, ps), folded=True)
                 out[(pid, ps)] = primary
-        self._primaries_cache = (om.epoch, out)
         return out
 
     def _health_checks(self, pgsum: dict | None = None) -> dict:
@@ -1050,6 +1062,44 @@ class Monitor:
                     "weight": weight,
                 })
                 return 0, f"reweighted {name} to {cmd['weight']}", b""
+            if prefix == "osd pool autoscale-status":
+                # the pg_autoscaler mgr module's sizing math, advisory
+                # (reference src/pybind/mgr/pg_autoscaler: ideal pg
+                # count ~ osds * mon_target_pg_per_osd / size, rounded
+                # to a power of two; applying a change needs pg
+                # splitting, which is out of scope — NEW_PG_NUM is a
+                # recommendation, exactly what the module surfaces)
+                om2 = self.osdmap
+                target = self.conf["mon_target_pg_per_osd"]
+
+                def _eligible(pool) -> int:
+                    rule = om2.crush.rules.get(pool.crush_rule)
+                    cls = getattr(rule, "device_class", None)
+                    n = sum(
+                        1 for o in range(om2.max_osd)
+                        if om2.exists(o) and not om2.is_out(o)
+                        and (cls is None
+                             or om2.crush.device_classes.get(o) == cls)
+                    )
+                    return n or 1
+
+                rows = []
+                for pid, pool in sorted(om2.pools.items()):
+                    n_in = _eligible(pool)
+                    ideal = max(1, n_in * target // max(1, pool.size))
+                    # nearest power of two, min 1
+                    p2 = 1 << max(0, ideal.bit_length() - 1)
+                    if ideal - p2 > (p2 * 2) - ideal:
+                        p2 *= 2
+                    rows.append({
+                        "pool": om2.pool_names.get(pid, str(pid)),
+                        "pool_id": pid,
+                        "size": pool.size,
+                        "pg_num": pool.pg_num,
+                        "new_pg_num": p2,
+                        "would_adjust": p2 != pool.pg_num,
+                    })
+                return 0, "", json.dumps(rows).encode()
             if prefix == "health":
                 h = self._health_checks()
                 return 0, h["status"], json.dumps(h).encode()

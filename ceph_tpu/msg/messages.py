@@ -837,6 +837,7 @@ class MOSDPGInfo(Message):
         from_osd: int = 0, last_update=None, log_tail=None,
         entries: list[bytes] | None = None,
         objects: list[tuple[str, bytes]] | None = None, epoch: int = 0,
+        past_acting: bytes = b"",
     ):
         from ceph_tpu.osd.pglog import ZERO
 
@@ -846,6 +847,9 @@ class MOSDPGInfo(Message):
         self.entries = entries or []
         self.objects = objects or []
         self.epoch = epoch
+        # json chain of previous acting sets this member witnessed
+        # (PastIntervals sharing via pg info, newest last)
+        self.past_acting = past_acting
 
     def encode_payload(self, enc):
         enc.u64(self.tid)
@@ -861,6 +865,7 @@ class MOSDPGInfo(Message):
             enc.str_(oid)
             enc.bytes_(v)
         enc.u32(self.epoch)
+        enc.bytes_(self.past_acting)
 
     @classmethod
     def decode_payload(cls, dec):
@@ -871,7 +876,8 @@ class MOSDPGInfo(Message):
         lt = _dec_ev(dec)
         entries = [dec.bytes_() for _ in range(dec.u32())]
         objects = [(dec.str_(), dec.bytes_()) for _ in range(dec.u32())]
-        return cls(tid, pg, shard, from_osd, lu, lt, entries, objects, dec.u32())
+        return cls(tid, pg, shard, from_osd, lu, lt, entries, objects,
+                   dec.u32(), dec.bytes_())
 
 
 class MOSDPGLog(Message):

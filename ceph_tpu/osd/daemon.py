@@ -655,9 +655,25 @@ class OSDDaemon:
             self._pg_logs[c] = lg
         return lg
 
-    def _next_version(self, c: coll_t) -> eversion_t:
+    def _next_version(
+        self, c: coll_t, epoch: int | None = None
+    ) -> eversion_t | None:
+        """``epoch`` must be the op's ADMISSION epoch (captured when the
+        primary check passed): maps can advance mid-op, and minting with
+        the then-current epoch would let two daemons that were each
+        primary under different maps stamp the SAME eversion onto
+        different payloads — an undetectable mixed-content write.
+
+        Returns None when the pg log already holds an entry from a
+        NEWER epoch (e.g. adopted from the next interval's primary):
+        this op must be re-admitted under the newer map (caller replies
+        EAGAIN) — minting into a foreign epoch could collide with that
+        primary's versions."""
         lu = self._pg_log(c).info.last_update
-        return eversion_t(self.epoch, lu.version + 1)
+        e = self.epoch if epoch is None else epoch
+        if lu.epoch > e:
+            return None
+        return eversion_t(e, lu.version + 1)
 
     def _object_version(self, c: coll_t, o: ghobject_t) -> eversion_t:
         try:
@@ -791,7 +807,9 @@ class OSDDaemon:
             return
         # placement-inputs precheck: epochs minted by non-placement
         # changes (pool create, profiles, config) can't move any pg —
-        # skip the per-pg mapping work entirely
+        # skip the per-pg mapping work entirely.  CRUSH weights are a
+        # placement input too (osd crush reweight!), compared via the
+        # per-bucket item weights.
         if (
             old_map.osd_state == new_map.osd_state
             and old_map.osd_weight == new_map.osd_weight
@@ -799,6 +817,15 @@ class OSDDaemon:
             and old_map.pg_upmap == new_map.pg_upmap
             and old_map.pg_upmap_items == new_map.pg_upmap_items
             and old_map.pg_temp == new_map.pg_temp
+            and len(old_map.crush.buckets) == len(new_map.crush.buckets)
+            and all(
+                bid in new_map.crush.buckets
+                and b.items == new_map.crush.buckets[bid].items
+                and b.item_weights == new_map.crush.buckets[bid].item_weights
+                for bid, b in old_map.crush.buckets.items()
+            )
+            and old_map.crush.rules == new_map.crush.rules
+            and old_map.crush.device_classes == new_map.crush.device_classes
             and all(
                 p.pg_num == new_map.pools[pid].pg_num
                 and p.crush_rule == new_map.pools[pid].crush_rule
@@ -832,7 +859,9 @@ class OSDDaemon:
         if changed:
             self._save_past_acting()
 
-    _META_COLL = coll_t(0, 0, -1)   # pool ids start at 1: reserved
+    # the store layer's reserved meta collection (objectstore.py:37,
+    # pool -1 can never collide with a real pool)
+    from ceph_tpu.store.objectstore import META_COLL as _META_COLL
     _META_OID = "osd_past_intervals"
 
     def _load_past_acting(self) -> None:
@@ -1044,6 +1073,9 @@ class OSDDaemon:
         if primary != self.id:
             # client raced a map change; tell it to retry on a newer map
             return MOSDOpReply(tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
+        # versions mint under the epoch primacy was verified at, even
+        # if the map advances mid-op (see _next_version)
+        admit_epoch = self.epoch
         if any(o.op in (OP_WATCH, OP_UNWATCH, OP_NOTIFY) for o in msg.ops):
             return await self._watch_notify_vector(pool, pg, msg)
         if msg.is_write():
@@ -1054,9 +1086,11 @@ class OSDDaemon:
                 if pool.is_erasure():
                     ec = self._ec_for(pool)
                     return await self._ec_write_vector(
-                        pool, pg, acting, msg, ec, self._sinfo(ec)
+                        pool, pg, acting, msg, ec, self._sinfo(ec),
+                        admit_epoch,
                     )
-                return await self._rep_write_vector(pool, pg, acting, msg)
+                return await self._rep_write_vector(
+                    pool, pg, acting, msg, admit_epoch)
         if pool.is_erasure():
             ec = self._ec_for(pool)
             return await self._ec_read_vector(
@@ -1112,6 +1146,7 @@ class OSDDaemon:
         guarded = prev_version is not None
         parent_sp = self._op_span.get()
         waits = []
+        local: list[tuple[int, bytes]] = []
         estale = False
         for shard, osd in live:
             payload = shard_payloads.get(shard, b"")
@@ -1123,12 +1158,7 @@ class OSDDaemon:
                 if guarded and self._object_version(c, o) != prev_version:
                     estale = True
                     continue
-                await self._apply_shard_write_async(
-                    pool, pg, shard, oid, payload, attrs, version=version,
-                    off=off, truncate=truncate, rmattrs=rmattrs,
-                    reqid=reqid, clone_snap=clone_snap,
-                    clone_snaps=clone_snaps,
-                )
+                local.append((shard, payload))
             else:
                 tid = next(self._tids)
                 waits.append(self._traced_sub_op(
@@ -1151,6 +1181,19 @@ class OSDDaemon:
                     first_err = rep.result
         if first_err:
             return first_err
+        if not estale:
+            # the primary's OWN shard applies only after every remote
+            # accepted: a demoted primary whose fan-out the cluster
+            # rejects must not poison its local shard with a write
+            # nobody else has (that one divergent shard would cost the
+            # pg its availability margin)
+            for shard, payload in local:
+                await self._apply_shard_write_async(
+                    pool, pg, shard, oid, payload, attrs, version=version,
+                    off=off, truncate=truncate, rmattrs=rmattrs,
+                    reqid=reqid, clone_snap=clone_snap,
+                    clone_snaps=clone_snaps,
+                )
         if estale:
             if _retried:
                 return -errno.EAGAIN
@@ -1181,7 +1224,7 @@ class OSDDaemon:
         return 0
 
     async def _ec_write_vector(
-        self, pool, pg, acting, msg, ec, sinfo
+        self, pool, pg, acting, msg, ec, sinfo, admit_epoch: int | None = None
     ) -> MOSDOpReply:
         """EC write-class op vector: full writes encode directly; partial
         writes (write/append/zero/truncate) run the read-modify-write
@@ -1196,7 +1239,8 @@ class OSDDaemon:
         if any(o.op == OP_DELETE for o in ops):
             if len(ops) != 1:
                 return MOSDOpReply(tid=msg.tid, result=-errno.EINVAL, epoch=self.epoch)
-            return await self._ec_delete(pool, pg, acting, msg, snapc)
+            return await self._ec_delete(
+                pool, pg, acting, msg, snapc, admit_epoch)
         lv = self._ec_live(pool, acting)
         if lv is None:
             return MOSDOpReply(tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
@@ -1345,7 +1389,11 @@ class OSDDaemon:
             else:
                 return MOSDOpReply(tid=msg.tid, result=-errno.EOPNOTSUPP, epoch=self.epoch)
 
-        version = self._next_version(self._shard_coll(pool, pg, my_shard))
+        version = self._next_version(
+            self._shard_coll(pool, pg, my_shard), admit_epoch)
+        if version is None:
+            return MOSDOpReply(
+                tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
         base_attrs = {
             SIZE_ATTR: str(size).encode(),
             VERSION_ATTR: _v_bytes(version),
@@ -1913,7 +1961,8 @@ class OSDDaemon:
             return None, None, -rep.result
         return rep.data, rep.attrs, 0
 
-    async def _ec_delete(self, pool, pg, acting, msg, snapc=None) -> MOSDOpReply:
+    async def _ec_delete(self, pool, pg, acting, msg, snapc=None,
+                         admit_epoch: int | None = None) -> MOSDOpReply:
         my_shard = next(
             (s for s, o in enumerate(acting) if o == self.id), None
         )
@@ -1955,7 +2004,11 @@ class OSDDaemon:
                         tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
                 live, _ = lv
                 version = self._next_version(
-                    self._shard_coll(pool, pg, my_shard))
+                    self._shard_coll(pool, pg, my_shard), admit_epoch)
+                if version is None:
+                    return MOSDOpReply(
+                        tid=msg.tid, result=-errno.EAGAIN,
+                        epoch=self.epoch)
                 wo_attrs = {
                     SIZE_ATTR: b"0",
                     VERSION_ATTR: _v_bytes(version),
@@ -1969,7 +2022,11 @@ class OSDDaemon:
                 )
                 return MOSDOpReply(tid=msg.tid, result=r, epoch=self.epoch)
         self._extent_cache_drop(pool.id, msg.oid)
-        version = self._next_version(self._shard_coll(pool, pg, my_shard))
+        version = self._next_version(
+            self._shard_coll(pool, pg, my_shard), admit_epoch)
+        if version is None:
+            return MOSDOpReply(
+                tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
         waits = []
         for shard, osd in enumerate(acting):
             if osd == CRUSH_ITEM_NONE:
@@ -1998,12 +2055,22 @@ class OSDDaemon:
         result = 0
         try:
             await FAULTS.check("osd.ec_sub_write_apply")
+            if msg.version > ZERO and msg.version.epoch < self.epoch:
+                # a sub-write minted under an older map (the version
+                # carries the sender's ADMISSION epoch): accept it only
+                # if the sender still leads this pg in OUR map — a
+                # demoted primary's in-flight fan-out must not land
+                # (the reference's require_same_or_newer_map gate)
+                _u, _up, _a, cur_primary = self.osdmap.pg_to_up_acting_osds(
+                    pg_t(msg.pg.pool, msg.pg.ps), folded=True)
+                if msg.from_osd != cur_primary:
+                    result = -errno.ESTALE
             skip = False
             if msg.guard > ZERO:
                 c = self._shard_coll(pool, msg.pg, msg.shard)
                 o = ghobject_t(msg.oid, shard=msg.shard)
                 skip = self._object_version(c, o) > msg.guard
-            if msg.guarded and not skip:
+            if msg.guarded and not skip and result == 0:
                 c = self._shard_coll(pool, msg.pg, msg.shard)
                 o = ghobject_t(msg.oid, shard=msg.shard)
                 if self._object_version(c, o) != msg.prev_version:
@@ -2415,7 +2482,8 @@ class OSDDaemon:
                 lg.trim(t, self._log_keep)
         return t
 
-    async def _rep_write_vector(self, pool, pg, acting, msg) -> MOSDOpReply:
+    async def _rep_write_vector(self, pool, pg, acting, msg,
+                                admit_epoch: int | None = None) -> MOSDOpReply:
         c = self._shard_coll(pool, pg, NO_SHARD)
         o = ghobject_t(msg.oid)
         lg = self._pg_log(c)
@@ -2442,7 +2510,10 @@ class OSDDaemon:
             return MOSDOpReply(tid=msg.tid, result=-resolved, epoch=self.epoch)
         effects, size, delete, call_outs = resolved
         effects = cow + effects
-        version = self._next_version(c)
+        version = self._next_version(c, admit_epoch)
+        if version is None:
+            return MOSDOpReply(
+                tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
         attrs = {
             SIZE_ATTR: str(size).encode(),
             VERSION_ATTR: _v_bytes(version),
@@ -2574,7 +2645,7 @@ class OSDDaemon:
             ]
         return [(NO_SHARD, o) for o in acting if o != CRUSH_ITEM_NONE]
 
-    async def _recover_pg(self, pool: PgPool, pg: pg_t, acting: list[int]) -> None:
+    async def _recover_pg(self, pool: PgPool, pg: pg_t, acting: list[int]) -> bool:
         """Peering-lite + recovery for one PG this OSD leads.
 
         1. collect pg_info from every acting member (MOSDPGQuery);
@@ -2608,6 +2679,34 @@ class OSDDaemon:
                 )
             except (OSError, asyncio.TimeoutError, ConnectionError):
                 continue  # unreachable; next map change retries
+
+        # merge peers' witnessed interval chains into ours
+        # (PastIntervals sharing via pg info): a member that joined in
+        # a later interval learns the older homes it never saw
+        import json as _json
+
+        def _merge_chain(raw: bytes) -> bool:
+            if not raw:
+                return False
+            try:
+                chain = _json.loads(raw)
+            except ValueError:
+                return False
+            hist = self._past_acting.setdefault((pg.pool, pg.ps), [])
+            changed = False
+            for a in chain:
+                if a != acting and a not in hist:
+                    hist.append(a)
+                    del hist[:-16]
+                    changed = True
+            return changed
+
+        merged = False
+        for info in peer_infos.values():
+            merged |= _merge_chain(getattr(info, "past_acting", b""))
+        if merged:
+            self._save_past_acting()
+            prior = self._prior_pairs(pool, pg, pairs)
 
         pre_adopt_lu = lg.info.last_update
         ahead = [
@@ -2662,10 +2761,30 @@ class OSDDaemon:
                 (my_shard, self.id): set(objs)
             }
             lus = {(my_shard, self.id): pre_adopt_lu}
-            prior_sets = [
+            worklist = [
                 ((s, o), None) for s, o in prior
             ] + [(k, i) for k, i in peer_infos.items()]
-            for (s, o), info in prior_sets:
+            chain_grew = False
+            queried: set[tuple[int, int]] = {(my_shard, self.id)}
+            qi = 0
+            while qi < len(worklist):
+                (s, o), info = worklist[qi]
+                qi += 1
+                if (s, o) in queried:
+                    continue
+                queried.add((s, o))
+                if o == self.id:
+                    # a past interval where WE held a different shard:
+                    # serve the listing locally (querying self raises)
+                    try:
+                        lists[(s, o)] = set(
+                            self._local_objects(pool, pg, s))
+                    except FileNotFoundError:
+                        continue
+                    lus[(s, o)] = self._pg_log(
+                        self._shard_coll(pool, pg, s)).info.last_update
+                    objs |= lists[(s, o)]
+                    continue
                 try:
                     full = await self._pg_query(
                         pool, pg, s, o, since=lg.info.last_update,
@@ -2679,6 +2798,13 @@ class OSDDaemon:
                     else full.last_update
                 )
                 objs |= lists[(s, o)]
+                if _merge_chain(getattr(full, "past_acting", b"")):
+                    # chain-follow: the old home knew an even older one
+                    chain_grew = True
+                    prior = self._prior_pairs(pool, pg, pairs)
+                    for pair in prior:
+                        if pair not in queried:
+                            worklist.append((pair, None))
                 if info is None and full.last_update > lg.info.last_update:
                     # adopt the prior member's log delta so ops from
                     # the foreign interval (e.g. DELETEs) replay here
@@ -2695,6 +2821,8 @@ class OSDDaemon:
                     lg.trim(t2, self._log_keep)
                     if not t2.empty():
                         self.store.queue_transaction(t2)
+            if chain_grew:
+                self._save_past_acting()  # one write after the drain
             auth = max(lus, key=lambda k: lus[k])
             strays = objs - lists[auth]
         else:
@@ -2739,7 +2867,7 @@ class OSDDaemon:
         self, pool: PgPool, pg: pg_t, pairs: list[tuple[int, int]], oid: str,
         stray: bool = False, have_lock: bool = False,
         prior_pairs: list[tuple[int, int]] | None = None,
-    ) -> None:
+    ) -> bool:
         """Bring one object to its newest version on every acting
         member: replay deletes, remove strays, reconstruct
         stale/missing shards from the members holding the newest
@@ -2860,8 +2988,12 @@ class OSDDaemon:
             # the reference's divergent-entry rollback (PGLog merge_log)
             # expressed at shard granularity.  The rolled-back write's
             # log entries are stripped so a client retry re-applies it.
+            # rollback candidates come from the CURRENT interval only:
+            # prior-interval members hold old versions by definition,
+            # and letting them vote would roll back writes whose newer
+            # copies merely sit on temporarily-down current members
             by_v: dict = {}
-            for (s, o), (p, v, _a) in all_state.items():
+            for (s, o), (p, v, _a) in state.items():
                 if p:
                     by_v.setdefault(v, []).append((s, o))
             candidates = [v for v, lst in by_v.items() if len(lst) >= k]
@@ -2883,7 +3015,7 @@ class OSDDaemon:
                 if not p or v != v_star
             ]
             src_attrs = next(
-                a for (s, o), (p, v, a) in all_state.items()
+                a for (s, o), (p, v, a) in state.items()
                 if p and v == v_star
             )
             force_push = True
@@ -3023,10 +3155,16 @@ class OSDDaemon:
                 except (FileNotFoundError, KeyError):
                     v = b""
                 objects.append((name, v))
+        import json as _json
+
+        if not self._past_acting_loaded:
+            self._load_past_acting()
+        chain = self._past_acting.get((msg.pg.pool, msg.pg.ps), [])
         await msg.conn.send_message(MOSDPGInfo(
             tid=msg.tid, pg=msg.pg, shard=msg.shard, from_osd=self.id,
             last_update=lg.info.last_update, log_tail=lg.info.log_tail,
             entries=entries, objects=objects, epoch=self.epoch,
+            past_acting=_json.dumps(chain).encode() if chain else b"",
         ))
 
     async def _handle_pg_log(self, msg: MOSDPGLog) -> None:

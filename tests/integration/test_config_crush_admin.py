@@ -102,3 +102,22 @@ class TestCrushAdmin:
                 assert code != 0
 
         run(go())
+
+
+class TestAutoscaleStatus:
+    def test_recommendations(self):
+        async def go():
+            async with Cluster(n_osds=4) as c:
+                await c.client.pool_create("a", pg_num=4, size=3)
+                await c.client.pool_create("b", pg_num=256, size=3)
+                code, _, data = await c.client.command(
+                    {"prefix": "osd pool autoscale-status"})
+                assert code == 0
+                rows = {r["pool"]: r for r in json.loads(data)}
+                # 4 osds * 100 / 3 = 133 -> 128
+                assert rows["a"]["new_pg_num"] == 128
+                assert rows["a"]["would_adjust"]
+                assert rows["b"]["new_pg_num"] == 128
+                assert rows["b"]["would_adjust"]
+
+        run(go())

@@ -94,3 +94,44 @@ class TestFullRemapRecovery:
                     assert await io.read(oid) == data, oid
 
         run(go())
+
+    def test_chained_double_remap(self):
+        """Two quick remaps: the final home never saw the FIRST interval
+        — it must learn it from the middle home's shared chain
+        (PastIntervals propagation via pg info)."""
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                await c.client.pool_create("pc", pg_num=1, size=2)
+                io = c.client.ioctx("pc")
+                data = b"chained " * 2000
+                await io.write_full("obj", data)
+                await c.client.wait_clean(timeout=30)
+
+                om = c.client.osdmap
+                from ceph_tpu.osd.types import pg_t
+
+                _, _, acting0, _ = om.pg_to_up_acting_osds(
+                    pg_t(io.pool_id, 0), folded=True)
+                others = [o for o in range(6) if o not in acting0]
+                mid, final = others[:2], others[2:4]
+                # remap 1: acting0 -> mid ; remap 2 immediately: ->
+                # final.  upmap pairs always map FROM the raw CRUSH set
+                # (items replace wholesale), so both rounds zip from
+                # acting0.
+                for dest in (mid, final):
+                    omx = c.client.osdmap
+                    pairs = " ".join(
+                        f"{frm} {to}" for frm, to in zip(acting0, dest))
+                    code, rs, _ = await c.client.command({
+                        "prefix": "osd pg-upmap-items",
+                        "pgid": f"{io.pool_id}.0", "pairs": pairs})
+                    assert code == 0, rs
+                    epoch = omx.epoch
+                    await c.wait_epoch(epoch + 1)
+                await c.client.wait_clean(timeout=60)
+                _, _, a2, _ = c.client.osdmap.pg_to_up_acting_osds(
+                    pg_t(io.pool_id, 0), folded=True)
+                assert set(a2) == set(final), (a2, final)
+                assert await io.read("obj") == data
+
+        run(go())

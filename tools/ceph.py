@@ -105,6 +105,9 @@ async def amain(args, extra: list[str]) -> int:
                 "prefix": "config rm", "who": extra[1], "name": extra[2]})
         elif verb == "config" and extra[:1] == ["dump"]:
             code, rs, data = await client.command({"prefix": "config dump"})
+        elif verb == "osd" and extra[:2] == ["pool", "autoscale-status"]:
+            code, rs, data = await client.command(
+                {"prefix": "osd pool autoscale-status"})
         elif verb == "osd" and extra[:2] == ["crush", "reweight"]:
             code, rs, data = await client.command({
                 "prefix": "osd crush reweight", "name": extra[2],
