@@ -241,3 +241,6 @@ _hello = register_class("hello")
 def _hello_say(ctx: MethodContext, indata: bytes) -> bytes:
     who = indata.decode() or "world"
     return f"Hello, {who}!".encode()
+
+
+from . import rgw as _cls_rgw  # noqa: E402,F401  (registers the rgw class)

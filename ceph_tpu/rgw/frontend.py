@@ -1,0 +1,417 @@
+"""S3 REST frontend — asyncio HTTP server + op dispatch.
+
+Twin of the reference's beast/asio frontend (rgw_asio_frontend.cc) and
+the REST op dispatch in rgw_op.cc / rgw_rest_s3.cc, for path-style S3:
+
+    GET    /                       ListBuckets
+    PUT    /bucket                 CreateBucket
+    DELETE /bucket                 DeleteBucket
+    GET    /bucket?list-type=2     ListObjectsV2
+    GET    /bucket?uploads         ListMultipartUploads (stub: empty)
+    PUT    /bucket/key             PutObject | UploadPart (partNumber&uploadId)
+    GET    /bucket/key             GetObject (Range) | ListParts (uploadId)
+    HEAD   /bucket/key             HeadObject
+    DELETE /bucket/key             DeleteObject | AbortMultipart (uploadId)
+    POST   /bucket/key?uploads     CreateMultipartUpload
+    POST   /bucket/key?uploadId=X  CompleteMultipartUpload
+
+Every request is SigV4-authenticated against the user records in the
+store (rgw_auth_s3.cc); errors render as S3 XML error bodies.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+from . import sigv4
+from .store import RGWError, RGWStore, entag_strip
+
+log = logging.getLogger("ceph_tpu.rgw")
+
+XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+MAX_BODY = 5 * 2**30
+
+
+class _HTTPRequest:
+    def __init__(self, method, path, query, headers, body):
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers  # lowercased keys
+        self.body = body
+        self.params = dict(urllib.parse.parse_qsl(
+            query, keep_blank_values=True))
+        self.uid = None  # set by auth
+
+
+def _xml(tag: str, *children, text: str | None = None) -> ET.Element:
+    el = ET.Element(tag)
+    if text is not None:
+        el.text = text
+    for c in children:
+        el.append(c)
+    return el
+
+
+def _render(root: ET.Element) -> bytes:
+    root.set("xmlns", XMLNS)
+    return (
+        b'<?xml version="1.0" encoding="UTF-8"?>'
+        + ET.tostring(root, encoding="utf-8")
+    )
+
+
+_STATUS = {
+    200: "OK", 204: "No Content", 206: "Partial Content",
+    400: "Bad Request", 403: "Forbidden", 404: "Not Found",
+    405: "Method Not Allowed", 409: "Conflict",
+    416: "Range Not Satisfiable", 500: "Internal Server Error",
+    501: "Not Implemented",
+}
+
+
+class S3Frontend:
+    def __init__(self, store: RGWStore, host: str = "127.0.0.1", port: int = 0):
+        self.store = store
+        self.host, self.port = host, port
+        self._server: asyncio.AbstractServer | None = None
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._serve, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("rgw: listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # -- HTTP plumbing -------------------------------------------------
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                req = await self._read_request(reader)
+                if req is None:
+                    break
+                status, headers, body = await self._handle(req)
+                await self._respond(writer, status, headers, body,
+                                    head_only=req.method == "HEAD")
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _read_request(self, reader) -> _HTTPRequest | None:
+        try:
+            line = await reader.readline()
+        except (ConnectionError, OSError):
+            return None
+        if not line:
+            return None
+        try:
+            method, target, _version = line.decode().split()
+        except ValueError:
+            return None
+        headers: dict[str, str] = {}
+        while True:
+            hline = await reader.readline()
+            if hline in (b"\r\n", b"\n", b""):
+                break
+            name, _, val = hline.decode().partition(":")
+            headers[name.strip().lower()] = val.strip()
+        try:
+            length = int(headers.get("content-length", "0"))
+        except ValueError:
+            return None
+        if length > MAX_BODY or length < 0:
+            return None
+        body = await reader.readexactly(length) if length else b""
+        parsed = urllib.parse.urlsplit(target)
+        return _HTTPRequest(method.upper(), parsed.path, parsed.query,
+                            headers, body)
+
+    async def _respond(self, writer, status: int, headers: dict, body: bytes,
+                       head_only: bool = False) -> None:
+        headers.setdefault("content-length", str(len(body)))
+        lines = [f"HTTP/1.1 {status} {_STATUS.get(status, '?')}\r\n"]
+        lines += [f"{k}: {v}\r\n" for k, v in headers.items()]
+        lines.append("\r\n")
+        writer.write("".join(lines).encode())
+        if body and not head_only:
+            writer.write(body)
+        await writer.drain()
+
+    # -- auth + dispatch -----------------------------------------------
+
+    def _error(self, e: RGWError) -> tuple[int, dict, bytes]:
+        body = _render(_xml(
+            "Error",
+            _xml("Code", text=e.code),
+            _xml("Message", text=str(e)),
+        ))
+        return e.status, {"content-type": "application/xml"}, body
+
+    async def _authenticate(self, req: _HTTPRequest) -> None:
+        auth_hdr = req.headers.get("authorization", "")
+        if not auth_hdr:
+            raise RGWError("AccessDenied", 403, "anonymous access denied")
+        try:
+            parsed = sigv4.parse_authorization(auth_hdr)
+            user = await self.store.get_user_by_access_key(parsed.access_key)
+            if user is None:
+                raise RGWError("InvalidAccessKeyId", 403, parsed.access_key)
+            sigv4.verify(req.method, req.path, req.query, req.headers,
+                         req.body, user["secret_key"])
+        except sigv4.SigV4Error as e:
+            raise RGWError(e.code, 403, str(e))
+        req.uid = user["uid"]
+
+    async def _handle(self, req: _HTTPRequest) -> tuple[int, dict, bytes]:
+        try:
+            await self._authenticate(req)
+            parts = req.path.lstrip("/").split("/", 1)
+            bucket_name = urllib.parse.unquote(parts[0])
+            key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+            if not bucket_name:
+                return await self._service(req)
+            if not key:
+                return await self._bucket(req, bucket_name)
+            return await self._object(req, bucket_name, key)
+        except RGWError as e:
+            return self._error(e)
+        except Exception:
+            log.exception("rgw: internal error on %s %s", req.method, req.path)
+            return self._error(RGWError("InternalError", 500, "internal"))
+
+    # -- service ops ----------------------------------------------------
+
+    async def _service(self, req) -> tuple[int, dict, bytes]:
+        if req.method != "GET":
+            raise RGWError("MethodNotAllowed", 405, req.method)
+        buckets = await self.store.list_buckets(req.uid)
+        root = _xml(
+            "ListAllMyBucketsResult",
+            _xml("Owner", _xml("ID", text=req.uid)),
+            _xml("Buckets", *[
+                _xml("Bucket",
+                     _xml("Name", text=b["name"]),
+                     _xml("CreationDate", text=b["created"]))
+                for b in buckets
+            ]),
+        )
+        return 200, {"content-type": "application/xml"}, _render(root)
+
+    # -- bucket ops ------------------------------------------------------
+
+    async def _bucket(self, req, name: str) -> tuple[int, dict, bytes]:
+        if req.method == "PUT":
+            placement = req.headers.get("x-rgw-placement")  # extension
+            await self.store.create_bucket(name, req.uid, placement)
+            return 200, {"location": f"/{name}"}, b""
+        if req.method == "DELETE":
+            await self.store.delete_bucket(name, req.uid)
+            return 204, {}, b""
+        if req.method == "HEAD":
+            await self.store.get_bucket(name)
+            return 200, {}, b""
+        if req.method == "GET":
+            bucket = await self.store.get_bucket(name)
+            if "uploads" in req.params:
+                root = _xml("ListMultipartUploadsResult",
+                            _xml("Bucket", text=name))
+                return 200, {"content-type": "application/xml"}, _render(root)
+            return await self._list_objects_v2(req, bucket)
+        raise RGWError("MethodNotAllowed", 405, req.method)
+
+    async def _list_objects_v2(self, req, bucket) -> tuple[int, dict, bytes]:
+        prefix = req.params.get("prefix", "")
+        delimiter = req.params.get("delimiter", "")
+        max_keys = _int_param(req.params.get("max-keys", "1000"), "max-keys")
+        token = req.params.get("continuation-token", "")
+        start_after = req.params.get("start-after", "")
+        marker = token or start_after
+        res = await self.store.list_objects(
+            bucket, prefix=prefix, delimiter=delimiter,
+            marker=marker, max_keys=max_keys)
+        children = [
+            _xml("Name", text=bucket["name"]),
+            _xml("Prefix", text=prefix),
+            _xml("KeyCount", text=str(
+                len(res["entries"]) + len(res["common_prefixes"]))),
+            _xml("MaxKeys", text=str(max_keys)),
+            _xml("IsTruncated", text="true" if res["truncated"] else "false"),
+        ]
+        if res["truncated"]:
+            children.append(
+                _xml("NextContinuationToken", text=res["next_marker"]))
+        for key, meta in res["entries"]:
+            children.append(_xml(
+                "Contents",
+                _xml("Key", text=key),
+                _xml("LastModified", text=meta.get("mtime", "")),
+                _xml("ETag", text=f"\"{meta.get('etag', '')}\""),
+                _xml("Size", text=str(meta.get("size", 0))),
+            ))
+        for cp in res["common_prefixes"]:
+            children.append(_xml("CommonPrefixes", _xml("Prefix", text=cp)))
+        root = _xml("ListBucketResult", *children)
+        return 200, {"content-type": "application/xml"}, _render(root)
+
+    # -- object ops ------------------------------------------------------
+
+    async def _object(self, req, bucket_name: str, key: str):
+        bucket = await self.store.get_bucket(bucket_name)
+        if req.method == "PUT":
+            if "partnumber" in {k.lower() for k in req.params}:
+                return await self._upload_part(req, bucket, key)
+            ct = req.headers.get("content-type", "binary/octet-stream")
+            meta = await self.store.put_object(bucket, key, req.body, ct)
+            return 200, {"etag": f"\"{meta['etag']}\""}, b""
+        if req.method == "POST":
+            if "uploads" in req.params:
+                ct = req.headers.get("content-type", "binary/octet-stream")
+                upload_id = await self.store.initiate_multipart(bucket, key, ct)
+                root = _xml(
+                    "InitiateMultipartUploadResult",
+                    _xml("Bucket", text=bucket_name),
+                    _xml("Key", text=key),
+                    _xml("UploadId", text=upload_id),
+                )
+                return 200, {"content-type": "application/xml"}, _render(root)
+            if "uploadId" in req.params:
+                return await self._complete_multipart(req, bucket, key)
+            raise RGWError("MethodNotAllowed", 405, "POST")
+        if req.method in ("GET", "HEAD"):
+            if "uploadId" in req.params and req.method == "GET":
+                parts = await self.store.list_parts(
+                    bucket, key, req.params["uploadId"])
+                root = _xml(
+                    "ListPartsResult",
+                    _xml("Bucket", text=bucket_name),
+                    _xml("Key", text=key),
+                    _xml("UploadId", text=req.params["uploadId"]),
+                    *[_xml("Part",
+                           _xml("PartNumber", text=str(p["part_number"])),
+                           _xml("ETag", text=f"\"{p['etag']}\""),
+                           _xml("Size", text=str(p["size"])))
+                      for p in parts],
+                )
+                return 200, {"content-type": "application/xml"}, _render(root)
+            return await self._get_object(req, bucket, key)
+        if req.method == "DELETE":
+            if "uploadId" in req.params:
+                await self.store.abort_multipart(
+                    bucket, key, req.params["uploadId"])
+                return 204, {}, b""
+            await self.store.delete_object(bucket, key)
+            return 204, {}, b""
+        raise RGWError("MethodNotAllowed", 405, req.method)
+
+    async def _get_object(self, req, bucket, key):
+        rng = req.headers.get("range", "")
+        meta = await self.store.head_object(bucket, key)
+        size = meta["size"]
+        status = 200
+        off, length = 0, None
+        resp_headers = {}
+        if rng:
+            off, end_incl = _parse_range(rng, size)
+            length = end_incl - off + 1
+            status = 206
+            resp_headers["content-range"] = f"bytes {off}-{end_incl}/{size}"
+        if req.method == "HEAD":
+            body = b""
+            resp_headers["content-length"] = str(
+                length if length is not None else size)
+        else:
+            _meta, body = await self.store.get_object(bucket, key, off, length)
+        resp_headers.update({
+            "etag": f"\"{meta['etag']}\"",
+            "last-modified": meta.get("mtime", ""),
+            "content-type": meta.get("content_type", "binary/octet-stream"),
+            "accept-ranges": "bytes",
+        })
+        return status, resp_headers, body
+
+    async def _upload_part(self, req, bucket, key):
+        params = {k.lower(): v for k, v in req.params.items()}
+        upload_id = params.get("uploadid")
+        if not upload_id:
+            raise RGWError("InvalidArgument", 400, "uploadId required")
+        part_num = _int_param(params.get("partnumber", "0"), "partNumber")
+        etag = await self.store.upload_part(
+            bucket, key, upload_id, part_num, req.body)
+        return 200, {"etag": f"\"{etag}\""}, b""
+
+    async def _complete_multipart(self, req, bucket, key):
+        upload_id = req.params["uploadId"]
+        try:
+            root = ET.fromstring(req.body)
+        except ET.ParseError:
+            raise RGWError("MalformedXML", 400, "bad CompleteMultipartUpload")
+        parts: list[tuple[int, str]] = []
+        for part in root:
+            if not part.tag.endswith("Part"):
+                continue
+            pn = etag = None
+            for child in part:
+                if child.tag.endswith("PartNumber"):
+                    try:
+                        pn = int(child.text)
+                    except (TypeError, ValueError):
+                        raise RGWError("MalformedXML", 400, "bad PartNumber")
+                elif child.tag.endswith("ETag"):
+                    etag = entag_strip(child.text or "")
+            if pn is None or etag is None:
+                raise RGWError("MalformedXML", 400, "Part missing fields")
+            parts.append((pn, etag))
+        meta = await self.store.complete_multipart(bucket, key, upload_id, parts)
+        out = _xml(
+            "CompleteMultipartUploadResult",
+            _xml("Bucket", text=bucket["name"]),
+            _xml("Key", text=key),
+            _xml("ETag", text=f"\"{meta['etag']}\""),
+        )
+        return 200, {"content-type": "application/xml"}, _render(out)
+
+
+def _int_param(value: str, name: str) -> int:
+    try:
+        return int(value)
+    except ValueError:
+        raise RGWError("InvalidArgument", 400, f"bad {name}: {value!r}")
+
+
+def _parse_range(value: str, size: int) -> tuple[int, int]:
+    """'bytes=a-b' (also 'a-' and '-suffix') -> (first, last) inclusive."""
+    if not value.startswith("bytes="):
+        raise RGWError("InvalidRange", 416, value)
+    spec = value[len("bytes="):].split(",")[0].strip()
+    first_s, _, last_s = spec.partition("-")
+    try:
+        if first_s == "":           # suffix: last N bytes
+            n = int(last_s)
+            if n <= 0 or size == 0:
+                raise ValueError
+            return max(0, size - n), size - 1
+        first = int(first_s)
+        last = int(last_s) if last_s else size - 1
+    except ValueError:
+        raise RGWError("InvalidRange", 416, value)
+    if first >= size or first > last:
+        raise RGWError("InvalidRange", 416, value)
+    return first, min(last, size - 1)

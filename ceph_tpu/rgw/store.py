@@ -1,0 +1,559 @@
+"""RGW RADOS driver — bucket/object/multipart layout on RADOS.
+
+Twin of rgw/driver/rados/rgw_rados.cc + rgw_user/rgw_bucket metadata
+handling, reduced to the layout that matters:
+
+- **Users** (rgw_user.cc): omap on ``users.keys`` maps access_key ->
+  {uid, secret_key, display_name}; per-user bucket list on
+  ``user.<uid>`` omap.
+- **Buckets**: global directory omap on ``buckets.dir``; each bucket
+  gets a unique ``bucket_id`` and a ``.dir.<bucket_id>`` index object
+  whose omap holds the entries, mutated ONLY through the in-OSD ``rgw``
+  object class (src/cls/rgw) with the reference's prepare/complete
+  two-phase so index and data never diverge silently.
+- **Objects** (rgw_rados.cc put_obj/get_obj): head object
+  ``<bucket_id>_<key>`` holds the first ``chunk_size`` bytes + a JSON
+  manifest xattr; tails ``<bucket_id>__shadow_<key>.<n>`` hold the
+  rest (the RGWObjManifest idea).  Multipart parts are standalone
+  chains ``<bucket_id>__multipart_<key>.<upload_id>.<part>``; complete
+  stitches them into the head's manifest WITHOUT copying data, exactly
+  like the reference.
+- **Multipart state** (rgw_multi.cc): upload meta object
+  ``mp.<bucket_id>.<key>.<upload_id>`` with one omap row per part.
+
+The index/meta pool must be replicated (omap + cls); data pools may be
+EC — the per-bucket ``placement`` selects the data ioctx.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+import hashlib
+import json
+import os
+import time
+
+from ceph_tpu.client.rados import IoCtx, ObjectOperation, RadosError
+
+USERS_KEYS_OID = "users.keys"
+BUCKETS_DIR_OID = "buckets.dir"
+
+CHUNK_SIZE = 4 * 2**20  # rgw_obj_stripe_size / rgw_max_chunk_size default 4M
+
+
+class RGWError(Exception):
+    """S3-style error: code string + HTTP status."""
+
+    def __init__(self, code: str, status: int, msg: str = ""):
+        super().__init__(msg or code)
+        self.code = code
+        self.status = status
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime())
+
+
+def _md5(data: bytes) -> str:
+    return hashlib.md5(data).hexdigest()
+
+
+class RGWStore:
+    def __init__(self, meta_io: IoCtx, data_pools: dict[str, IoCtx],
+                 default_placement: str | None = None,
+                 chunk_size: int = CHUNK_SIZE):
+        self.meta = meta_io
+        self.data_pools = dict(data_pools)
+        self.default_placement = default_placement or next(iter(data_pools))
+        self.chunk_size = chunk_size
+
+    # -- users (rgw_user.cc) -------------------------------------------
+
+    async def create_user(
+        self, uid: str, display_name: str,
+        access_key: str | None = None, secret_key: str | None = None,
+    ) -> dict:
+        access_key = access_key or os.urandom(10).hex().upper()
+        secret_key = secret_key or os.urandom(20).hex()
+        existing = await self.get_user_by_access_key(access_key)
+        if existing is not None and existing["uid"] != uid:
+            raise RGWError("KeyExists", 409,
+                           f"access key bound to {existing['uid']!r}")
+        info = {
+            "uid": uid, "display_name": display_name,
+            "access_key": access_key, "secret_key": secret_key,
+        }
+        await self.meta.omap_set(USERS_KEYS_OID, {
+            access_key: json.dumps(info).encode(),
+        })
+        await self.meta.omap_set(f"user.{uid}", {"info": json.dumps(info).encode()})
+        return info
+
+    async def get_user_by_access_key(self, access_key: str) -> dict | None:
+        try:
+            got = await self.meta.omap_get_vals_by_keys(
+                USERS_KEYS_OID, [access_key])
+        except RadosError as e:
+            if e.errno == errno.ENOENT:
+                return None
+            raise
+        raw = got.get(access_key)
+        return json.loads(raw) if raw else None
+
+    # -- buckets --------------------------------------------------------
+
+    def _data_io(self, bucket: dict) -> IoCtx:
+        try:
+            return self.data_pools[bucket["placement"]]
+        except KeyError:
+            raise RGWError("InvalidArgument", 400,
+                           f"unknown placement {bucket['placement']!r}")
+
+    def _index_oid(self, bucket: dict) -> str:
+        return f".dir.{bucket['id']}"
+
+    async def _buckets_dir(self) -> dict[str, bytes]:
+        try:
+            return await self.meta.omap_get(BUCKETS_DIR_OID)
+        except RadosError as e:
+            if e.errno == errno.ENOENT:
+                return {}
+            raise
+
+    async def get_bucket(self, name: str) -> dict:
+        raw = (await self._buckets_dir()).get(name)
+        if raw is None:
+            raise RGWError("NoSuchBucket", 404, name)
+        return json.loads(raw)
+
+    async def create_bucket(
+        self, name: str, owner: str, placement: str | None = None,
+    ) -> dict:
+        existing = (await self._buckets_dir()).get(name)
+        if existing is not None:
+            b = json.loads(existing)
+            if b["owner"] != owner:
+                raise RGWError("BucketAlreadyExists", 409, name)
+            raise RGWError("BucketAlreadyOwnedByYou", 409, name)
+        bucket = {
+            "id": os.urandom(8).hex(), "name": name, "owner": owner,
+            "created": _now(),
+            "placement": placement or self.default_placement,
+        }
+        if bucket["placement"] not in self.data_pools:
+            raise RGWError("InvalidArgument", 400,
+                           f"unknown placement {bucket['placement']!r}")
+        await self.meta.execute(
+            self._index_oid(bucket), "rgw", "bucket_init_index")
+        await self.meta.omap_set(BUCKETS_DIR_OID, {
+            name: json.dumps(bucket).encode(),
+        })
+        await self.meta.omap_set(f"user.{owner}", {f"bucket.{name}": b""})
+        return bucket
+
+    async def delete_bucket(self, name: str, owner: str) -> None:
+        bucket = await self.get_bucket(name)
+        stats = await self.bucket_stats(bucket)
+        if stats["count"] > 0:
+            raise RGWError("BucketNotEmpty", 409, name)
+        await self.meta.omap_rm_keys(BUCKETS_DIR_OID, [name])
+        await self.meta.omap_rm_keys(f"user.{owner}", [f"bucket.{name}"])
+        try:
+            await self.meta.remove(self._index_oid(bucket))
+        except RadosError:
+            pass
+
+    async def list_buckets(self, owner: str) -> list[dict]:
+        out = []
+        for name, raw in sorted((await self._buckets_dir()).items()):
+            b = json.loads(raw)
+            if b["owner"] == owner:
+                out.append(b)
+        return out
+
+    async def bucket_stats(self, bucket: dict) -> dict:
+        raw = await self.meta.execute(
+            self._index_oid(bucket), "rgw", "bucket_stats")
+        return json.loads(raw)
+
+    # -- index two-phase (cls_rgw prepare/complete) ---------------------
+
+    async def _index_prepare(self, bucket: dict, key: str, op: str) -> str:
+        tag = os.urandom(8).hex()
+        await self.meta.execute(
+            self._index_oid(bucket), "rgw", "bucket_prepare_op",
+            json.dumps({"tag": tag, "key": key, "op": op}).encode())
+        return tag
+
+    async def _index_complete(
+        self, bucket: dict, key: str, tag: str, op: str, meta: dict | None = None,
+    ) -> None:
+        await self.meta.execute(
+            self._index_oid(bucket), "rgw", "bucket_complete_op",
+            json.dumps({
+                "tag": tag, "key": key, "op": op, "meta": meta or {},
+            }).encode())
+
+    async def _index_abort(self, bucket: dict, key: str, tag: str) -> None:
+        try:
+            await self.meta.execute(
+                self._index_oid(bucket), "rgw", "bucket_abort_op",
+                json.dumps({"tag": tag, "key": key}).encode())
+        except RadosError:
+            pass
+
+    # -- object data layout --------------------------------------------
+
+    def _head_oid(self, bucket: dict, key: str) -> str:
+        return f"{bucket['id']}_{key}"
+
+    def _shadow_prefix(self, bucket: dict, key: str) -> str:
+        # unique per write (the reference's tail tag): an overwrite's new
+        # tails never collide with the old object's, so the old chain
+        # survives intact until the new write fully lands
+        return f"{bucket['id']}__shadow_{key}.{os.urandom(4).hex()}"
+
+    def _part_oid(self, bucket: dict, key: str, upload_id: str, part: int) -> str:
+        return f"{bucket['id']}__multipart_{key}.{upload_id}.{part}"
+
+    async def _write_tails(
+        self, io: IoCtx, tail_prefix: str, data: bytes,
+    ) -> list[list]:
+        """Write the shadow tails (bytes past chunk_size); returns the
+        tail manifest [[oid, size], ...].  The head's first-chunk bytes
+        are written by the caller, atomically with the meta xattr."""
+        cs = self.chunk_size
+        manifest: list[list] = []
+        writes = []
+        for i, off in enumerate(range(cs, len(data), cs)):
+            oid = f"{tail_prefix}.{i}"
+            chunk = data[off:off + cs]
+            manifest.append([oid, len(chunk)])
+            writes.append(io.write_full(oid, chunk))
+        if writes:
+            await asyncio.gather(*writes)
+        return manifest
+
+    async def _read_meta(self, io: IoCtx, head_oid: str) -> dict:
+        try:
+            raw = await io.getxattr(head_oid, "rgw.meta")
+        except RadosError as e:
+            if e.errno == errno.ENOENT:
+                raise RGWError("NoSuchKey", 404, head_oid)
+            raise
+        return json.loads(raw)
+
+    async def _remove_chain(self, io: IoCtx, head_oid: str, meta: dict) -> None:
+        rms = []
+        for oid, _size in meta.get("manifest", []):
+            rms.append(self._remove_quiet(io, oid))
+        rms.append(self._remove_quiet(io, head_oid))
+        await asyncio.gather(*rms)
+
+    @staticmethod
+    async def _remove_quiet(io: IoCtx, oid: str) -> None:
+        try:
+            await io.remove(oid)
+        except RadosError:
+            pass
+
+    # -- object ops (rgw_op.cc RGWPutObj/RGWGetObj/RGWDeleteObj) --------
+
+    async def put_object(
+        self, bucket: dict, key: str, data: bytes,
+        content_type: str = "binary/octet-stream",
+    ) -> dict:
+        io = self._data_io(bucket)
+        head_oid = self._head_oid(bucket, key)
+        tag = await self._index_prepare(bucket, key, "put")
+        try:
+            old_manifest: list[list] = []
+            try:
+                old_manifest = (
+                    await self._read_meta(io, head_oid)).get("manifest", [])
+            except RGWError:
+                pass
+            # write-new-then-drop-old: tails first (fresh tag, no
+            # collision with the old chain), then head data + meta
+            # xattr as ONE atomic compound op, so a crash anywhere
+            # leaves either the intact old object or the complete new
+            # one — never a head/meta mismatch
+            manifest = await self._write_tails(
+                io, self._shadow_prefix(bucket, key), data)
+            meta = {
+                "size": len(data), "etag": _md5(data), "mtime": _now(),
+                "content_type": content_type,
+                "head_size": min(len(data), self.chunk_size),
+                "manifest": manifest,
+            }
+            await io.operate(head_oid, ObjectOperation()
+                             .write_full(data[:self.chunk_size])
+                             .setxattr("rgw.meta", json.dumps(meta).encode()))
+        except BaseException:
+            await self._index_abort(bucket, key, tag)
+            raise
+        await self._index_complete(bucket, key, tag, "put", {
+            "size": meta["size"], "etag": meta["etag"],
+            "mtime": meta["mtime"], "content_type": content_type,
+        })
+        # old tails are garbage now (reference: deferred to rgw gc)
+        new_oids = {oid for oid, _sz in manifest}
+        for oid, _sz in old_manifest:
+            if oid not in new_oids:
+                await self._remove_quiet(io, oid)
+        return meta
+
+    async def head_object(self, bucket: dict, key: str) -> dict:
+        io = self._data_io(bucket)
+        return await self._read_meta(io, self._head_oid(bucket, key))
+
+    async def get_object(
+        self, bucket: dict, key: str, off: int = 0, length: int | None = None,
+    ) -> tuple[dict, bytes]:
+        io = self._data_io(bucket)
+        head_oid = self._head_oid(bucket, key)
+        meta = await self._read_meta(io, head_oid)
+        size = meta["size"]
+        if off >= size and size > 0:
+            raise RGWError("InvalidRange", 416, key)
+        end = size if length is None else min(size, off + length)
+        # segment list: head span + manifest tails, in logical order
+        segments: list[tuple[str, int]] = [(head_oid, meta["head_size"])]
+        segments += [(oid, sz) for oid, sz in meta.get("manifest", [])]
+        reads = []
+        pos = 0
+        for oid, sz in segments:
+            seg_start, seg_end = pos, pos + sz
+            pos = seg_end
+            lo, hi = max(off, seg_start), min(end, seg_end)
+            if lo >= hi:
+                continue
+            reads.append(io.read(oid, off=lo - seg_start, length=hi - lo))
+        chunks = await asyncio.gather(*reads) if reads else []
+        return meta, b"".join(chunks)
+
+    async def delete_object(self, bucket: dict, key: str) -> None:
+        io = self._data_io(bucket)
+        head_oid = self._head_oid(bucket, key)
+        meta = None
+        try:
+            meta = await self._read_meta(io, head_oid)
+        except RGWError:
+            pass  # data already gone — still reconcile the index below
+        tag = await self._index_prepare(bucket, key, "del")
+        try:
+            if meta is not None:
+                await self._remove_chain(io, head_oid, meta)
+        except BaseException:
+            await self._index_abort(bucket, key, tag)
+            raise
+        # completes even when the head was missing: a retried DELETE
+        # whose first attempt died between data removal and index
+        # update settles the orphaned entry (the dir_suggest role);
+        # S3 DELETE of a missing key succeeds either way
+        await self._index_complete(bucket, key, tag, "del")
+
+    async def list_objects(
+        self, bucket: dict, prefix: str = "", delimiter: str = "",
+        marker: str = "", max_keys: int = 1000,
+    ) -> dict:
+        """ListObjectsV2 core: returns {entries, common_prefixes,
+        truncated, next_marker}.  Delimiter folding happens here, like
+        the reference's RGWRados::Bucket::List::list_objects."""
+        entries: list[list] = []
+        prefixes: set[str] = set()
+        truncated = False
+        next_marker = ""
+        cur = marker
+        last_included = marker
+        while True:
+            raw = await self.meta.execute(
+                self._index_oid(bucket), "rgw", "bucket_list",
+                json.dumps({
+                    "marker": cur, "prefix": prefix, "max": 1000,
+                }).encode())
+            page = json.loads(raw)
+            for key, emeta in page["entries"]:
+                cur = key
+                if delimiter:
+                    rest = key[len(prefix):]
+                    di = rest.find(delimiter)
+                    if di >= 0:
+                        cp = prefix + rest[:di + len(delimiter)]
+                        if cp not in prefixes:
+                            if len(entries) + len(prefixes) >= max_keys:
+                                # marker is EXCLUSIVE: resume after the
+                                # last key we actually returned
+                                return {
+                                    "entries": entries,
+                                    "common_prefixes": sorted(prefixes),
+                                    "truncated": True,
+                                    "next_marker": last_included,
+                                }
+                            prefixes.add(cp)
+                        last_included = key
+                        continue
+                if len(entries) + len(prefixes) >= max_keys:
+                    return {
+                        "entries": entries,
+                        "common_prefixes": sorted(prefixes),
+                        "truncated": True, "next_marker": last_included,
+                    }
+                entries.append([key, emeta])
+                last_included = key
+            if not page["truncated"]:
+                break
+        return {
+            "entries": entries, "common_prefixes": sorted(prefixes),
+            "truncated": truncated, "next_marker": next_marker,
+        }
+
+    # -- multipart (rgw_multi.cc) --------------------------------------
+
+    def _mp_meta_oid(self, bucket: dict, key: str, upload_id: str) -> str:
+        return f"mp.{bucket['id']}.{key}.{upload_id}"
+
+    async def initiate_multipart(self, bucket: dict, key: str,
+                                 content_type: str = "binary/octet-stream") -> str:
+        upload_id = os.urandom(12).hex()
+        oid = self._mp_meta_oid(bucket, key, upload_id)
+        await self.meta.create(oid, exclusive=True)
+        await self.meta.omap_set(oid, {
+            ".meta": json.dumps({
+                "key": key, "initiated": _now(),
+                "content_type": content_type,
+            }).encode(),
+        })
+        return upload_id
+
+    async def _mp_state(self, bucket: dict, key: str, upload_id: str) -> dict[str, bytes]:
+        oid = self._mp_meta_oid(bucket, key, upload_id)
+        try:
+            omap = await self.meta.omap_get(oid)
+        except RadosError as e:
+            if e.errno == errno.ENOENT:
+                raise RGWError("NoSuchUpload", 404, upload_id)
+            raise
+        if ".meta" not in omap:
+            raise RGWError("NoSuchUpload", 404, upload_id)
+        return omap
+
+    async def upload_part(
+        self, bucket: dict, key: str, upload_id: str, part_num: int,
+        data: bytes,
+    ) -> str:
+        if not 1 <= part_num <= 10000:
+            raise RGWError("InvalidArgument", 400, "partNumber out of range")
+        omap = await self._mp_state(bucket, key, upload_id)
+        io = self._data_io(bucket)
+        # a fresh tag per attempt: re-uploads never collide with the
+        # previous chain, which stays valid until the omap row flips
+        part_head = (
+            self._part_oid(bucket, key, upload_id, part_num)
+            + "." + os.urandom(4).hex())
+        manifest = await self._write_tails(io, part_head + ".t", data)
+        await io.write_full(part_head, data[:self.chunk_size])
+        etag = _md5(data)
+        entry = {
+            "size": len(data), "etag": etag,
+            "head_size": min(len(data), self.chunk_size),
+            "oids": [[part_head, min(len(data), self.chunk_size)]] + manifest,
+        }
+        await self.meta.omap_set(self._mp_meta_oid(bucket, key, upload_id), {
+            f"part.{part_num:05d}": json.dumps(entry).encode(),
+        })
+        old_raw = omap.get(f"part.{part_num:05d}")
+        if old_raw:  # replaced: the old chain is garbage now
+            for oid, _sz in json.loads(old_raw)["oids"]:
+                await self._remove_quiet(io, oid)
+        return etag
+
+    async def complete_multipart(
+        self, bucket: dict, key: str, upload_id: str,
+        parts: list[tuple[int, str]],
+    ) -> dict:
+        """parts: [(part_number, etag)] as sent by the client; must be
+        ascending and match uploaded parts (rgw_op.cc
+        RGWCompleteMultipart::execute)."""
+        omap = await self._mp_state(bucket, key, upload_id)
+        mp_meta = json.loads(omap[".meta"])
+        if not parts:
+            raise RGWError("InvalidPart", 400, "no parts")
+        if [p for p, _ in parts] != sorted(set(p for p, _ in parts)):
+            raise RGWError("InvalidPartOrder", 400, "parts out of order")
+        manifest: list[list] = []
+        total = 0
+        md5s = b""
+        uploaded = {
+            int(k.split(".")[1]): json.loads(v)
+            for k, v in omap.items() if k.startswith("part.")
+        }
+        for pn, etag in parts:
+            entry = uploaded.get(pn)
+            if entry is None or entag_strip(entry["etag"]) != entag_strip(etag):
+                raise RGWError("InvalidPart", 400, f"part {pn}")
+            manifest += [[oid, sz] for oid, sz in entry["oids"]]
+            total += entry["size"]
+            md5s += bytes.fromhex(entry["etag"])
+        io = self._data_io(bucket)
+        head_oid = self._head_oid(bucket, key)
+        etag = f"{hashlib.md5(md5s).hexdigest()}-{len(parts)}"
+        tag = await self._index_prepare(bucket, key, "put")
+        try:
+            new_oids = {oid for oid, _sz in manifest}
+            try:  # replacing an existing object: drop its tails
+                old = await self._read_meta(io, head_oid)
+                for oid, _sz in old.get("manifest", []):
+                    if oid not in new_oids:
+                        await self._remove_quiet(io, oid)
+            except RGWError:
+                pass
+            meta = {
+                "size": total, "etag": etag, "mtime": _now(),
+                "content_type": mp_meta.get("content_type",
+                                            "binary/octet-stream"),
+                "head_size": 0, "manifest": manifest,
+            }
+            await io.operate(head_oid, ObjectOperation()
+                             .write_full(b"")
+                             .setxattr("rgw.meta", json.dumps(meta).encode()))
+        except BaseException:
+            await self._index_abort(bucket, key, tag)
+            raise
+        await self._index_complete(bucket, key, tag, "put", {
+            "size": total, "etag": etag, "mtime": meta["mtime"],
+            "content_type": meta["content_type"],
+        })
+        # unreferenced parts (uploaded but not listed) + the meta object
+        for pn, entry in uploaded.items():
+            if pn not in {p for p, _ in parts}:
+                for oid, _ in entry["oids"]:
+                    await self._remove_quiet(io, oid)
+        await self._remove_quiet(self.meta, self._mp_meta_oid(bucket, key, upload_id))
+        return meta
+
+    async def abort_multipart(self, bucket: dict, key: str, upload_id: str) -> None:
+        omap = await self._mp_state(bucket, key, upload_id)
+        io = self._data_io(bucket)
+        for k, v in omap.items():
+            if k.startswith("part."):
+                for oid, _ in json.loads(v)["oids"]:
+                    await self._remove_quiet(io, oid)
+        await self._remove_quiet(self.meta, self._mp_meta_oid(bucket, key, upload_id))
+
+    async def list_parts(self, bucket: dict, key: str, upload_id: str) -> list[dict]:
+        omap = await self._mp_state(bucket, key, upload_id)
+        out = []
+        for k in sorted(omap):
+            if k.startswith("part."):
+                e = json.loads(omap[k])
+                out.append({
+                    "part_number": int(k.split(".")[1]),
+                    "size": e["size"], "etag": e["etag"],
+                })
+        return out
+
+
+def entag_strip(etag: str) -> str:
+    return etag.strip().strip('"')
