@@ -1,0 +1,187 @@
+"""CephFS client-lite: POSIX-style API over MDS + direct data I/O.
+
+Twin of the userspace client (src/client/Client.cc): metadata ops go
+to the MDS as MClientRequest/MClientReply; file DATA bypasses the MDS
+entirely — the client stripes bytes straight to the data pool using
+the file's layout (src/osdc/Striper.cc file_to_extents, objects named
+``<ino hex>.<objno 08x>``).  Cap-free v1: after a write extends a file
+the client reports the new size to the MDS (setattr) instead of
+holding a size cap.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+import itertools
+
+from ceph_tpu.client.rados import IoCtx, RadosError
+from ceph_tpu.client.striper import Layout, file_to_extents
+from ceph_tpu.msg.messages import MClientReply, MClientRequest
+from ceph_tpu.msg.messenger import Messenger
+
+from .mds import FSError
+
+REQUEST_TIMEOUT = 30.0
+
+
+class FSClient:
+    """Mounts the filesystem: MDS session + data-pool handle."""
+
+    def __init__(self, mds_addr: tuple[str, int], data_io: IoCtx,
+                 client_id: int | None = None):
+        import os
+
+        self.mds_addr = mds_addr
+        self.data_io = data_io
+        cid = client_id if client_id is not None else (os.getpid() << 8) | 3
+        self.messenger = Messenger(("client", cid), self._dispatch)
+        self._conn = None
+        self._tids = itertools.count(1)
+        self._waiters: dict[int, asyncio.Future] = {}
+        # unique per MOUNT, not per entity: reqids from a previous
+        # session of the same client must never hit the MDS's
+        # completed-request cache (the reference's mon-issued global_id
+        # plays this role)
+        self._session = os.urandom(8).hex()
+
+    async def mount(self) -> None:
+        self._conn = await self.messenger.connect(*self.mds_addr)
+
+    async def unmount(self) -> None:
+        await self.messenger.shutdown()
+
+    async def _dispatch(self, msg) -> None:
+        if isinstance(msg, MClientReply):
+            fut = self._waiters.get(msg.tid)
+            if fut and not fut.done():
+                fut.set_result(msg)
+
+    async def request(self, op: str, **args) -> dict:
+        # one reqid across every retry of this logical request: the MDS
+        # deduplicates a mutation whose first attempt landed but whose
+        # reply was lost (completed_requests, Client.cc resend rules)
+        args["_reqid"] = f"{self._session}:{next(self._tids)}"
+        for attempt in range(3):
+            tid = next(self._tids)
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._waiters[tid] = fut
+            try:
+                await self._conn.send_message(
+                    MClientRequest(tid=tid, op=op, args=args))
+                reply: MClientReply = await asyncio.wait_for(
+                    fut, REQUEST_TIMEOUT)
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                # session reset (MDS restart) or lost reply: reconnect
+                # and resend — the Client.cc session-reconnect behavior
+                await asyncio.sleep(0.2 * (attempt + 1))
+                try:
+                    self._conn = await self.messenger.connect(*self.mds_addr)
+                except (ConnectionError, OSError):
+                    pass
+                continue
+            finally:
+                self._waiters.pop(tid, None)
+            if reply.result < 0:
+                raise FSError(-reply.result, f"{op} failed")
+            return reply.out
+        raise FSError(errno.ETIMEDOUT, f"{op}: mds unreachable")
+
+    # -- metadata ------------------------------------------------------
+
+    async def mkdir(self, path: str, mode: int = 0o755) -> None:
+        await self.request("mkdir", path=path, mode=mode)
+
+    async def rmdir(self, path: str) -> None:
+        await self.request("rmdir", path=path)
+
+    async def unlink(self, path: str) -> None:
+        await self.request("unlink", path=path)
+
+    async def rename(self, src: str, dst: str) -> None:
+        await self.request("rename", src=src, dst=dst)
+
+    async def stat(self, path: str) -> dict:
+        return (await self.request("stat", path=path))["attr"]
+
+    async def readdir(self, path: str) -> dict[str, dict]:
+        return (await self.request("readdir", path=path))["entries"]
+
+    async def symlink(self, path: str, target: str) -> None:
+        await self.request("symlink", path=path, target=target)
+
+    async def readlink(self, path: str) -> str:
+        return (await self.request("readlink", path=path))["target"]
+
+    async def truncate(self, path: str, size: int) -> None:
+        await self.request("setattr", path=path, size=size)
+
+    async def sync(self) -> None:
+        """fsync-the-filesystem: force the MDS flush + journal trim."""
+        await self.request("flush")
+
+    # -- file I/O ------------------------------------------------------
+
+    async def create(self, path: str, mode: int = 0o644) -> "File":
+        out = await self.request("create", path=path, mode=mode)
+        return File(self, path, out["ino"], out["size"],
+                    Layout(*out["layout"]))
+
+    async def open(self, path: str) -> "File":
+        out = await self.request("open", path=path)
+        return File(self, path, out["ino"], out["size"],
+                    Layout(*out["layout"]))
+
+
+class File:
+    """An open file: striped data I/O + size reporting (Fh)."""
+
+    def __init__(self, fs: FSClient, path: str, ino: int, size: int,
+                 layout: Layout):
+        self.fs = fs
+        self.path = path
+        self.ino = ino
+        self.size = size
+        self.layout = layout
+
+    def _oid(self, objectno: int) -> str:
+        return f"{self.ino:x}.{objectno:08x}"
+
+    async def write(self, off: int, data: bytes) -> None:
+        if not data:
+            return
+        pos = 0
+        writes = []
+        for objectno, obj_off, n in file_to_extents(
+                self.layout, off, len(data)):
+            writes.append(self.fs.data_io.write(
+                self._oid(objectno), data[pos:pos + n], off=obj_off))
+            pos += n
+        await asyncio.gather(*writes)
+        if off + len(data) > self.size:
+            self.size = off + len(data)
+            await self.fs.request("setattr", path=self.path, size=self.size)
+
+    async def read(self, off: int = 0, length: int | None = None) -> bytes:
+        end = self.size if length is None else min(off + length, self.size)
+        if off >= end:
+            return b""
+        async def _one(objectno: int, obj_off: int, n: int) -> bytes:
+            try:
+                chunk = await self.fs.data_io.read(
+                    self._oid(objectno), off=obj_off, length=n)
+            except RadosError as e:
+                if e.errno != errno.ENOENT:
+                    raise
+                chunk = b""  # sparse hole
+            return chunk.ljust(n, b"\0")
+
+        parts = await asyncio.gather(*(
+            _one(*ext)
+            for ext in file_to_extents(self.layout, off, end - off)))
+        return b"".join(parts)
+
+    async def fsync(self) -> None:
+        """Refresh our size view + push mtime (no caps to flush)."""
+        attr = await self.fs.stat(self.path)
+        self.size = attr["size"]

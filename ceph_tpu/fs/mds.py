@@ -1,0 +1,536 @@
+"""MDS-lite: the single-active metadata server.
+
+Behavioral twin of the reference MDS reduced to one rank, no client
+caps and no subtree migration (src/mds/MDSDaemon.cc boot,
+src/mds/Server.cc request dispatch, src/mds/MDCache.cc the
+inode/dentry cache): directory content lives as omap on per-directory
+"dirfrag" objects in the metadata pool (``<ino hex>.00000000``, the
+CDir backing store), with each inode embedded in its parent's primary
+dentry exactly like the reference stores InodeStore inline; every
+mutation journals first (:mod:`ceph_tpu.fs.journal`, the
+src/mds/journal.cc EMetaBlob discipline) then applies to the cache,
+and dirty dirfrags flush back lazily — restart replays the journal
+over the flushed state.
+
+File DATA does not pass through the MDS: clients stripe file bytes
+directly to the data pool as ``<ino hex>.<objno 8x>`` objects (the
+CephFS file layout); the MDS only allocates inos, tracks sizes
+(clients report back, cap-free v1) and purges data on unlink — the
+PurgeQueue role, done inline.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+import logging
+import time
+
+from ceph_tpu.client.rados import ObjectOperation, RadosClient, RadosError
+from ceph_tpu.client.striper import Layout, file_to_extents
+from ceph_tpu.msg.messages import MClientReply, MClientRequest
+from ceph_tpu.msg.messenger import Messenger
+
+from .journal import Journaler
+
+log = logging.getLogger("ceph_tpu.mds")
+
+ROOT_INO = 1  # MDS_INO_ROOT (src/mds/mdstypes.h)
+DEFAULT_LAYOUT = [65536, 4, 4 * 2**20]  # [stripe_unit, stripe_count, object_size]
+
+
+class FSError(OSError):
+    pass
+
+
+def _err(code: int, msg: str) -> FSError:
+    return FSError(code, msg)
+
+
+class MDSDaemon:
+    """One MDS rank over the shared Messenger, backed by RADOS pools.
+
+    ``flush_every``: dirty-dirfrag writeback + journal checkpoint cadence
+    in events (LogSegment size, tiny here so tests hit both paths).
+    """
+
+    def __init__(self, rank: int, mon_addr: tuple[str, int],
+                 meta_pool: str = "cephfs.meta",
+                 data_pool: str = "cephfs.data",
+                 flush_every: int = 128):
+        self.rank = rank
+        self.mon_addr = mon_addr
+        self.meta_pool = meta_pool
+        self.data_pool = data_pool
+        self.flush_every = flush_every
+        self.messenger = Messenger(("mds", rank), self._dispatch)
+        self.rados: RadosClient | None = None
+        self.journal: Journaler | None = None
+        self.ino_next = ROOT_INO + 1
+        # MDCache: ino -> {"entries": {name: rec}, "dirty": bool}
+        self._dirs: dict[int, dict] = {}
+        self._doomed: set[int] = set()     # dirfrag objects to remove at flush
+        self._mutation_lock = asyncio.Lock()  # single-MDS total order
+        self._events_since_flush = 0
+        # completed-request cache (the reference session's
+        # completed_requests): reqid -> reply payload, rebuilt from the
+        # journal on replay, so a client retrying a mutation whose
+        # first attempt landed gets its original answer instead of
+        # EEXIST/ENOENT
+        self._completed: dict[str, dict] = {}
+        self._cur_reqid: str | None = None
+        self.addr: tuple[str, int] | None = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    async def start(self) -> None:
+        self.rados = RadosClient(client_id=(7000 + self.rank))
+        await self.rados.connect(*self.mon_addr)
+        self.meta_io = self.rados.ioctx(self.meta_pool)
+        self.data_io = self.rados.ioctx(self.data_pool)
+        self.journal = Journaler(self.meta_io, f"mds{self.rank}.journal")
+        state, events = await self.journal.load()
+        self.ino_next = state.get("ino_next", ROOT_INO + 1)
+        for ev in events:
+            await self._apply(ev, replay=True)
+        self.addr = await self.messenger.bind()
+        log.info("mds.%d: up at %s, replayed %d events",
+                 self.rank, self.addr, len(events))
+
+    async def stop(self) -> None:
+        """Clean shutdown: flush + trim, then drop sessions."""
+        async with self._mutation_lock:
+            await self._flush()
+        await self.messenger.shutdown()
+        await self.rados.shutdown()
+
+    async def crash(self) -> None:
+        """Test hook: die WITHOUT flushing — restart must replay."""
+        await self.messenger.shutdown()
+        await self.rados.shutdown()
+
+    # -- dirfrag cache (MDCache/CDir) ----------------------------------
+
+    def _dirfrag_oid(self, ino: int) -> str:
+        return f"{ino:x}.00000000"
+
+    async def _dir(self, ino: int) -> dict:
+        d = self._dirs.get(ino)
+        if d is None:
+            import json
+
+            try:
+                omap = await self.meta_io.omap_get(self._dirfrag_oid(ino))
+            except RadosError as e:
+                if e.errno != errno.ENOENT:
+                    raise
+                omap = {}
+            d = {"entries": {k: json.loads(v) for k, v in omap.items()},
+                 "dirty": False}
+            self._dirs[ino] = d
+        return d
+
+    async def _flush(self) -> None:
+        """Write back dirty dirfrags, delete doomed ones, checkpoint
+        the journal (LogSegment expiry)."""
+        import json
+
+        for ino, d in list(self._dirs.items()):
+            if not d["dirty"] or ino in self._doomed:
+                continue
+            op = ObjectOperation().omap_clear().omap_set({
+                name: json.dumps(rec).encode()
+                for name, rec in d["entries"].items()
+            })
+            await self.meta_io.operate(self._dirfrag_oid(ino), op)
+            d["dirty"] = False
+        for ino in list(self._doomed):
+            try:
+                await self.meta_io.remove(self._dirfrag_oid(ino))
+            except RadosError:
+                pass
+            self._doomed.discard(ino)
+            self._dirs.pop(ino, None)
+        await self.journal.checkpoint({"ino_next": self.ino_next})
+        self._events_since_flush = 0
+
+    async def _journal_and_apply(self, ev: dict) -> None:
+        if self._cur_reqid:
+            ev["reqid"] = self._cur_reqid
+        await self.journal.append(ev)
+        await self._apply(ev)
+        self._events_since_flush += 1
+        if self._events_since_flush >= self.flush_every:
+            await self._flush()
+
+    @staticmethod
+    def _reply_of(ev: dict) -> dict:
+        """The reply payload a journaled mutation produced — derivable
+        from the event, so replay can rebuild the completed-request
+        cache."""
+        op = ev["op"]
+        if op == "create":
+            return {"ino": ev["ino"], "size": 0, "layout": ev["layout"],
+                    "existed": False}
+        if op in ("mkdir", "symlink"):
+            return {"ino": ev["ino"]}
+        return {}
+
+    def _record_completed(self, reqid: str, out: dict) -> None:
+        self._completed[reqid] = out
+        while len(self._completed) > 4096:
+            self._completed.pop(next(iter(self._completed)))
+
+    # -- event application (EMetaBlob::replay) -------------------------
+
+    async def _apply(self, ev: dict, replay: bool = False) -> None:
+        """Idempotent apply of a journal event to the cache.  During
+        replay the affected dirfrags load from their flushed state
+        first, then the event lands on top."""
+        op = ev["op"]
+        if ev.get("reqid"):
+            self._record_completed(ev["reqid"], self._reply_of(ev))
+        if op in ("mkdir", "create", "symlink"):
+            d = await self._dir(ev["p"])
+            rec = {"ino": ev["ino"], "mtime": ev["t"],
+                   "mode": ev.get("mode", 0o644)}
+            if op == "mkdir":
+                rec["type"] = "dir"
+            elif op == "create":
+                rec["type"] = "file"
+                rec["size"] = 0
+                rec["layout"] = ev["layout"]
+            else:
+                rec["type"] = "symlink"
+                rec["target"] = ev["target"]
+            d["entries"][ev["n"]] = rec
+            d["dirty"] = True
+            if replay:
+                self.ino_next = max(self.ino_next, ev["ino"] + 1)
+        elif op in ("unlink", "rmdir"):
+            d = await self._dir(ev["p"])
+            d["entries"].pop(ev["n"], None)
+            d["dirty"] = True
+            if op == "rmdir":
+                self._doomed.add(ev["ino"])
+                self._dirs.pop(ev["ino"], None)
+            purge = ev.get("purge")
+            if purge:
+                await self._purge_data(
+                    purge["ino"], purge["size"], purge["layout"])
+        elif op == "rename":
+            src = await self._dir(ev["sp"])
+            dst = await self._dir(ev["dp"])
+            rec = src["entries"].pop(ev["sn"], None)
+            purge = ev.get("purge")
+            if purge:
+                await self._purge_data(
+                    purge["ino"], purge["size"], purge["layout"])
+            if ev.get("doom") is not None:  # replaced an empty dir
+                self._doomed.add(ev["doom"])
+                self._dirs.pop(ev["doom"], None)
+            if rec is not None:
+                dst["entries"][ev["dn"]] = rec
+            src["dirty"] = dst["dirty"] = True
+        elif op == "setattr":
+            d = await self._dir(ev["p"])
+            rec = d["entries"].get(ev["n"])
+            trunc = ev.get("truncate")
+            if trunc:
+                # data truncation lives HERE, after the event is
+                # durable: a crash before the append leaves the file
+                # intact; replay re-truncates (idempotent)
+                await self._truncate_data(trunc, ev["size"])
+            if rec is not None:
+                for f in ("size", "mtime", "mode"):
+                    if f in ev:
+                        rec[f] = ev[f]
+                d["dirty"] = True
+        else:  # pragma: no cover
+            log.warning("mds: unknown journal op %r", op)
+
+    async def _purge_data(self, ino: int, size: int, layout: list) -> None:
+        """Inline PurgeQueue: drop the file's data objects."""
+        lay = Layout(*layout)
+        objnos = {0}
+        for objectno, _o, _n in file_to_extents(lay, 0, max(size, 1)):
+            objnos.add(objectno)
+        for objectno in objnos:
+            try:
+                await self.data_io.remove(f"{ino:x}.{objectno:08x}")
+            except RadosError:
+                pass
+
+    # -- path resolution (MDCache::path_traverse) ----------------------
+
+    @staticmethod
+    def _split(path: str) -> list[str]:
+        parts = [p for p in path.split("/") if p]
+        if any(p == ".." for p in parts):
+            raise _err(errno.EINVAL, "'..' not supported")
+        return [p for p in parts if p != "."]
+
+    async def _resolve_dir(self, parts: list[str]) -> int:
+        """Walk every component as a directory; returns its ino."""
+        ino = ROOT_INO
+        for name in parts:
+            d = await self._dir(ino)
+            rec = d["entries"].get(name)
+            if rec is None:
+                raise _err(errno.ENOENT, name)
+            if rec["type"] != "dir":
+                raise _err(errno.ENOTDIR, name)
+            ino = rec["ino"]
+        return ino
+
+    async def _resolve_parent(self, path: str) -> tuple[int, str]:
+        parts = self._split(path)
+        if not parts:
+            raise _err(errno.EINVAL, "root")
+        return await self._resolve_dir(parts[:-1]), parts[-1]
+
+    async def _lookup(self, path: str) -> dict:
+        parts = self._split(path)
+        if not parts:
+            return {"ino": ROOT_INO, "type": "dir", "mode": 0o755,
+                    "mtime": 0}
+        pino = await self._resolve_dir(parts[:-1])
+        d = await self._dir(pino)
+        rec = d["entries"].get(parts[-1])
+        if rec is None:
+            raise _err(errno.ENOENT, path)
+        return rec
+
+    # -- request dispatch (src/mds/Server.cc) --------------------------
+
+    async def _dispatch(self, msg) -> None:
+        import inspect
+
+        if not isinstance(msg, MClientRequest):
+            return
+        args = dict(msg.args)
+        reqid = args.pop("_reqid", None)
+        handler = getattr(self, f"_op_{msg.op}", None)
+        if handler is None:
+            reply = MClientReply(msg.tid, -errno.EOPNOTSUPP)
+        elif reqid is not None and reqid in self._completed:
+            # a retry of a mutation that already landed: original answer
+            reply = MClientReply(msg.tid, 0, self._completed[reqid])
+        else:
+            try:
+                # bad client args must NOT be conflated with handler
+                # bugs: bind-check here, so a TypeError raised deeper
+                # inside the handler surfaces as a logged EIO below
+                inspect.signature(handler).bind(**args)
+            except TypeError:
+                reply = MClientReply(msg.tid, -errno.EINVAL)
+            else:
+                try:
+                    # reads serialize with mutations too: _apply awaits
+                    # mid-event (dirfrag loads, purges), so an unlocked
+                    # read could observe a half-applied rename
+                    async with self._mutation_lock:
+                        self._cur_reqid = reqid
+                        try:
+                            out = await handler(**args)
+                        finally:
+                            self._cur_reqid = None
+                    reply = MClientReply(msg.tid, 0, out or {})
+                except FSError as e:
+                    reply = MClientReply(msg.tid, -(e.errno or errno.EIO))
+                except Exception:
+                    log.exception("mds: %s failed", msg.op)
+                    reply = MClientReply(msg.tid, -errno.EIO)
+        try:
+            await msg.conn.send_message(reply)
+        except ConnectionError:
+            pass
+
+    # mutations --------------------------------------------------------
+
+    async def _op_mkdir(self, path: str, mode: int = 0o755) -> dict:
+        pino, name = await self._resolve_parent(path)
+        d = await self._dir(pino)
+        if name in d["entries"]:
+            raise _err(errno.EEXIST, path)
+        ino = self.ino_next
+        self.ino_next += 1
+        await self._journal_and_apply({
+            "op": "mkdir", "p": pino, "n": name, "ino": ino,
+            "mode": mode, "t": time.time(),
+        })
+        return {"ino": ino}
+
+    async def _op_create(self, path: str, mode: int = 0o644,
+                         layout: list | None = None) -> dict:
+        pino, name = await self._resolve_parent(path)
+        d = await self._dir(pino)
+        rec = d["entries"].get(name)
+        if rec is not None:
+            if rec["type"] != "file":
+                raise _err(errno.EISDIR, path)
+            return {"ino": rec["ino"], "size": rec["size"],
+                    "layout": rec["layout"], "existed": True}
+        ino = self.ino_next
+        self.ino_next += 1
+        lay = list(layout or DEFAULT_LAYOUT)
+        await self._journal_and_apply({
+            "op": "create", "p": pino, "n": name, "ino": ino,
+            "mode": mode, "layout": lay, "t": time.time(),
+        })
+        return {"ino": ino, "size": 0, "layout": lay, "existed": False}
+
+    async def _op_symlink(self, path: str, target: str) -> dict:
+        pino, name = await self._resolve_parent(path)
+        d = await self._dir(pino)
+        if name in d["entries"]:
+            raise _err(errno.EEXIST, path)
+        ino = self.ino_next
+        self.ino_next += 1
+        await self._journal_and_apply({
+            "op": "symlink", "p": pino, "n": name, "ino": ino,
+            "target": target, "t": time.time(),
+        })
+        return {"ino": ino}
+
+    async def _op_unlink(self, path: str) -> dict:
+        pino, name = await self._resolve_parent(path)
+        d = await self._dir(pino)
+        rec = d["entries"].get(name)
+        if rec is None:
+            raise _err(errno.ENOENT, path)
+        if rec["type"] == "dir":
+            raise _err(errno.EISDIR, path)
+        ev = {"op": "unlink", "p": pino, "n": name}
+        if rec["type"] == "file":
+            ev["purge"] = {"ino": rec["ino"], "size": rec["size"],
+                           "layout": rec["layout"]}
+        await self._journal_and_apply(ev)
+        return {}
+
+    async def _op_rmdir(self, path: str) -> dict:
+        pino, name = await self._resolve_parent(path)
+        d = await self._dir(pino)
+        rec = d["entries"].get(name)
+        if rec is None:
+            raise _err(errno.ENOENT, path)
+        if rec["type"] != "dir":
+            raise _err(errno.ENOTDIR, path)
+        child = await self._dir(rec["ino"])
+        if child["entries"]:
+            raise _err(errno.ENOTEMPTY, path)
+        await self._journal_and_apply({
+            "op": "rmdir", "p": pino, "n": name, "ino": rec["ino"],
+        })
+        return {}
+
+    async def _op_rename(self, src: str, dst: str) -> dict:
+        src_parts, dst_parts = self._split(src), self._split(dst)
+        # POSIX rename(2): moving a directory into its own subtree
+        # orphans it — EINVAL (paths are the namespace here, so a
+        # prefix test is exact: no hardlinked dirs exist)
+        if dst_parts[:len(src_parts)] == src_parts and src_parts:
+            if len(dst_parts) > len(src_parts):
+                raise _err(errno.EINVAL, "rename into own subtree")
+        sp, sn = await self._resolve_parent(src)
+        dp, dn = await self._resolve_parent(dst)
+        sd = await self._dir(sp)
+        rec = sd["entries"].get(sn)
+        if rec is None:
+            raise _err(errno.ENOENT, src)
+        dd = await self._dir(dp)
+        existing = dd["entries"].get(dn)
+        ev = {"op": "rename", "sp": sp, "sn": sn, "dp": dp, "dn": dn}
+        if existing is not None:
+            if existing["ino"] == rec["ino"]:
+                return {}
+            if existing["type"] == "dir":
+                if rec["type"] != "dir":
+                    raise _err(errno.EISDIR, dst)
+                if (await self._dir(existing["ino"]))["entries"]:
+                    raise _err(errno.ENOTEMPTY, dst)
+                ev["doom"] = existing["ino"]
+            elif rec["type"] == "dir":
+                raise _err(errno.ENOTDIR, dst)
+            elif existing["type"] == "file":
+                ev["purge"] = {"ino": existing["ino"],
+                               "size": existing["size"],
+                               "layout": existing["layout"]}
+        await self._journal_and_apply(ev)
+        return {}
+
+    async def _op_setattr(self, path: str, size: int | None = None,
+                          mtime: float | None = None,
+                          mode: int | None = None) -> dict:
+        pino, name = await self._resolve_parent(path)
+        d = await self._dir(pino)
+        rec = d["entries"].get(name)
+        if rec is None:
+            raise _err(errno.ENOENT, path)
+        ev = {"op": "setattr", "p": pino, "n": name}
+        if size is not None:
+            if rec["type"] != "file":
+                raise _err(errno.EINVAL, "size on non-file")
+            if size < rec["size"]:
+                # journal-first: _apply does the data truncation once
+                # the event is durable
+                ev["truncate"] = {"ino": rec["ino"], "size": rec["size"],
+                                  "layout": rec["layout"]}
+            ev["size"] = size
+        if mtime is not None:
+            ev["mtime"] = mtime
+        if mode is not None:
+            ev["mode"] = mode
+        await self._journal_and_apply(ev)
+        return {}
+
+    async def _truncate_data(self, rec: dict, new_size: int) -> None:
+        """Shrink: drop whole data objects past the end, trim the
+        boundary object (Striper::truncate semantics, MDS-driven since
+        v1 clients hold no caps)."""
+        lay = Layout(*rec["layout"])
+        live: dict[int, int] = {}
+        if new_size > 0:
+            for objectno, obj_off, n in file_to_extents(lay, 0, new_size):
+                live[objectno] = max(live.get(objectno, 0), obj_off + n)
+        for objectno, _o, _n in file_to_extents(lay, 0, max(rec["size"], 1)):
+            oid = f"{rec['ino']:x}.{objectno:08x}"
+            try:
+                if objectno not in live:
+                    await self.data_io.remove(oid)
+                else:
+                    await self.data_io.truncate(oid, live[objectno])
+            except RadosError:
+                pass
+
+    # reads ------------------------------------------------------------
+
+    async def _op_stat(self, path: str) -> dict:
+        return {"attr": await self._lookup(path)}
+
+    async def _op_open(self, path: str) -> dict:
+        rec = await self._lookup(path)
+        if rec["type"] != "file":
+            raise _err(errno.EISDIR, path)
+        return {"ino": rec["ino"], "size": rec["size"],
+                "layout": rec["layout"]}
+
+    async def _op_readdir(self, path: str) -> dict:
+        rec = await self._lookup(path)
+        if rec["type"] != "dir":
+            raise _err(errno.ENOTDIR, path)
+        d = await self._dir(rec["ino"])
+        return {"entries": {
+            name: r for name, r in sorted(d["entries"].items())
+        }}
+
+    async def _op_readlink(self, path: str) -> dict:
+        rec = await self._lookup(path)
+        if rec["type"] != "symlink":
+            raise _err(errno.EINVAL, path)
+        return {"target": rec["target"]}
+
+    async def _op_flush(self) -> dict:
+        """Admin/test verb: force writeback + journal trim."""
+        await self._flush()
+        return {}

@@ -1060,3 +1060,55 @@ class MOSDScrubReply(Message):
     @classmethod
     def decode_payload(cls, dec):
         return cls(dec.u64(), dec.i32(), dec.bytes_())
+
+
+# -- cephfs client <-> mds (src/messages/MClientRequest.h) ------------------
+
+class MClientRequest(Message):
+    """Filesystem metadata request (CEPH_MSG_CLIENT_REQUEST=24).  The
+    reference carries op-specific structs; the lite MDS takes the op
+    name + JSON args (paths resolve server-side, single-MDS v1)."""
+
+    TYPE = 24
+
+    def __init__(self, tid: int = 0, op: str = "", args: dict | None = None):
+        self.tid, self.op, self.args = tid, op, args or {}
+
+    def encode_payload(self, enc):
+        import json
+
+        enc.u64(self.tid)
+        enc.str_(self.op)
+        enc.bytes_(json.dumps(self.args).encode())
+
+    @classmethod
+    def decode_payload(cls, dec):
+        import json
+
+        tid = dec.u64()
+        op = dec.str_()
+        return cls(tid, op, json.loads(dec.bytes_() or b"{}"))
+
+
+class MClientReply(Message):
+    """CEPH_MSG_CLIENT_REPLY=26: result code + JSON payload."""
+
+    TYPE = 26
+
+    def __init__(self, tid: int = 0, result: int = 0, out: dict | None = None):
+        self.tid, self.result, self.out = tid, result, out or {}
+
+    def encode_payload(self, enc):
+        import json
+
+        enc.u64(self.tid)
+        enc.i32(self.result)
+        enc.bytes_(json.dumps(self.out).encode())
+
+    @classmethod
+    def decode_payload(cls, dec):
+        import json
+
+        tid = dec.u64()
+        result = dec.i32()
+        return cls(tid, result, json.loads(dec.bytes_() or b"{}"))

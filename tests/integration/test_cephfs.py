@@ -1,0 +1,333 @@
+"""CephFS-lite end to end: mkdir/create/write/rename/readdir/unlink
+over a live mini-cluster, plus MDS restart journal replay — the
+VERDICT round-3 item 2 acceptance flow (reference analogues:
+qa/workunits/fs/misc, src/mds/journal.cc replay).
+"""
+
+from __future__ import annotations
+
+import errno
+
+import numpy as np
+import pytest
+
+from ceph_tpu.fs import FSClient, FSError, MDSDaemon
+
+from .test_mini_cluster import Cluster, run
+
+
+async def _fs(c, flush_every: int = 128, ec_data: bool = False):
+    await c.client.pool_create("cephfs.meta", pg_num=4, size=3)
+    if ec_data:
+        await c.client.ec_profile_set(
+            "fsp", {"plugin": "jax", "k": "3", "m": "2"})
+        await c.client.pool_create(
+            "cephfs.data", pg_num=8, pool_type="erasure",
+            erasure_code_profile="fsp")
+    else:
+        await c.client.pool_create("cephfs.data", pg_num=8, size=3)
+    mds = MDSDaemon(0, c.mon.addr, flush_every=flush_every)
+    await mds.start()
+    fs = FSClient(mds.addr, c.client.ioctx("cephfs.data"))
+    await fs.mount()
+    return mds, fs
+
+
+class TestPosixSurface:
+    def test_dirs_files_rename_unlink(self):
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                mds, fs = await _fs(c)
+                try:
+                    await fs.mkdir("/a")
+                    await fs.mkdir("/a/b")
+                    with pytest.raises(FSError) as ei:
+                        await fs.mkdir("/a")
+                    assert ei.value.errno == errno.EEXIST
+                    with pytest.raises(FSError) as ei:
+                        await fs.mkdir("/nope/c")
+                    assert ei.value.errno == errno.ENOENT
+
+                    # create + write + read (crosses stripe units)
+                    f = await fs.create("/a/b/data.bin")
+                    payload = np.random.default_rng(3).integers(
+                        0, 256, 300_000, dtype=np.uint8).tobytes()
+                    await f.write(0, payload)
+                    assert await f.read(0) == payload
+                    # overwrite inside + read a slice
+                    await f.write(1000, b"\xee" * 500)
+                    want = payload[:1000] + b"\xee" * 500 + payload[1500:]
+                    assert await f.read(900, 800) == want[900:1700]
+
+                    # reopen sees the reported size
+                    f2 = await fs.open("/a/b/data.bin")
+                    assert f2.size == len(payload)
+                    assert await f2.read(0) == want
+
+                    # stat/readdir
+                    attr = await fs.stat("/a/b/data.bin")
+                    assert attr["type"] == "file"
+                    assert attr["size"] == len(payload)
+                    names = sorted(await fs.readdir("/a/b"))
+                    assert names == ["data.bin"]
+                    root = await fs.readdir("/")
+                    assert list(root) == ["a"]
+
+                    # rename within and across directories
+                    await fs.mkdir("/target")
+                    await fs.rename("/a/b/data.bin", "/target/moved.bin")
+                    with pytest.raises(FSError):
+                        await fs.stat("/a/b/data.bin")
+                    f3 = await fs.open("/target/moved.bin")
+                    assert await f3.read(0) == want
+
+                    # rename onto an existing file replaces it (and
+                    # purges the victim's data)
+                    g = await fs.create("/target/victim.bin")
+                    await g.write(0, b"victim")
+                    await fs.rename("/target/moved.bin",
+                                    "/target/victim.bin")
+                    f4 = await fs.open("/target/victim.bin")
+                    assert await f4.read(0) == want
+
+                    # unlink + rmdir ordering rules
+                    with pytest.raises(FSError) as ei:
+                        await fs.rmdir("/target")
+                    assert ei.value.errno == errno.ENOTEMPTY
+                    await fs.unlink("/target/victim.bin")
+                    await fs.rmdir("/target")
+                    with pytest.raises(FSError) as ei:
+                        await fs.unlink("/a/b")   # a dir
+                    assert ei.value.errno == errno.EISDIR
+                    await fs.rmdir("/a/b")
+                    await fs.rmdir("/a")
+                    assert await fs.readdir("/") == {}
+                finally:
+                    await fs.unmount()
+                    await mds.stop()
+
+        run(go())
+
+    def test_symlink_truncate(self):
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                mds, fs = await _fs(c)
+                try:
+                    f = await fs.create("/file")
+                    await f.write(0, b"0123456789" * 100)
+                    await fs.symlink("/link", "/file")
+                    assert await fs.readlink("/link") == "/file"
+                    assert (await fs.stat("/link"))["type"] == "symlink"
+                    # shrink, then read through a fresh handle
+                    await fs.truncate("/file", 10)
+                    f2 = await fs.open("/file")
+                    assert f2.size == 10
+                    assert await f2.read(0) == b"0123456789"
+                    # grow-by-truncate reads zeros (sparse)
+                    await fs.truncate("/file", 20)
+                    f3 = await fs.open("/file")
+                    assert await f3.read(0) == b"0123456789" + b"\0" * 10
+                finally:
+                    await fs.unmount()
+                    await mds.stop()
+
+        run(go())
+
+    def test_data_on_ec_pool(self):
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                mds, fs = await _fs(c, ec_data=True)
+                try:
+                    f = await fs.create("/ec.bin")
+                    payload = np.random.default_rng(11).integers(
+                        0, 256, 200_000, dtype=np.uint8).tobytes()
+                    await f.write(0, payload)
+                    f2 = await fs.open("/ec.bin")
+                    assert await f2.read(0) == payload
+                finally:
+                    await fs.unmount()
+                    await mds.stop()
+
+        run(go())
+
+
+class TestJournalReplay:
+    def test_mds_crash_replays_unflushed_ops(self):
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                # flush_every high: nothing writes back before the crash
+                mds, fs = await _fs(c, flush_every=10_000)
+                await fs.mkdir("/d")
+                f = await fs.create("/d/f1")
+                await f.write(0, b"persisted across mds death")
+                await fs.mkdir("/d/sub")
+                await fs.rename("/d/f1", "/d/sub/f1")
+                await fs.create("/d/doomed")
+                await fs.unlink("/d/doomed")
+                await fs.unmount()
+                await mds.crash()   # no flush: dirfrags never written
+
+                mds2 = MDSDaemon(0, c.mon.addr, flush_every=10_000)
+                await mds2.start()  # journal replay rebuilds everything
+                fs2 = FSClient(mds2.addr, c.client.ioctx("cephfs.data"))
+                await fs2.mount()
+                try:
+                    assert sorted(await fs2.readdir("/d")) == ["sub"]
+                    assert sorted(await fs2.readdir("/d/sub")) == ["f1"]
+                    f2 = await fs2.open("/d/sub/f1")
+                    assert await f2.read(0) == b"persisted across mds death"
+                    # ino allocator replayed past every used ino: new
+                    # files must not collide with pre-crash data objects
+                    f3 = await fs2.create("/d/new")
+                    await f3.write(0, b"fresh")
+                    assert await (await fs2.open("/d/new")).read(0) == b"fresh"
+                    assert await f2.read(0) == b"persisted across mds death"
+                finally:
+                    await fs2.unmount()
+                    await mds2.stop()
+
+        run(go())
+
+    def test_flush_then_crash_replays_tail_only(self):
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                mds, fs = await _fs(c, flush_every=10_000)
+                await fs.mkdir("/pre")
+                await (await fs.create("/pre/a")).write(0, b"AA")
+                await fs.sync()     # checkpoint: dirfrags durable
+                # post-checkpoint tail, unflushed
+                await (await fs.create("/pre/b")).write(0, b"BB")
+                await fs.rename("/pre/a", "/pre/a2")
+                await fs.unmount()
+                await mds.crash()
+
+                mds2 = MDSDaemon(0, c.mon.addr)
+                await mds2.start()
+                fs2 = FSClient(mds2.addr, c.client.ioctx("cephfs.data"))
+                await fs2.mount()
+                try:
+                    assert sorted(await fs2.readdir("/pre")) == ["a2", "b"]
+                    assert await (await fs2.open("/pre/a2")).read(0) == b"AA"
+                    assert await (await fs2.open("/pre/b")).read(0) == b"BB"
+                finally:
+                    await fs2.unmount()
+                    await mds2.stop()
+
+        run(go())
+
+    def test_clean_restart_after_stop(self):
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                mds, fs = await _fs(c)
+                await fs.mkdir("/keep")
+                await (await fs.create("/keep/f")).write(0, b"data!")
+                await fs.unmount()
+                await mds.stop()    # clean: flush + trim
+
+                mds2 = MDSDaemon(0, c.mon.addr)
+                await mds2.start()
+                # trimmed journal: nothing to replay, state from dirfrags
+                assert mds2.journal.min_seg == mds2.journal.cur_seg
+                fs2 = FSClient(mds2.addr, c.client.ioctx("cephfs.data"))
+                await fs2.mount()
+                try:
+                    assert await (await fs2.open("/keep/f")).read(0) == b"data!"
+                finally:
+                    await fs2.unmount()
+                    await mds2.stop()
+
+        run(go())
+
+
+class TestReviewFixes:
+    def test_rename_into_own_subtree_einval(self):
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                mds, fs = await _fs(c)
+                try:
+                    await fs.mkdir("/a")
+                    await fs.mkdir("/a/b")
+                    with pytest.raises(FSError) as ei:
+                        await fs.rename("/a", "/a/b/c")
+                    assert ei.value.errno == errno.EINVAL
+                    # a sibling rename still works
+                    await fs.rename("/a/b", "/a/b2")
+                    assert sorted(await fs.readdir("/a")) == ["b2"]
+                finally:
+                    await fs.unmount()
+                    await mds.stop()
+
+        run(go())
+
+    def test_retried_mutation_deduplicated(self):
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                mds, fs = await _fs(c)
+                try:
+                    await fs.mkdir("/d")
+                    # replay the exact wire request (same _reqid): the
+                    # MDS must return the ORIGINAL answer, not EEXIST
+                    out1 = await fs.request("mkdir", path="/d/x")
+                    from ceph_tpu.msg.messages import MClientRequest
+                    tid = 9_999
+                    fut = None
+                    args = {"path": "/d/x", "mode": 0o755,
+                            "_reqid": None}
+                    # reuse the reqid the client generated: grab it by
+                    # sending through the raw path ourselves
+                    out2 = None
+                    # simulate: second send with an explicit fixed reqid
+                    r1 = await _raw(fs, "mkdir", {"path": "/d/y",
+                                                  "_reqid": "42:1"})
+                    assert r1.result == 0
+                    r2 = await _raw(fs, "mkdir", {"path": "/d/y",
+                                                  "_reqid": "42:1"})
+                    assert r2.result == 0          # dedup, not EEXIST
+                    assert r2.out == r1.out
+                    r3 = await _raw(fs, "mkdir", {"path": "/d/y",
+                                                  "_reqid": "42:2"})
+                    assert r3.result == -errno.EEXIST  # genuinely new
+                finally:
+                    await fs.unmount()
+                    await mds.stop()
+
+        run(go())
+
+    def test_truncate_journal_first(self):
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                mds, fs = await _fs(c, flush_every=10_000)
+                f = await fs.create("/t.bin")
+                await f.write(0, b"Z" * 100_000)
+                await fs.truncate("/t.bin", 7)
+                await fs.unmount()
+                await mds.crash()   # truncate event only in journal
+                mds2 = MDSDaemon(0, c.mon.addr)
+                await mds2.start()
+                fs2 = FSClient(mds2.addr, c.client.ioctx("cephfs.data"))
+                await fs2.mount()
+                try:
+                    f2 = await fs2.open("/t.bin")
+                    assert f2.size == 7
+                    assert await f2.read(0) == b"Z" * 7
+                finally:
+                    await fs2.unmount()
+                    await mds2.stop()
+
+        run(go())
+
+
+async def _raw(fs, op, args):
+    """Send a request with caller-controlled args (fixed _reqid)."""
+    import asyncio as _a
+
+    from ceph_tpu.msg.messages import MClientRequest
+
+    tid = next(fs._tids)
+    fut = _a.get_running_loop().create_future()
+    fs._waiters[tid] = fut
+    try:
+        await fs._conn.send_message(MClientRequest(tid=tid, op=op, args=args))
+        return await _a.wait_for(fut, 10)
+    finally:
+        fs._waiters.pop(tid, None)
