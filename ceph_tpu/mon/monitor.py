@@ -145,6 +145,14 @@ class Monitor:
         # 'mon', 'client') -> {option: value}; replicated via paxos and
         # pushed to every subscriber as MConfig
         self._config_db: dict[str, dict[str, str]] = {}
+        # AuthMonitor database: entity -> {"key": hex, "caps": {...}},
+        # paxos-replicated, mirrored into the live AuthContext keyring
+        self._auth_db: dict[str, dict] = {}
+        # construction-keyring identities: the root of trust the
+        # command plane may never rebind, clobber, or delete
+        self._bootstrap_entities: set[str] = (
+            set(auth.keyring) if auth is not None else set()
+        )
         self._next_pool = 1
         self._tids = itertools.count(1)
         self._scrub_waiters: dict[int, asyncio.Future] = {}
@@ -229,6 +237,7 @@ class Monitor:
             },
             "up_from": {str(k): v for k, v in self._up_from.items()},
             "config_db": self._config_db,
+            "auth_db": self._auth_db,
         }))
         return self._state_version, enc.bytes()
 
@@ -249,6 +258,8 @@ class Monitor:
             int(k): v for k, v in aux["incarnations"].items()
         }
         self._config_db = dict(aux.get("config_db", {}))
+        self._auth_db = dict(aux.get("auth_db", {}))
+        self._sync_auth_keyring()
         self._apply_config_locally()
         self._up_from = {
             int(k): v for k, v in aux.get("up_from", {}).items()
@@ -469,7 +480,8 @@ class Monitor:
             if fut and not fut.done():
                 fut.set_result(msg)
         elif isinstance(msg, MMonCommand):
-            code, rs, data = await self._command(msg.cmd)
+            code, rs, data = await self._command(
+                msg.cmd, caps=getattr(msg.conn, "peer_caps", None))
             await msg.conn.send_message(
                 MMonCommandAck(tid=msg.tid, code=code, rs=rs, data=data)
             )
@@ -609,6 +621,16 @@ class Monitor:
                 om.pg_upmap_items[pg_t(pool, ps)] = [
                     (f, t) for f, t in pairs
                 ]
+        elif kind == "auth_upsert":
+            self._auth_db[op["entity"]] = {
+                "key": op["key"], "caps": dict(op["caps"]),
+            }
+            self._sync_auth_keyring()
+            return  # auth changes don't mint osdmap epochs
+        elif kind == "auth_del":
+            self._auth_db.pop(op["entity"], None)
+            self._sync_auth_keyring()
+            return
         else:
             log.error("mon.%d: unknown committed op %r", self.rank, kind)
             return
@@ -803,6 +825,130 @@ class Monitor:
                 out[sec] = dict(self._config_db[sec])
         return out
 
+    async def _auth_command(
+        self, prefix: str, cmd: dict[str, str],
+    ) -> tuple[int, str, bytes]:
+        """The AuthMonitor command slice (src/mon/AuthMonitor.cc
+        prepare_command): add / get-or-create / del / caps / get / ls.
+        ``caps`` argument is a JSON object {"mon": "allow r", ...}."""
+        import errno
+        import json
+
+        from ceph_tpu.common.caps import CapsError, validate
+        from ceph_tpu.msg.auth import make_secret
+
+        def parse_caps() -> dict[str, str]:
+            raw = cmd.get("caps", "")
+            caps = json.loads(raw) if raw else {}
+            if not isinstance(caps, dict):
+                raise CapsError("caps must be an object")
+            validate(caps)
+            return caps
+
+        entity = cmd.get("entity", "")
+        if prefix in ("auth add", "auth get-or-create", "auth del",
+                      "auth caps", "auth get") and not entity:
+            return -errno.EINVAL, "entity required", b""
+        if entity in getattr(self, "_bootstrap_entities", set()):
+            # construction-keyring identities are the cluster's root of
+            # trust (client.admin bootstrap): the command plane must
+            # not be able to rebind or delete them
+            return -errno.EPERM, f"{entity} is a bootstrap entity", b""
+        try:
+            if prefix == "auth add":
+                if entity in self._auth_db:
+                    return -errno.EEXIST, f"entity {entity} exists", b""
+                key = cmd.get("key") or make_secret().hex()
+                try:
+                    if len(bytes.fromhex(key)) not in (16, 24, 32):
+                        raise ValueError
+                except ValueError:
+                    # never let a malformed key reach paxos: applying
+                    # it would poison every restart's replay
+                    return -errno.EINVAL, "key must be 16/24/32 hex bytes", b""
+                await self._propose({
+                    "op": "auth_upsert", "entity": entity, "key": key,
+                    "caps": parse_caps(),
+                })
+                return 0, "added", json.dumps({"key": key}).encode()
+            if prefix == "auth get-or-create":
+                existing = self._auth_db.get(entity)
+                if existing is not None:
+                    if cmd.get("caps"):
+                        if parse_caps() != existing["caps"]:
+                            # the reference's EINVAL on caps mismatch:
+                            # a get-or-create never silently diverges
+                            # from what the caller asked for
+                            return (-errno.EINVAL,
+                                    "entity exists with different caps", b"")
+                    return 0, "exists", json.dumps(
+                        {"key": existing["key"]}).encode()
+                key = make_secret().hex()
+                await self._propose({
+                    "op": "auth_upsert", "entity": entity, "key": key,
+                    "caps": parse_caps(),
+                })
+                return 0, "created", json.dumps({"key": key}).encode()
+            if prefix == "auth del":
+                if entity not in self._auth_db:
+                    return -errno.ENOENT, f"no entity {entity}", b""
+                await self._propose({"op": "auth_del", "entity": entity})
+                return 0, "removed", b""
+            if prefix == "auth caps":
+                rec = self._auth_db.get(entity)
+                if rec is None:
+                    return -errno.ENOENT, f"no entity {entity}", b""
+                await self._propose({
+                    "op": "auth_upsert", "entity": entity,
+                    "key": rec["key"], "caps": parse_caps(),
+                })
+                return 0, "caps updated", b""
+            if prefix == "auth get":
+                rec = self._auth_db.get(entity)
+                if rec is None:
+                    return -errno.ENOENT, f"no entity {entity}", b""
+                return 0, "", json.dumps(
+                    {"entity": entity, **rec}).encode()
+            if prefix == "auth ls":
+                return 0, "", json.dumps({
+                    e: {"caps": r["caps"]}
+                    for e, r in sorted(self._auth_db.items())
+                }).encode()
+        except (CapsError, json.JSONDecodeError) as e:
+            return -errno.EINVAL, f"bad caps: {e}", b""
+        return -errno.EOPNOTSUPP, f"unknown {prefix!r}", b""
+
+    def _sync_auth_keyring(self) -> None:
+        """Mirror the paxos-committed auth database into the live
+        AuthContext so grants/tickets reflect it immediately (the
+        AuthMonitor -> KeyServer update path).  Statically-keyed
+        bootstrap entities (construction keyring) stay untouched."""
+        a = self.messenger.auth
+        if a is None:
+            return
+        synced = getattr(self, "_auth_synced", set())
+        for entity in synced - set(self._auth_db):
+            a.keyring.pop(entity, None)
+            a.caps_db.pop(entity, None)
+        ok: set[str] = set()
+        for entity, rec in self._auth_db.items():
+            if entity in self._bootstrap_entities:
+                continue  # never clobber the root of trust
+            try:
+                key = bytes.fromhex(rec["key"])
+                if len(key) not in (16, 24, 32):
+                    raise ValueError(len(key))
+            except ValueError:
+                # a poisoned record must degrade to "that entity can't
+                # auth", never to "the monitor can't restart"
+                log.error("mon.%d: unusable key for %s in auth db — "
+                          "skipped", self.rank, entity)
+                continue
+            a.keyring[entity] = key
+            a.caps_db[entity] = dict(rec["caps"])
+            ok.add(entity)
+        self._auth_synced = ok
+
     def _apply_config_locally(self) -> None:
         for sec in ("global", "mon", f"mon.{self.rank}"):
             for name, value in self._config_db.get(sec, {}).items():
@@ -831,19 +977,40 @@ class Monitor:
 
     # -- commands (the MonCommands.h slice) ----------------------------
 
-    async def _command(self, cmd: dict[str, str]) -> tuple[int, str, bytes]:
+    WRITE_PREFIXES = frozenset({
+        "osd erasure-code-profile set", "osd pool create",
+        "osd down", "osd out", "osd balance",
+        "osd pool selfmanaged-snap create",
+        "osd pool selfmanaged-snap rm",
+        "osd pool mksnap", "osd pool rmsnap",
+        "config set", "config rm", "osd crush reweight",
+        "osd pg-upmap-items",
+        "auth add", "auth get-or-create", "auth del", "auth caps",
+    })
+
+    async def _command(
+        self, cmd: dict[str, str], caps: dict[str, str] | None = None,
+    ) -> tuple[int, str, bytes]:
         import errno
         import json
 
         prefix = cmd.get("prefix", "")
-        mutating = prefix in (
-            "osd erasure-code-profile set", "osd pool create",
-            "osd down", "osd out", "osd balance",
-            "osd pool selfmanaged-snap create",
-            "osd pool selfmanaged-snap rm",
-            "osd pool mksnap", "osd pool rmsnap",
-            "config set", "config rm", "osd crush reweight",
-            "osd pg-upmap-items",
+        if caps is not None:
+            # MonCap admission (Monitor::_allowed_command): mutations
+            # need mon w, everything else mon r — EXCEPT the auth
+            # plane, which is admin-only end to end (the reference
+            # tags MonCommands.h auth verbs with mon rwx): 'auth get'
+            # returns secret keys and 'auth caps' rewrites grants, so
+            # plain r/w must not reach either
+            from ceph_tpu.common.caps import capable
+
+            if prefix.startswith("auth "):
+                need = "rwx"
+            else:
+                need = "w" if prefix in self.WRITE_PREFIXES else "r"
+            if not capable(caps, "mon", need):
+                return -errno.EACCES, "access denied", b""
+        mutating = prefix in self.WRITE_PREFIXES or prefix in (
             # not mutations, but only the leader ingests pg stats and
             # knows the live quorum: redirect so peons don't serve an
             # empty status plane
@@ -867,6 +1034,8 @@ class Monitor:
                 return 0, f"profile {name} set", b""
             if prefix == "osd pool create":
                 return await self._pool_create(cmd)
+            if prefix.startswith("auth "):
+                return await self._auth_command(prefix, cmd)
             if prefix == "osd pool selfmanaged-snap create":
                 pid = self._pool_ids[cmd["pool"]]
                 # serialize id allocation: two concurrent creates must

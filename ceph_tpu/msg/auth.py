@@ -70,24 +70,35 @@ def unseal(secret: bytes, blob: bytes) -> bytes:
 
 def mint_ticket(
     service_secret: bytes, entity: str, session_key: bytes,
-    ttl: float = TICKET_TTL,
+    ttl: float = TICKET_TTL, caps: dict[str, str] | None = None,
 ) -> bytes:
+    """Caps ride INSIDE the sealed ticket (the reference's
+    CephXServiceTicketInfo carrying AuthCapsInfo): validators learn
+    the peer's authorization without asking the mon."""
+    import json
+
     enc = Encoder()
     enc.str_(entity)
     enc.bytes_(session_key)
     enc.u64(int((time.time() + ttl) * 1000))
+    enc.str_(json.dumps(caps if caps is not None else {}))
     return seal(service_secret, enc.bytes())
 
 
-def open_ticket(service_secret: bytes, blob: bytes) -> tuple[str, bytes]:
-    """Returns (entity, session_key); raises on tamper or expiry."""
+def open_ticket(
+    service_secret: bytes, blob: bytes,
+) -> tuple[str, bytes, dict[str, str]]:
+    """Returns (entity, session_key, caps); raises on tamper/expiry."""
+    import json
+
     dec = Decoder(unseal(service_secret, blob))
     entity = dec.str_()
     session_key = dec.bytes_()
     expiry_ms = dec.u64()
     if time.time() * 1000 > expiry_ms:
         raise PermissionError(f"ticket for {entity} expired")
-    return entity, session_key
+    caps = json.loads(dec.str_())
+    return entity, session_key, caps
 
 
 # -- per-connection AEAD framing -------------------------------------------
@@ -141,30 +152,46 @@ class AuthContext:
         secret: bytes | None = None,
         service_secret: bytes | None = None,
         keyring: dict[str, bytes] | None = None,
+        caps_db: dict[str, dict[str, str]] | None = None,
     ):
         self.entity = entity
         self.secret = secret
         self.service_secret = service_secret
         self.keyring = keyring or {}
+        # entity -> caps dict (the AuthMonitor's view); keyring entries
+        # absent here get ADMIN caps — a statically-keyed entity is the
+        # client.admin bootstrap role
+        self.caps_db = caps_db or {}
         self.ticket: bytes | None = None       # from the mon (clients)
         self.session_key: bytes | None = None  # paired with self.ticket
 
     # server side: grant or validate -----------------------------------
 
-    def grant(self, entity: str) -> tuple[bytes, bytes, bytes] | None:
+    def caps_of(self, entity: str) -> dict[str, str]:
+        got = self.caps_db.get(entity)
+        if got is not None:
+            return got
+        from ceph_tpu.common.caps import ADMIN_CAPS
+
+        return dict(ADMIN_CAPS)
+
+    def grant(self, entity: str) -> tuple[bytes, bytes, bytes, dict] | None:
         """Mon-side (keyring holder): returns (sealed_grant, session_key,
-        ticket) for a known entity, None for an unknown one.  The grant
-        is sealed under the ENTITY's keyring secret — only the genuine
-        entity can recover the session key (cephx proof of possession)."""
+        ticket, caps) for a known entity, None for an unknown one.  The
+        grant is sealed under the ENTITY's keyring secret — only the
+        genuine entity can recover the session key (cephx proof of
+        possession); its caps are sealed into the ticket."""
         peer_secret = self.keyring.get(entity)
         if peer_secret is None or self.service_secret is None:
             return None
         session_key = make_secret()
-        ticket = mint_ticket(self.service_secret, entity, session_key)
+        caps = self.caps_of(entity)
+        ticket = mint_ticket(
+            self.service_secret, entity, session_key, caps=caps)
         enc = Encoder()
         enc.bytes_(session_key)
         enc.bytes_(ticket)
-        return seal(peer_secret, enc.bytes()), session_key, ticket
+        return seal(peer_secret, enc.bytes()), session_key, ticket, caps
 
     def open_grant(self, sealed: bytes) -> tuple[bytes, bytes]:
         """Client-side: recover (session_key, ticket) with our secret."""
@@ -176,9 +203,12 @@ class AuthContext:
         """Cluster daemons mint their own (ticket, session_key) — they
         hold the service secret, like the reference's OSDs holding the
         rotating service keys."""
+        from ceph_tpu.common.caps import ADMIN_CAPS
+
         assert self.service_secret is not None
         session_key = make_secret()
         return (
-            mint_ticket(self.service_secret, self.entity, session_key),
+            mint_ticket(self.service_secret, self.entity, session_key,
+                        caps=dict(ADMIN_CAPS)),
             session_key,
         )

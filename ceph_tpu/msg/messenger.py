@@ -100,6 +100,8 @@ class Connection:
         self._reader_task: asyncio.Task | None = None
         # msgr2 SECURE mode: set by the auth handshake; None = crc mode
         self.crypto = None
+        # peer authorization from its ticket; None = auth off (allow)
+        self.peer_caps: dict[str, str] | None = None
         # negotiated on-wire compressor (None = uncompressed)
         self.compressor = None
 
@@ -471,7 +473,8 @@ class Messenger:
             if a.service_secret is None:
                 raise PermissionError("cannot validate tickets")
             try:
-                t_entity, session_key = open_ticket(a.service_secret, ticket)
+                t_entity, session_key, peer_caps = open_ticket(
+                    a.service_secret, ticket)
             except PermissionError:
                 raise
             except Exception as e:  # InvalidTag / malformed blob
@@ -480,6 +483,9 @@ class Messenger:
                 raise PermissionError(
                     f"ticket entity {t_entity!r} != claimed {entity!r}"
                 )
+            # authorization rides the ticket (AuthCapsInfo): op
+            # admission reads it off the connection
+            conn.peer_caps = peer_caps
             enc = Encoder()
             enc.bool_(False)
             enc.bytes_(b"")
@@ -491,7 +497,8 @@ class Messenger:
             res = a.grant(entity)
             if res is None:
                 raise PermissionError(f"unknown entity {entity!r}")
-            sealed, session_key, _ticket = res
+            sealed, session_key, _ticket, peer_caps = res
+            conn.peer_caps = peer_caps
             enc = Encoder()
             enc.bool_(True)
             enc.bytes_(sealed)

@@ -1068,6 +1068,34 @@ class OSDDaemon:
             return MOSDOpReply(tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch)
         if not msg.ops:
             return MOSDOpReply(tid=msg.tid, result=-errno.EINVAL, epoch=self.epoch)
+        caps = getattr(msg.conn, "peer_caps", None)
+        if caps is not None:
+            # OSDCap admission (PrimaryLogPG::do_op op_has_sufficient_caps):
+            # the need is the UNION over sub-ops — a write-only cap
+            # must not smuggle a read by bundling it with a write —
+            # with class calls additionally requiring x; scoped to
+            # this pool.  A denial is EPERM, not a retry.
+            from ceph_tpu.common.caps import capable
+            from ceph_tpu.msg.messages import OP_CALL
+
+            need = set()
+            for o in msg.ops:
+                if o.op == OP_CALL:
+                    need.add("x")
+                    from ceph_tpu import cls as _cls
+
+                    cname, _, mname = (o.name or "").partition(".")
+                    need.add("w" if _cls.method_is_write(cname, mname)
+                             else "r")
+                elif o.is_write():
+                    need.add("w")
+                else:
+                    need.add("r")
+            pool_name = self.osdmap.pool_names.get(msg.pool, "")
+            if not capable(caps, "osd", "".join(sorted(need)),
+                           pool=pool_name):
+                return MOSDOpReply(
+                    tid=msg.tid, result=-errno.EPERM, epoch=self.epoch)
         pg = object_to_pg(pool, msg.oid)
         acting, primary = self._acting(pool, pg)
         if primary != self.id:

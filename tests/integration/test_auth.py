@@ -33,9 +33,10 @@ from .test_mini_cluster import run
 def test_ticket_and_seal_primitives():
     ss = make_secret()
     sk = make_secret()
-    blob = mint_ticket(ss, "client.7", sk)
-    entity, got = open_ticket(ss, blob)
+    blob = mint_ticket(ss, "client.7", sk, caps={"osd": "allow r"})
+    entity, got, caps = open_ticket(ss, blob)
     assert (entity, got) == ("client.7", sk)
+    assert caps == {"osd": "allow r"}
     with pytest.raises(Exception):
         open_ticket(make_secret(), blob)  # wrong service secret
     with pytest.raises(Exception):
@@ -163,5 +164,147 @@ class TestSecureCluster:
                 for o in c.osds:
                     if o.addr is not None:
                         await o.stop()
+
+        run(go())
+
+
+class TestAuthAdminAndCaps:
+    """The AuthMonitor command plane + cap enforcement at op admission
+    (src/mon/AuthMonitor.cc prepare_command, src/osd/OSDCap.cc): a
+    restricted entity is minted through `auth get-or-create`, its caps
+    ride the ticket, OSDs EPERM writes outside the grant, the mon
+    EACCESes mutations without mon w, and the database survives a mon
+    restart (round-3 VERDICT item 8 acceptance)."""
+
+    def test_restricted_client_end_to_end(self, tmp_path):
+        async def go():
+            import errno
+            import json
+
+            from ceph_tpu.client import RadosClient
+            from ceph_tpu.client.rados import RadosError
+            from ceph_tpu.store.filestore import FileStore
+
+            service_secret = make_secret()
+            admin_secret = make_secret()
+            crush = CrushMap()
+            B.build_hierarchy(crush, osds_per_host=1, n_hosts=4)
+            store = FileStore(str(tmp_path / "mon0"))
+            store.mount()
+            mon = Monitor(crush=crush, store=store, auth=AuthContext(
+                "mon.0", service_secret=service_secret,
+                keyring={"client.1": admin_secret},
+            ))
+            await mon.start()
+            osds = []
+            for i in range(4):
+                o = OSDDaemon(i, None, auth=AuthContext(
+                    f"osd.{i}", service_secret=service_secret))
+                o.mon_addrs = [mon.addr]
+                o.mon_addr = mon.addr
+                await o.start()
+                osds.append(o)
+            admin = RadosClient(client_id=1, auth=AuthContext(
+                "client.1", secret=admin_secret))
+            await admin.connect(*mon.addr)
+            await admin.pool_create("allowed", pg_num=4, size=3)
+            await admin.pool_create("forbidden", pg_num=4, size=3)
+
+            # mint a restricted user: mon read-only, osd rw on ONE pool
+            code, rs, data = await admin.command({
+                "prefix": "auth get-or-create", "entity": "client.77",
+                "caps": json.dumps({
+                    "mon": "allow r",
+                    "osd": "allow rw pool=allowed",
+                }),
+            })
+            assert code == 0, rs
+            key = bytes.fromhex(json.loads(data)["key"])
+            # get-or-create again returns the same key
+            code, _, data2 = await admin.command({
+                "prefix": "auth get-or-create", "entity": "client.77",
+            })
+            assert code == 0
+            assert json.loads(data2)["key"] == key.hex()
+
+            limited = RadosClient(client_id=77, auth=AuthContext(
+                "client.77", secret=key))
+            await limited.connect(*mon.addr)
+            io_ok = limited.ioctx("allowed")
+            await io_ok.write_full("obj", b"permitted")
+            assert await io_ok.read("obj") == b"permitted"
+            # outside the pool grant: EPERM (no retry storm)
+            io_no = limited.ioctx("forbidden")
+            with pytest.raises(RadosError) as ei:
+                await io_no.write_full("obj", b"nope")
+            assert ei.value.errno == errno.EPERM
+            with pytest.raises(RadosError) as ei:
+                await io_no.read("obj")
+            assert ei.value.errno == errno.EPERM
+            # mon mutation without mon w: EACCES
+            code, rs, _ = await limited.command({
+                "prefix": "osd pool create", "name": "x",
+                "pg_num": "4", "pool_type": "replicated"})
+            assert code == -errno.EACCES
+            # mon reads still fine
+            code, _, _ = await limited.command({"prefix": "status"})
+            assert code == 0
+
+            # cap update tightens live grants for NEW sessions
+            code, rs, _ = await admin.command({
+                "prefix": "auth caps", "entity": "client.77",
+                "caps": json.dumps({
+                    "mon": "allow r", "osd": "allow r pool=allowed"}),
+            })
+            assert code == 0, rs
+            limited2 = RadosClient(client_id=77, auth=AuthContext(
+                "client.77", secret=key))
+            await limited2.connect(*mon.addr)
+            io2 = limited2.ioctx("allowed")
+            assert await io2.read("obj") == b"permitted"
+            with pytest.raises(RadosError) as ei:
+                await io2.write_full("obj", b"now denied")
+            assert ei.value.errno == errno.EPERM
+
+            # unknown-entity deletion + listing
+            code, _, data = await admin.command({"prefix": "auth ls"})
+            assert "client.77" in json.loads(data)
+            await limited.shutdown()
+            await limited2.shutdown()
+
+            # mon restart: auth db survives via the paxos store
+            await admin.shutdown()
+            await mon.stop()
+            store2 = FileStore(str(tmp_path / "mon0"))
+            store2.mount()
+            mon2 = Monitor(crush=crush, store=store2, auth=AuthContext(
+                "mon.0", service_secret=service_secret,
+                keyring={"client.1": admin_secret},
+            ))
+            await mon2.start()
+            limited3 = RadosClient(client_id=77, auth=AuthContext(
+                "client.77", secret=key))
+            await limited3.connect(*mon2.addr)  # key survived restart
+            # auth get is ADMIN-only (it returns secret keys): the
+            # restricted client gets EACCES even with mon r
+            code, _, _ = await limited3.command(
+                {"prefix": "auth get", "entity": "client.77"})
+            assert code == -errno.EACCES
+            admin2 = RadosClient(client_id=1, auth=AuthContext(
+                "client.1", secret=admin_secret))
+            await admin2.connect(*mon2.addr)
+            code, _, data = await admin2.command(
+                {"prefix": "auth get", "entity": "client.77"})
+            assert code == 0
+            assert json.loads(data)["caps"]["osd"] == "allow r pool=allowed"
+            # bootstrap identities are untouchable via the command plane
+            code, _, _ = await admin2.command(
+                {"prefix": "auth del", "entity": "client.1"})
+            assert code == -errno.EPERM
+            await admin2.shutdown()
+            await limited3.shutdown()
+            for o in osds:
+                await o.stop()
+            await mon2.stop()
 
         run(go())
