@@ -103,6 +103,10 @@ declare(
     Option("mon_target_pg_per_osd", int, 100, LEVEL_ADVANCED,
            "target PG replicas per OSD driving pg_autoscaler "
            "recommendations (reference mon_target_pg_per_osd)", min=1),
+    Option("mon_pg_autoscale_interval", float, 0.0, LEVEL_ADVANCED,
+           "seconds between pg_autoscaler acting passes on pools with "
+           "pg_autoscale_mode=on (reference pg_autoscaler sleep "
+           "interval); 0 disables the acting loop", min=0.0),
     Option("osd_ec_extent_cache_bytes", int, 32 * 1024 * 1024, LEVEL_ADVANCED,
            "primary-side cache of recently written EC stripe ranges so "
            "hot RMW overwrites skip the shard read (ExtentCache role, "

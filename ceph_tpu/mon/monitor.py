@@ -197,6 +197,9 @@ class Monitor:
         await self._replay()
         if self.beacon_grace > 0:
             self._tick_task = asyncio.ensure_future(self._tick())
+        if self.conf["mon_pg_autoscale_interval"] > 0:
+            self._autoscale_task = asyncio.ensure_future(
+                self._autoscale_tick())
         return self.addr
 
     async def _replay(self) -> None:
@@ -307,6 +310,8 @@ class Monitor:
             await self._admin.stop()
         if self._tick_task:
             self._tick_task.cancel()
+        if getattr(self, "_autoscale_task", None):
+            self._autoscale_task.cancel()
         await self.messenger.shutdown()
 
     # -- quorum plumbing ----------------------------------------------
@@ -621,6 +626,44 @@ class Monitor:
                 om.pg_upmap_items[pg_t(pool, ps)] = [
                     (f, t) for f, t in pairs
                 ]
+        elif kind == "pool_set":
+            pool = om.pools.get(op["pool"])
+            if pool is None:
+                return
+            var, val = op["var"], op["val"]
+            if var == "pg_num":
+                n = int(val)
+                if n <= pool.pg_num:
+                    return  # replay / stale
+                # pgp_num follows pg_num in one step: children place
+                # independently at once, and recovery pulls them from
+                # the parent's prior interval (ancestor-aware)
+                pool.pg_num = n
+                pool.pgp_num = n
+            elif var == "size":
+                pool.size = int(val)
+            elif var == "min_size":
+                pool.min_size = int(val)
+            else:
+                pool.extra[var] = val
+        elif kind == "pool_rm":
+            pid = op["pool"]
+            if pid not in om.pools:
+                return
+            name = om.pool_names.pop(pid, None)
+            om.pools.pop(pid, None)
+            if name is not None:
+                self._pool_ids.pop(name, None)
+            # dead placement overrides must not haunt the map forever
+            # (the reference clears upmap/pg_temp on pool deletion)
+            for d in (om.pg_upmap, om.pg_upmap_items, om.pg_temp):
+                for key in [k for k in d if k.pool == pid]:
+                    del d[key]
+        elif kind == "in":
+            osd = op["osd"]
+            if not om.exists(osd) or not om.is_out(osd):
+                return
+            om.osd_weight[osd] = 0x10000
         elif kind == "auth_upsert":
             self._auth_db[op["entity"]] = {
                 "key": op["key"], "caps": dict(op["caps"]),
@@ -825,6 +868,135 @@ class Monitor:
                 out[sec] = dict(self._config_db[sec])
         return out
 
+    def _autoscale_rows(self) -> list[dict]:
+        """pg_autoscaler sizing math: ideal pg count ~ eligible osds *
+        mon_target_pg_per_osd / size, rounded to a power of two."""
+        om2 = self.osdmap
+        target = self.conf["mon_target_pg_per_osd"]
+
+        def _eligible(pool) -> int:
+            rule = om2.crush.rules.get(pool.crush_rule)
+            cls = getattr(rule, "device_class", None)
+            n = sum(
+                1 for o in range(om2.max_osd)
+                if om2.exists(o) and not om2.is_out(o)
+                and (cls is None
+                     or om2.crush.device_classes.get(o) == cls)
+            )
+            return n or 1
+
+        rows = []
+        for pid, pool in sorted(om2.pools.items()):
+            n_in = _eligible(pool)
+            ideal = max(1, n_in * target // max(1, pool.size))
+            # nearest power of two, min 1
+            p2 = 1 << max(0, ideal.bit_length() - 1)
+            if ideal - p2 > (p2 * 2) - ideal:
+                p2 *= 2
+            rows.append({
+                "pool": om2.pool_names.get(pid, str(pid)),
+                "pool_id": pid,
+                "size": pool.size,
+                "pg_num": pool.pg_num,
+                "new_pg_num": p2,
+                "autoscale_mode": pool.extra.get(
+                    "pg_autoscale_mode", "off"),
+                "would_adjust": p2 != pool.pg_num,
+            })
+        return rows
+
+    async def _autoscale_tick(self) -> None:
+        """The acting half of the pg_autoscaler: pools that opted in
+        (pg_autoscale_mode=on) get their advised pg_num APPLIED through
+        paxos — reference src/pybind/mgr/pg_autoscaler/module.py
+        _maybe_adjust.  Grow-only (pg merge unsupported)."""
+        interval = self.conf["mon_pg_autoscale_interval"]
+        while True:
+            await asyncio.sleep(interval)
+            if not self.is_leader:
+                continue
+            try:
+                for row in self._autoscale_rows():
+                    pool = self.osdmap.pools.get(row["pool_id"])
+                    if (
+                        pool is None
+                        or pool.extra.get("pg_autoscale_mode") != "on"
+                        or row["new_pg_num"] <= pool.pg_num
+                    ):
+                        continue
+                    log.info("mon.%d: autoscaler growing pool %d "
+                             "pg_num %d -> %d", self.rank,
+                             row["pool_id"], pool.pg_num,
+                             row["new_pg_num"])
+                    await self._propose({
+                        "op": "pool_set", "pool": row["pool_id"],
+                        "var": "pg_num",
+                        "val": str(row["new_pg_num"]),
+                    })
+            except Exception:
+                log.exception("mon.%d: autoscale tick failed", self.rank)
+
+    def _pool_by_name(self, name: str):
+        import errno
+
+        pid = self.osdmap.lookup_pg_pool_name(name)
+        if pid < 0:
+            raise OSError(errno.ENOENT, f"no pool {name!r}")
+        return pid, self.osdmap.pools[pid]
+
+    async def _pool_set(self, cmd: dict[str, str]) -> tuple[int, str, bytes]:
+        """osd pool set <pool> <var> <val> (OSDMonitor::prepare_command
+        pool ops, src/mon/OSDMonitor.cc:7339+).  pg_num increases split
+        PGs on the OSDs; merges are not supported (EPERM)."""
+        import errno
+
+        pid, pool = self._pool_by_name(cmd["pool"])
+        var, val = cmd["var"], cmd["val"]
+        if var == "pg_num":
+            n = int(val)
+            if n < pool.pg_num:
+                return -errno.EPERM, "pg merge not supported", b""
+            if n == pool.pg_num:
+                return 0, "no change", b""
+            if n > 65536:
+                return -errno.ERANGE, "pg_num too large", b""
+        elif var in ("size", "min_size"):
+            n = int(val)
+            if not 1 <= n <= 16:
+                return -errno.EINVAL, f"bad {var}", b""
+            if var == "size" and pool.type != 1:  # replicated only
+                return -errno.EPERM, "size is fixed for EC pools", b""
+            if var == "size" and n < pool.min_size:
+                return -errno.EINVAL, "size < min_size", b""
+            if var == "min_size" and n > pool.size:
+                return -errno.EINVAL, "min_size > size", b""
+        elif var == "pg_autoscale_mode":
+            if val not in ("on", "off"):
+                return -errno.EINVAL, "pg_autoscale_mode: on|off", b""
+        elif var == "fast_read":
+            if val not in ("0", "1"):
+                return -errno.EINVAL, "fast_read: 0|1", b""
+        else:
+            return -errno.EINVAL, f"unsettable var {var!r}", b""
+        await self._propose({
+            "op": "pool_set", "pool": pid, "var": var, "val": str(val),
+        })
+        return 0, f"set pool {cmd['pool']} {var} to {val}", b""
+
+    async def _pool_rm(self, cmd: dict[str, str]) -> tuple[int, str, bytes]:
+        """osd pool rm <pool> <pool-again> --yes-i-really-really-mean-it
+        (the reference's double-confirmation)."""
+        import errno
+
+        pid, _pool = self._pool_by_name(cmd["pool"])
+        if cmd.get("pool2") != cmd["pool"] or cmd.get(
+                "sure") != "--yes-i-really-really-mean-it":
+            return (-errno.EPERM,
+                    "pass the pool name twice and "
+                    "--yes-i-really-really-mean-it", b"")
+        await self._propose({"op": "pool_rm", "pool": pid})
+        return 0, f"pool {cmd['pool']} removed", b""
+
     async def _auth_command(
         self, prefix: str, cmd: dict[str, str],
     ) -> tuple[int, str, bytes]:
@@ -986,6 +1158,7 @@ class Monitor:
         "config set", "config rm", "osd crush reweight",
         "osd pg-upmap-items",
         "auth add", "auth get-or-create", "auth del", "auth caps",
+        "osd pool set", "osd pool rm", "osd in",
     })
 
     async def _command(
@@ -1036,6 +1209,19 @@ class Monitor:
                 return await self._pool_create(cmd)
             if prefix.startswith("auth "):
                 return await self._auth_command(prefix, cmd)
+            if prefix == "osd pool set":
+                return await self._pool_set(cmd)
+            if prefix == "osd pool rm":
+                return await self._pool_rm(cmd)
+            if prefix == "osd in":
+                osd = int(cmd["id"])
+                om = self.osdmap
+                if not om.exists(osd):
+                    return -errno.ENOENT, f"osd.{osd} does not exist", b""
+                if not om.is_out(osd):
+                    return 0, f"osd.{osd} is already in", b""
+                await self._propose({"op": "in", "osd": osd})
+                return 0, f"marked in osd.{osd}", b""
             if prefix == "osd pool selfmanaged-snap create":
                 pid = self._pool_ids[cmd["pool"]]
                 # serialize id allocation: two concurrent creates must
@@ -1232,43 +1418,11 @@ class Monitor:
                 })
                 return 0, f"reweighted {name} to {cmd['weight']}", b""
             if prefix == "osd pool autoscale-status":
-                # the pg_autoscaler mgr module's sizing math, advisory
-                # (reference src/pybind/mgr/pg_autoscaler: ideal pg
-                # count ~ osds * mon_target_pg_per_osd / size, rounded
-                # to a power of two; applying a change needs pg
-                # splitting, which is out of scope — NEW_PG_NUM is a
-                # recommendation, exactly what the module surfaces)
-                om2 = self.osdmap
-                target = self.conf["mon_target_pg_per_osd"]
-
-                def _eligible(pool) -> int:
-                    rule = om2.crush.rules.get(pool.crush_rule)
-                    cls = getattr(rule, "device_class", None)
-                    n = sum(
-                        1 for o in range(om2.max_osd)
-                        if om2.exists(o) and not om2.is_out(o)
-                        and (cls is None
-                             or om2.crush.device_classes.get(o) == cls)
-                    )
-                    return n or 1
-
-                rows = []
-                for pid, pool in sorted(om2.pools.items()):
-                    n_in = _eligible(pool)
-                    ideal = max(1, n_in * target // max(1, pool.size))
-                    # nearest power of two, min 1
-                    p2 = 1 << max(0, ideal.bit_length() - 1)
-                    if ideal - p2 > (p2 * 2) - ideal:
-                        p2 *= 2
-                    rows.append({
-                        "pool": om2.pool_names.get(pid, str(pid)),
-                        "pool_id": pid,
-                        "size": pool.size,
-                        "pg_num": pool.pg_num,
-                        "new_pg_num": p2,
-                        "would_adjust": p2 != pool.pg_num,
-                    })
-                return 0, "", json.dumps(rows).encode()
+                # the pg_autoscaler mgr module's sizing math
+                # (reference src/pybind/mgr/pg_autoscaler).  Advisory
+                # here; pools with pg_autoscale_mode=on get the advice
+                # APPLIED by _autoscale_tick (pg splitting exists now)
+                return 0, "", json.dumps(self._autoscale_rows()).encode()
             if prefix == "health":
                 h = self._health_checks()
                 return 0, h["status"], json.dumps(h).encode()

@@ -723,9 +723,14 @@ class OSDDaemon:
             elif isinstance(msg, MOSDPGPush):
                 await self._handle_push(msg)
             elif isinstance(msg, MOSDPGQuery):
-                await self._handle_pg_query(msg)
+                # peering messages may wait for map catch-up
+                # (_wait_for_epoch): run off the connection's dispatch
+                # loop so in-flight client sub-ops on the same pipe
+                # don't queue behind the wait (the reference parks
+                # these on a waiting_for_map queue the same way)
+                self._spawn_peering(self._handle_pg_query(msg))
             elif isinstance(msg, MOSDPGLog):
-                await self._handle_pg_log(msg)
+                self._spawn_peering(self._handle_pg_log(msg))
             elif isinstance(msg, MOSDScrub):
                 asyncio.ensure_future(self._handle_scrub(msg))
             elif isinstance(
@@ -754,6 +759,8 @@ class OSDDaemon:
             self.osdmap = new_map
             self._maybe_snap_trim(old_map, new_map)
             self._track_intervals(old_map, new_map)
+            self._maybe_split_pgs(old_map, new_map)
+            self._gc_removed_pools(old_map, new_map)
         if gap:
             # ask the mon for the missing range (or a full map)
             await self._request_map_fill()
@@ -845,8 +852,18 @@ class OSDDaemon:
                 pg = pg_t(pid, ps)
                 _u, _up, acting, _p = new_map.pg_to_up_acting_osds(
                     pg, folded=True)
-                _u2, _up2, acting_old, _p2 = old_map.pg_to_up_acting_osds(
-                    pg, folded=True)
+                if ps >= old_pool.pg_num:
+                    # a split child did not exist under the old map:
+                    # its history starts at its ANCESTOR's home (the
+                    # reference's pg_t::get_ancestor in
+                    # PastIntervals::check_new_interval) — that's where
+                    # the refiled objects physically sit
+                    anc = old_pool.raw_pg_to_pg(pg_t(pid, ps))
+                    _u2, _up2, acting_old, _p2 = (
+                        old_map.pg_to_up_acting_osds(anc, folded=True))
+                else:
+                    _u2, _up2, acting_old, _p2 = (
+                        old_map.pg_to_up_acting_osds(pg, folded=True))
                 if acting_old == acting:
                     continue
                 if self.id not in acting and self.id not in acting_old:
@@ -922,6 +939,119 @@ class OSDDaemon:
                 seen.add((s, o))
                 out.append((s, o))
         return out
+
+    def _maybe_split_pgs(self, old_map, new_map) -> None:
+        """PG splitting, local half (the reference's PG::split_colls /
+        OSD::split_pgs, src/osd/OSD.cc + PG.cc): when a pool's pg_num
+        grows, every local object whose name now folds to a child ps
+        moves into the child's collection via collection_move_rename —
+        the same primitive the reference's split uses.  The cluster
+        half (children placing onto new OSDs) is ordinary recovery:
+        _track_intervals records the parent's old acting set as the
+        child's prior interval, so the child's primary pulls from the
+        members holding the refiled data.
+
+        Runs on EVERY first map after boot too (old_map None): a crash
+        mid-split leaves misfolded objects behind, and the reconcile
+        pass refiles them from persistent stores."""
+        pools = new_map.pools.items()
+        if old_map is not None:
+            pools = [
+                (pid, p) for pid, p in pools
+                if pid in old_map.pools
+                and p.pg_num > old_map.pools[pid].pg_num
+            ]
+        for _pid, pool in pools:
+            try:
+                moved = self._refile_split_collections(pool)
+            except Exception:
+                log.exception("osd.%d: pg split refile failed", self.id)
+                continue
+            if moved:
+                log.info("osd.%d: pg split pool %d: refiled %d objects",
+                         self.id, pool.id, moved)
+                # split invalidates the parent PGs' clean verdicts
+                for key in list(self._clean_epoch):
+                    if key[0] == pool.id:
+                        del self._clean_epoch[key]
+
+    def _refile_split_collections(self, pool) -> int:
+        from ceph_tpu.store.objectstore import META_COLL
+
+        moved = 0
+        for c in list(self.store.list_collections()):
+            if c.pool != pool.id or c == META_COLL:
+                continue
+            if c.ps >= pool.pg_num:
+                continue  # stale collection beyond the map (merge-only)
+            try:
+                objs = list(self.store.collection_list(c))
+            except FileNotFoundError:
+                continue
+            t = Transaction()
+            made: set = set()
+            children: set[int] = set()
+            for o in objs:
+                if o.name == PGMETA_OID:
+                    continue
+                newps = pool.raw_pg_to_pg(object_to_pg(pool, o.name)).ps
+                if newps == c.ps:
+                    continue
+                dst = coll_t(pool.id, newps, c.shard)
+                if dst not in made and not self.store.collection_exists(dst):
+                    t.create_collection(dst)
+                    made.add(dst)
+                # clones (snap != head) ride along with the same id
+                t.collection_move_rename(c, o, dst, o)
+                children.add(newps)
+                moved += 1
+            # the log splits with the data (PGLog::split_into): each
+            # child inherits the entries for its objects AND the
+            # parent's version bounds, in the SAME transaction
+            parent_lg = self._pg_log(c)
+            for ps in sorted(children):
+                dst = coll_t(pool.id, ps, c.shard)
+                parent_lg.split_into(
+                    t, self._pg_log(dst),
+                    lambda oid, _ps=ps: pool.raw_pg_to_pg(
+                        object_to_pg(pool, oid)).ps == _ps,
+                )
+            if not t.empty():
+                self.store.queue_transaction(t)
+        return moved
+
+    def _gc_removed_pools(self, old_map, new_map) -> None:
+        """Deleted pools leave orphan collections (the reference's
+        pg-removal on pool deletion): drop them locally."""
+        if old_map is None:
+            gone = {
+                c.pool for c in self.store.list_collections()
+                if c.pool >= 0 and c.pool not in new_map.pools
+            }
+        else:
+            gone = set(old_map.pools) - set(new_map.pools)
+        if not gone:
+            return
+        try:
+            t = Transaction()
+            for c in list(self.store.list_collections()):
+                if c.pool in gone:
+                    try:
+                        objs = list(self.store.collection_list(c))
+                    except FileNotFoundError:
+                        continue
+                    for o in objs:
+                        t.remove(c, o)
+                    t.remove_collection(c)
+                    self._pg_logs.pop(c, None)
+            if not t.empty():
+                self.store.queue_transaction(t)
+                log.info("osd.%d: removed collections of deleted pools %s",
+                         self.id, sorted(gone))
+        except Exception:
+            # gc must never abort map handling (the map swap already
+            # happened; waiters and recovery still need their kicks)
+            log.exception("osd.%d: pool gc failed", self.id)
 
     def _maybe_snap_trim(self, old_map, new_map) -> None:
         """Schedule the snap trimmer for pools whose removed_snaps grew
@@ -3169,7 +3299,36 @@ class OSDDaemon:
             entries=entries, epoch=self.epoch, tail=tail,
         ), tid)
 
+    def _spawn_peering(self, coro) -> None:
+        """Run a peering handler as its own task, strongly referenced
+        (the loop holds tasks weakly)."""
+        task = asyncio.ensure_future(coro)
+        tasks = getattr(self, "_peering_tasks", None)
+        if tasks is None:
+            tasks = self._peering_tasks = set()
+        tasks.add(task)
+        task.add_done_callback(tasks.discard)
+
+    async def _wait_for_epoch(self, epoch: int, timeout: float = 10.0) -> None:
+        """Peering messages are meaningful only at (or after) the
+        sender's epoch — the reference queues them behind map catch-up
+        (OSD::wait_for_new_map).  Without this, a primary splitting a
+        PG can query a peer that hasn't refiled yet, read an empty
+        child collection, and wrongly conclude the PG is clean."""
+        if self.epoch >= epoch:
+            return
+        try:
+            await self._request_map_fill()
+        except (ConnectionError, OSError):
+            pass
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while (self.epoch < epoch and loop.time() < deadline
+               and not self.stopping):
+            await asyncio.sleep(0.05)
+
     async def _handle_pg_query(self, msg: MOSDPGQuery) -> None:
+        await self._wait_for_epoch(msg.epoch)
         pool = self.osdmap.get_pg_pool(msg.pg.pool)
         c = self._shard_coll(pool, msg.pg, msg.shard)
         lg = self._pg_log(c)
@@ -3196,6 +3355,7 @@ class OSDDaemon:
         ))
 
     async def _handle_pg_log(self, msg: MOSDPGLog) -> None:
+        await self._wait_for_epoch(msg.epoch)
         pool = self.osdmap.get_pg_pool(msg.pg.pool)
         c = self._shard_coll(pool, msg.pg, msg.shard)
         lg = self._pg_log(c)

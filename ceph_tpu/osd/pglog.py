@@ -225,6 +225,35 @@ class PGLog:
             )
         t.omap_setkeys(self.cid, self.meta, {INFO_KEY: self.info.encode()})
 
+    def split_into(self, t: Transaction, child: "PGLog", belongs) -> None:
+        """PGLog::split_into twin (reference src/osd/PGLog.h split_into,
+        called from PG::split_into on pg_num growth): entries whose
+        object now folds into the child pg MOVE to the child's log;
+        BOTH logs keep the parent's version bounds (last_update /
+        log_tail continue the parent's eversion sequence), so
+        post-split authority comparisons between members remain
+        meaningful — without this, children born with empty logs make
+        an empty member look authoritative and refiled objects get
+        reaped as strays."""
+        moved = [e for e in self.entries.values() if belongs(e.oid)]
+        child.info.last_update = self.info.last_update
+        child.info.log_tail = self.info.log_tail
+        t.touch(child.cid, child.meta)
+        kv = {INFO_KEY: child.info.encode()}
+        for e in moved:
+            child.entries[e.version] = e
+            child._track_reqid(e)
+            kv[LOG_KEY_PREFIX + e.version.key()] = e.encode()
+        t.omap_setkeys(child.cid, child.meta, kv)
+        if moved:
+            for e in moved:
+                del self.entries[e.version]
+            t.touch(self.cid, self.meta)
+            t.omap_rmkeys(self.cid, self.meta, [
+                LOG_KEY_PREFIX + e.version.key() for e in moved
+            ])
+        t.omap_setkeys(self.cid, self.meta, {INFO_KEY: self.info.encode()})
+
     # -- persistence ---------------------------------------------------
 
     def load(self, store: ObjectStore) -> None:
