@@ -525,6 +525,86 @@ def _indep_window(
     return out, out2
 
 
+def _msr_descend_j(jm, rew, x, bidx0, type_, r_value, pos, enabled):
+    """crush_msr_descend twin (ceph_tpu/crush/mapper.py:433, reference
+    mapper.c:1274) as a bounded while_loop over the dense bucket graph:
+    draw at each level until a device or a bucket of ``type_``.
+    Returns (item, child_idx) — item == CRUSH_ITEM_NONE encodes every
+    map-integrity reject (empty bucket, dangling child, oversized
+    device id), which the caller treats as a collision."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    i32 = jnp.int32
+    none = i32(CRUSH_ITEM_NONE)
+
+    def cond(st):
+        depth, bidx, done, _it, _ci = st
+        return ~done & (depth < jm.nb + 2)
+
+    def body(st):
+        depth, bidx, done, it, ci = st
+        empty = jm.size[bidx] == 0
+        item, cidx = _straw2_choose(jm, rew, bidx, x, r_value, pos)
+        is_dev = item >= 0
+        dev_ok = is_dev & (item < jm.max_devices)
+        known = cidx >= 0
+        btype = jm.btype[jnp.clip(cidx, 0, jm.nb - 1)]
+        hit_type = ~is_dev & known & (btype == type_)
+        stop = empty | is_dev | ~known | hit_type
+        new_it = jnp.where(
+            empty | (is_dev & ~dev_ok) | (~is_dev & ~known),
+            none, item)
+        return (depth + 1, jnp.where(stop, bidx, cidx), stop,
+                jnp.where(stop, new_it, it),
+                jnp.where(stop & hit_type, cidx, jnp.where(stop, i32(-1), ci)))
+
+    _d, _b, _done, item, cidx = lax.while_loop(
+        cond, body, (i32(0), bidx0, ~enabled, none, i32(-1)))
+    return item, cidx
+
+
+def _msr_window(idxs, lo, hi):
+    return (idxs >= lo) & (idxs < hi)
+
+
+def _msr_push_j(vec, s_lo, s_hi, cand, do):
+    """crush_msr_push_used twin: set the first UNDEF slot in the
+    stride window unless the candidate is already there.  Returns
+    (vec, pushed)."""
+    import jax.numpy as jnp
+
+    idxs = jnp.arange(vec.shape[0], dtype=jnp.int32)
+    win = _msr_window(idxs, s_lo, s_hi)
+    present = jnp.any(win & (vec == cand))
+    slots = win & (vec == CRUSH_ITEM_UNDEF)
+    pos = jnp.argmax(slots).astype(jnp.int32)
+    pushed = do & ~present & jnp.any(slots)
+    return jnp.where(pushed, vec.at[pos].set(cand), vec), pushed
+
+
+def _msr_pop_j(vec, s_lo, s_hi, cand, do):
+    """crush_msr_pop_used twin: clear the last slot == cand in the
+    stride window."""
+    import jax.numpy as jnp
+
+    rm = vec.shape[0]
+    idxs = jnp.arange(rm, dtype=jnp.int32)
+    eq = _msr_window(idxs, s_lo, s_hi) & (vec == cand)
+    pos = (rm - 1 - jnp.argmax(eq[::-1])).astype(jnp.int32)
+    return jnp.where(do & jnp.any(eq), vec.at[pos].set(CRUSH_ITEM_UNDEF), vec)
+
+
+def _msr_valid_j(vec, seg_lo, seg_hi, s_lo, s_hi, cand):
+    """crush_msr_valid_candidate twin: a candidate used elsewhere in
+    the segment is invalid unless that use is inside our own stride."""
+    import jax.numpy as jnp
+
+    idxs = jnp.arange(vec.shape[0], dtype=jnp.int32)
+    hit = _msr_window(idxs, seg_lo, seg_hi) & (vec == cand)
+    return jnp.all(~hit | _msr_window(idxs, s_lo, s_hi))
+
+
 def _append(acc, cnt, vals, n, rm):
     """result.extend(vals[:n]) with a dump slot at index rm."""
     import jax.numpy as jnp
@@ -552,19 +632,33 @@ class BatchedRuleMapper:
         self._jitted = None
 
     def _validate(self):
+        from ceph_tpu.crush.types import (
+            RULE_TYPE_MSR_FIRSTN,
+            RULE_TYPE_MSR_INDEP,
+        )
+
         t = self.cc.tunables
         if t.choose_local_fallback_tries:
             raise UnsupportedMap("choose_local_fallback_tries > 0")
+        if self.rule.rule_type in (RULE_TYPE_MSR_FIRSTN,
+                                   RULE_TYPE_MSR_INDEP):
+            # MSR rules take the dedicated lane (_msr_lane); only MSR
+            # step kinds may appear (crush_msr_do_rule rejects others)
+            for s in self.rule.steps:
+                if s.op not in (
+                    RuleOp.NOOP, RuleOp.TAKE, RuleOp.EMIT,
+                    RuleOp.CHOOSE_MSR, RuleOp.SET_MSR_DESCENTS,
+                    RuleOp.SET_MSR_COLLISION_TRIES,
+                ):
+                    raise UnsupportedMap(f"MSR rule op {s.op!r}")
+            return
         for s in self.rule.steps:
             if s.op == RuleOp.SET_CHOOSE_LOCAL_FALLBACK_TRIES and s.arg1 > 0:
                 raise UnsupportedMap("rule sets local_fallback_tries")
             if s.op in (RuleOp.CHOOSE_MSR, RuleOp.SET_MSR_DESCENTS,
                         RuleOp.SET_MSR_COLLISION_TRIES):
-                # MSR descent retries the whole path on a rejected leaf
-                # with data-dependent backtracking depth — expressed
-                # scalar for now; osd/remap.py transparently routes MSR
-                # rules through the scalar pipeline
-                raise UnsupportedMap("MSR rules take the scalar pipeline")
+                raise UnsupportedMap(
+                    "MSR step in a non-MSR rule")
             if s.op not in (
                 RuleOp.NOOP, RuleOp.TAKE, RuleOp.EMIT,
                 RuleOp.CHOOSE_FIRSTN, RuleOp.CHOOSE_INDEP,
@@ -576,10 +670,209 @@ class BatchedRuleMapper:
             ):
                 raise UnsupportedMap(f"rule op {s.op!r}")
 
+    # -- MSR lane (crush_msr_do_rule, mapper.c:1809) -------------------
+
+    def _msr_lane(self, jm: _Jm, class_mask, x, rew):
+        """Batched crush_msr_do_rule: the rule's stride tree is STATIC
+        (stride boundaries derive from step arg1 counts and
+        result_max), so the whole multi-step descent unrolls at trace
+        time; the data-dependent parts — whole-descent retries
+        (msr_descents), per-stride collision retries
+        (msr_collision_tries) and the bucket-graph descent — run as
+        bounded while_loops.  Statement-level twin of the scalar
+        _msr_do_rule/_msr_choose (ceph_tpu/crush/mapper.py:519-680,
+        reference mapper.c:1507,1809), pinned by the same golden
+        vectors."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ceph_tpu.crush.mapper import (
+            _msr_scan_config_steps,
+            _msr_scan_next,
+        )
+        from ceph_tpu.crush.types import RULE_TYPE_MSR_FIRSTN
+
+        cc = self.cc
+        rm = self.result_max
+        rule = self.rule
+        i32 = jnp.int32
+        none = i32(CRUSH_ITEM_NONE)
+        undef = i32(CRUSH_ITEM_UNDEF)
+        firstn = rule.rule_type == RULE_TYPE_MSR_FIRSTN
+
+        if class_mask is not None:
+            rew = jnp.where(class_mask, rew, 0)
+
+        t = cc.tunables
+        start_stepno, descents, collision_tries = _msr_scan_config_steps(rule)
+        if descents is None:
+            descents = t.msr_descents
+        if collision_tries is None:
+            collision_tries = t.msr_collision_tries
+
+        out = jnp.full((rm + 1,), none, jnp.int32)
+        returned = i32(0)
+
+        def emit(out, returned, cand, position, do):
+            pos = returned if firstn else i32(position)
+            out = jnp.where(do, out.at[pos].set(cand), out)
+            return out, returned + do
+
+        def choose(vecs, out, returned, bidx, tryno, enabled,
+                   lo, hi, total, stepno, seg_start_stepno, emit_stepno):
+            """_msr_choose (mapper.c:1507): one level, strides
+            unrolled.  ``total`` is the NOMINAL descendant count
+            (stride boundaries use it; windows clip to ``hi`` exactly
+            like the scalar's end_index).  The validity exclusion
+            window is THIS invocation's [lo, hi) — recursed levels
+            narrow it to the parent stride, exactly like the scalar's
+            start_index/end_index threading.  Returns (vecs, out,
+            returned, mapped)."""
+            curstep = rule.steps[stepno]
+            num_strides = curstep.arg1 if curstep.arg1 else rm
+            if num_strides <= 0 or total % num_strides != 0:
+                return vecs, out, returned, i32(0)  # malformed: skip
+            length = total // num_strides
+            if length <= 0:
+                return vecs, out, returned, i32(0)
+            level = stepno - seg_start_stepno
+            leaf_level = emit_stepno - seg_start_stepno - 1
+            is_leaf = curstep.arg2 == 0
+            mapped = i32(0)
+            undos: list = []
+            idxs = jnp.arange(rm, dtype=jnp.int32)
+            for sidx, s_lo in enumerate(range(lo, hi, length)):
+                s_hi = min(s_lo + length, hi)
+                filled = jnp.all(jnp.where(
+                    _msr_window(idxs, s_lo, s_hi),
+                    vecs[leaf_level] != undef, True))
+                en = enabled & ~filled
+
+                # collision loop: descend until a valid candidate
+                def coll_cond(st):
+                    lt, found, _c, _ci, _v = st
+                    return ~found & (lt < collision_tries)
+
+                def coll_body(st, _sidx=sidx, _s_lo=s_lo, _s_hi=s_hi,
+                              _vec=vecs[level], _bidx=bidx):
+                    lt, found, c, ci, v = st
+                    r = (((tryno * rm) + _sidx) << 16) + lt
+                    cand, cand_ci = _msr_descend_j(
+                        jm, rew, x, _bidx, curstep.arg2, r,
+                        i32(_sidx), jnp.bool_(True))
+                    ok = cand != none
+                    valid = ok & _msr_valid_j(
+                        _vec, lo, hi, _s_lo, _s_hi, cand)
+                    return (lt + 1, valid,
+                            jnp.where(valid, cand, c),
+                            jnp.where(valid, cand_ci, ci),
+                            valid)
+
+                _lt, found, cand, cand_ci, _v = lax.while_loop(
+                    coll_cond, coll_body,
+                    (i32(0), ~en, none, i32(-1), jnp.bool_(False)))
+                found = found & en
+
+                if is_leaf:
+                    # leaf: stride_length must be 1 and this must be
+                    # the last step (static malformed-rule guards)
+                    if length != 1 or stepno + 1 != emit_stepno:
+                        continue
+                    do = found & ~_is_out_j(jm, rew, cand, x)
+                    vec, pushed = _msr_push_j(
+                        vecs[level], s_lo, s_hi, cand, do)
+                    vecs = vecs[:level] + (vec,) + vecs[level + 1:]
+                    out, returned = emit(out, returned, cand, s_lo, do)
+                    mapped = mapped + do
+                else:
+                    if stepno + 1 >= emit_stepno:
+                        continue  # malformed
+                    en_child = found & (cand < 0)
+                    vecs, out, returned, child_mapped = choose(
+                        vecs, out, returned,
+                        jnp.clip(cand_ci, 0, jm.nb - 1), tryno,
+                        en_child, s_lo, s_hi, length, stepno + 1,
+                        seg_start_stepno, emit_stepno)
+                    vec, pushed = _msr_push_j(
+                        vecs[level], s_lo, s_hi, cand, en_child)
+                    vecs = vecs[:level] + (vec,) + vecs[level + 1:]
+                    # a pushed interior candidate whose subtree mapped
+                    # nothing is popped — but only AFTER every stride
+                    # at this level ran (the scalar's undo array): the
+                    # failed candidate must stay visible to later
+                    # strides' validity checks within this pass
+                    undos.append((s_lo, s_hi, cand,
+                                  pushed & (child_mapped == 0)))
+                    mapped = mapped + child_mapped
+            for s_lo, s_hi, cand, flag in undos:
+                vec = _msr_pop_j(vecs[level], s_lo, s_hi, cand, flag)
+                vecs = vecs[:level] + (vec,) + vecs[level + 1:]
+            return vecs, out, returned, mapped
+
+        stepno = start_stepno
+        start_index = 0
+        while stepno < len(rule.steps):
+            scan = _msr_scan_next(rule, rm, stepno)
+            if scan is None:
+                # invalid rule: "return whatever we have" (= none)
+                return jnp.full((rm + 1,), none, jnp.int32), i32(0)
+            total_children, emit_stepno = scan
+            take_step = rule.steps[stepno]
+            if take_step.arg1 >= 0:
+                if stepno + 1 != emit_stepno:
+                    return jnp.full((rm + 1,), none, jnp.int32), i32(0)
+                # NB: the scalar twin does NOT advance start_index
+                # after a raw-device take (mapper.py:639) — match it
+                out, returned = emit(
+                    out, returned, i32(take_step.arg1), start_index,
+                    jnp.bool_(True))
+            elif take_step.arg1 not in cc.idx_of:
+                pass  # unknown root: nothing placed for this segment
+            else:
+                root = i32(cc.idx_of[take_step.arg1])
+                seg_start = stepno + 1
+                n_steps = emit_stepno - seg_start
+                end_index = min(start_index + total_children, rm)
+                vecs0 = tuple(
+                    jnp.full((rm,), undef, jnp.int32)
+                    for _ in range(n_steps))
+                return_limit = returned + (end_index - start_index)
+
+                def desc_cond(st):
+                    tryno, _v, _o, ret = st
+                    return (tryno < descents) & (ret < return_limit)
+
+                def desc_body(st, _root=root, _seg=seg_start,
+                              _emit=emit_stepno, _lo=start_index,
+                              _hi=end_index, _tot=total_children):
+                    tryno, vecs, out, ret = st
+                    vecs, out, ret, _m = choose(
+                        vecs, out, ret, _root, tryno, jnp.bool_(True),
+                        _lo, _hi, _tot, _seg, _seg, _emit)
+                    return (tryno + 1, vecs, out, ret)
+
+                _t, _v, out, returned = lax.while_loop(
+                    desc_cond, desc_body, (i32(0), vecs0, out, returned))
+                start_index = end_index
+            stepno = emit_stepno + 1
+
+        if firstn:
+            return out[:rm], returned
+        return out[:rm], i32(rm)
+
     # -- trace-time interpreter (steps are static) --------------------
 
     def _lane(self, jm: _Jm, class_mask, x, rew):
         import jax.numpy as jnp
+
+        from ceph_tpu.crush.types import (
+            RULE_TYPE_MSR_FIRSTN,
+            RULE_TYPE_MSR_INDEP,
+        )
+
+        if self.rule.rule_type in (RULE_TYPE_MSR_FIRSTN,
+                                   RULE_TYPE_MSR_INDEP):
+            return self._msr_lane(jm, class_mask, x, rew)
 
         cc = self.cc
         rm = self.result_max

@@ -70,12 +70,55 @@ def _stable_mod_vec(x: np.ndarray, b: int, bmask: int) -> np.ndarray:
     return np.where((x & bmask) < b, x & bmask, x & (bmask >> 1))
 
 
+def _crush_fingerprint(crush, choose_args) -> int:
+    """Content hash over exactly the inputs compile_map consumes: maps
+    with identical CRUSH content (across epochs!) share one compiled
+    program.  Weights/upmap/pg_temp/osd-state changes are runtime
+    inputs, NOT part of the program — the common case (osd down, osd
+    out, reweight, upmap) therefore reuses the XLA executable and only
+    pool/rule/bucket topology changes recompile."""
+    parts = [repr(crush.tunables), repr(crush.max_devices)]
+    for bid in sorted(crush.buckets):
+        b = crush.buckets[bid]
+        parts.append(repr((
+            bid, int(b.alg), b.hash, b.type, tuple(b.items),
+            tuple(b.item_weights),
+        )))
+    for rid in sorted(crush.rules):
+        r = crush.rules[rid]
+        parts.append(repr((
+            rid, r.rule_type, r.device_class,
+            tuple((s.op, s.arg1, s.arg2) for s in r.steps),
+        )))
+    parts.append(repr(sorted(crush.device_classes.items())))
+    if choose_args:
+        parts.append(repr(sorted(
+            (k, tuple(tuple(p) for p in (a.weight_set or ())),
+             tuple(a.ids or ()))
+            for k, a in choose_args.items()
+        )))
+    return hash("\n".join(parts))
+
+
+# fingerprint -> (CompiledCrush | None, shared mapper dict); one slot —
+# the control plane holds one live topology at a time
+_PROGRAM_CACHE: dict[int, tuple] = {}
+
+
 class BatchedClusterMapper:
-    """Caches compiled per-pool rule programs for one OSDMap epoch —
-    the OSDMapMapping analogue."""
+    """Caches compiled per-pool rule programs — the OSDMapMapping
+    analogue.  Compiled XLA programs persist across OSDMap epochs via
+    a CRUSH-content fingerprint (see _crush_fingerprint)."""
 
     def __init__(self, osdmap: OSDMap):
         self.osdmap = osdmap
+        try:
+            fp = _crush_fingerprint(osdmap.crush, osdmap.choose_args)
+        except Exception:
+            fp = None
+        if fp is not None and fp in _PROGRAM_CACHE:
+            self.cc, self._mappers = _PROGRAM_CACHE[fp]
+            return
         try:
             self.cc = compile_map(
                 osdmap.crush, choose_args=osdmap.choose_args
@@ -83,6 +126,9 @@ class BatchedClusterMapper:
         except UnsupportedMap:
             self.cc = None
         self._mappers: dict[tuple[int, int], BatchedRuleMapper] = {}
+        if fp is not None:
+            _PROGRAM_CACHE.clear()  # one live topology; drop the old
+            _PROGRAM_CACHE[fp] = (self.cc, self._mappers)
 
     def _rule_mapper(self, ruleno: int, size: int) -> BatchedRuleMapper | None:
         if self.cc is None:

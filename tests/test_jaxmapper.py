@@ -96,21 +96,31 @@ class TestBatchedRules:
         )
         assert_rule_matches(m, 13, 8, XS)
 
-    def test_msr_rule_scalar_fallback(self, deep_map):
-        """MSR rules are served by the scalar pipeline: the batched
-        compiler refuses them (UnsupportedMap) and the cluster remap
-        engine falls back transparently (osd/remap.py)."""
-        import pytest as _pytest
-
-        from ceph_tpu.crush import jaxmapper as J
-
+    def test_msr_indep_rule(self, deep_map):
+        """MSR rules batch through the dedicated lane (_msr_lane),
+        bit-identical to the scalar crush_msr_do_rule twin (itself
+        golden-pinned vs the reference's C in test_crush_golden)."""
         m, root = deep_map
         B.add_osd_multi_per_domain_rule(
             m, root.id, 3, num_per_domain=2, num_domains=4, rule_id=21
         )
-        cc = J.compile_map(m)
-        with _pytest.raises(J.UnsupportedMap):
-            J.BatchedRuleMapper(cc, 21, 8)
+        assert_rule_matches(m, 21, 8, XS[:60])
+        assert_rule_matches(m, 21, 6, XS[:60])  # truncated result_max
+
+    def test_msr_firstn_rule_with_reweights(self, deep_map, rng):
+        from ceph_tpu.crush.types import RULE_TYPE_MSR_FIRSTN
+
+        m, root = deep_map
+        B.add_osd_multi_per_domain_rule(
+            m, root.id, 3, num_per_domain=3, num_domains=3, rule_id=22,
+            rule_type=RULE_TYPE_MSR_FIRSTN,
+        )
+        w = np.full(m.max_devices, 0x10000, np.int64)
+        w[rng.integers(0, m.max_devices, 10)] = 0
+        w[rng.integers(0, m.max_devices, 10)] = rng.integers(1, 0x10000, 10)
+        # zero/partial reweights force is_out rejections and
+        # whole-descent retries, the paths that distinguish MSR
+        assert_rule_matches(m, 22, 9, XS[:60], weights=[int(v) for v in w])
 
     def test_choose_firstn_osd_direct(self, deep_map):
         m, root = deep_map
@@ -184,6 +194,8 @@ class TestBatchedRemap:
         root = B.build_hierarchy(m, osds_per_host=4, n_hosts=8)
         r_rep = B.add_simple_rule(m, root.id, 1, mode="firstn")
         r_ec = B.add_simple_rule(m, root.id, 1, mode="indep", rule_type=3)
+        r_msr = B.add_osd_multi_per_domain_rule(
+            m, root.id, 1, num_per_domain=2, num_domains=3)
         om = OSDMap(crush=m)
         for o in range(32):
             om.new_osd(o)
@@ -200,6 +212,10 @@ class TestBatchedRemap:
         om.pools[2] = PgPool(
             id=2, type=PoolType.ERASURE, size=6, min_size=5,
             crush_rule=r_ec, pg_num=32, pgp_num=32,
+        )
+        om.pools[3] = PgPool(
+            id=3, type=PoolType.ERASURE, size=6, min_size=5,
+            crush_rule=r_msr, pg_num=16, pgp_num=16,
         )
         om.pg_upmap[pg_t(1, 3)] = [0, 4, 8]
         om.pg_upmap_items[pg_t(1, 7)] = [(1, 2)]
