@@ -333,6 +333,19 @@ class RadosClient:
             pool = om.get_pg_pool(pool_id)
             if pool is None:
                 raise RadosError(errno.ENOENT, f"pool {pool_id} vanished")
+            # cache-tier overlay redirect (Objecter::_calc_target,
+            # src/osdc/Objecter.cc:2783 read_tier/write_tier): ops on
+            # an overlaid base pool target the cache pool instead
+            tier = pool.extra.get(
+                "write_tier" if op.is_write() else "read_tier")
+            if tier is not None:
+                tpool = om.get_pg_pool(int(tier))
+                if tpool is not None:
+                    pool = tpool
+            # unconditional: a retry after an overlay CHANGE must
+            # re-home to wherever this map says, not keep a stale
+            # redirect from the previous attempt
+            op.pool = pool.id
             pg = object_to_pg(pool, op.oid)
             _, _, _, primary = om.pg_to_up_acting_osds(pg)
             if primary < 0:
@@ -427,6 +440,26 @@ class ObjectOperation:
 
     def omap_clear(self):
         self.ops.append(OSDOp(OP_OMAP_CLEAR))
+        return self
+
+    def copy_from(self, src_pool: int, src_oid: str):
+        """CEPH_OSD_OP_COPY_FROM: fill the target from another object
+        (the tiering promote/flush primitive, PrimaryLogPG copy-from)."""
+        from ceph_tpu.msg.messages import OP_COPY_FROM
+
+        self.ops.append(OSDOp(OP_COPY_FROM, name=f"{src_pool}:{src_oid}"))
+        return self
+
+    def cache_flush(self):
+        from ceph_tpu.msg.messages import OP_CACHE_FLUSH
+
+        self.ops.append(OSDOp(OP_CACHE_FLUSH))
+        return self
+
+    def cache_evict(self):
+        from ceph_tpu.msg.messages import OP_CACHE_EVICT
+
+        self.ops.append(OSDOp(OP_CACHE_EVICT))
         return self
 
     # read class

@@ -103,6 +103,10 @@ declare(
     Option("mon_target_pg_per_osd", int, 100, LEVEL_ADVANCED,
            "target PG replicas per OSD driving pg_autoscaler "
            "recommendations (reference mon_target_pg_per_osd)", min=1),
+    Option("osd_tier_agent_interval", float, 1.0, LEVEL_ADVANCED,
+           "seconds between cache-tier agent passes (flush dirty /"
+           " evict cold under target_max_bytes pressure, the reference"
+           " TierAgent cadence); 0 disables", min=0.0),
     Option("mon_pg_autoscale_interval", float, 0.0, LEVEL_ADVANCED,
            "seconds between pg_autoscaler acting passes on pools with "
            "pg_autoscale_mode=on (reference pg_autoscaler sleep "

@@ -664,6 +664,33 @@ class Monitor:
             if not om.exists(osd) or not om.is_out(osd):
                 return
             om.osd_weight[osd] = 0x10000
+        elif kind == "tier_add":
+            tier = om.pools.get(op["tier"])
+            if tier is None or op["base"] not in om.pools:
+                return
+            tier.extra["tier_of"] = str(op["base"])
+            tier.extra.setdefault("cache_mode", "none")
+        elif kind == "tier_rm":
+            tier = om.pools.get(op["tier"])
+            if tier is None:
+                return
+            tier.extra.pop("tier_of", None)
+            tier.extra.pop("cache_mode", None)
+        elif kind == "tier_mode":
+            tier = om.pools.get(op["tier"])
+            if tier is None:
+                return
+            tier.extra["cache_mode"] = op["mode"]
+        elif kind == "tier_overlay":
+            base = om.pools.get(op["base"])
+            if base is None:
+                return
+            if op["tier"] < 0:
+                base.extra.pop("read_tier", None)
+                base.extra.pop("write_tier", None)
+            else:
+                base.extra["read_tier"] = str(op["tier"])
+                base.extra["write_tier"] = str(op["tier"])
         elif kind == "auth_upsert":
             self._auth_db[op["entity"]] = {
                 "key": op["key"], "caps": dict(op["caps"]),
@@ -973,6 +1000,9 @@ class Monitor:
         elif var == "pg_autoscale_mode":
             if val not in ("on", "off"):
                 return -errno.EINVAL, "pg_autoscale_mode: on|off", b""
+        elif var == "target_max_bytes":
+            if int(val) < 0:
+                return -errno.EINVAL, "target_max_bytes >= 0", b""
         elif var == "fast_read":
             if val not in ("0", "1"):
                 return -errno.EINVAL, "fast_read: 0|1", b""
@@ -996,6 +1026,71 @@ class Monitor:
                     "--yes-i-really-really-mean-it", b"")
         await self._propose({"op": "pool_rm", "pool": pid})
         return 0, f"pool {cmd['pool']} removed", b""
+
+    async def _tier_command(
+        self, prefix: str, cmd: dict[str, str],
+    ) -> tuple[int, str, bytes]:
+        """Cache-tier admin (OSDMonitor::prepare_command tier verbs,
+        src/mon/OSDMonitor.cc 'osd tier add/remove/cache-mode/
+        set-overlay/remove-overlay')."""
+        import errno
+
+        _bpid, base = self._pool_by_name(cmd["pool"])
+        if prefix in ("osd tier add", "osd tier remove",
+                      "osd tier cache-mode", "osd tier set-overlay"):
+            tier_name = cmd.get("tierpool") or cmd.get("pool2", "")
+            if prefix == "osd tier cache-mode":
+                tier_name = cmd["pool"]
+        if prefix == "osd tier add":
+            tpid, tier = self._pool_by_name(tier_name)
+            if tpid == _bpid:
+                return -errno.EINVAL, "a pool cannot tier itself", b""
+            if tier.extra.get("tier_of"):
+                return -errno.EINVAL, "already a tier", b""
+            if base.extra.get("tier_of"):
+                return (-errno.EINVAL,
+                        "base is itself a tier (no tier chains)", b"")
+            if tier.type != 1:
+                return (-errno.EINVAL,
+                        "cache tier must be replicated (omap)", b"")
+            await self._propose({
+                "op": "tier_add", "base": _bpid, "tier": tpid,
+            })
+            return 0, f"{tier_name} is now a tier of {cmd['pool']}", b""
+        if prefix == "osd tier remove":
+            tpid, tier = self._pool_by_name(tier_name)
+            if tier.extra.get("tier_of") != str(_bpid):
+                return (-errno.ENOENT,
+                        f"{tier_name} is not a tier of {cmd['pool']}", b"")
+            if base.extra.get("read_tier") == str(tpid):
+                return -errno.EBUSY, "remove the overlay first", b""
+            await self._propose({
+                "op": "tier_rm", "base": _bpid, "tier": tpid,
+            })
+            return 0, "tier removed", b""
+        if prefix == "osd tier cache-mode":
+            mode = cmd["mode"]
+            if mode not in ("writeback", "none"):
+                return -errno.EINVAL, "mode: writeback|none", b""
+            if not base.extra.get("tier_of"):
+                return -errno.EINVAL, f"{cmd['pool']} is not a tier", b""
+            await self._propose({
+                "op": "tier_mode", "tier": _bpid, "mode": mode,
+            })
+            return 0, f"cache-mode {mode}", b""
+        if prefix == "osd tier set-overlay":
+            tpid, tier = self._pool_by_name(tier_name)
+            if tier.extra.get("tier_of") != str(_bpid):
+                return -errno.EINVAL, "not a tier of that pool", b""
+            await self._propose({
+                "op": "tier_overlay", "base": _bpid, "tier": tpid,
+            })
+            return 0, "overlay set", b""
+        if prefix == "osd tier remove-overlay":
+            await self._propose({"op": "tier_overlay", "base": _bpid,
+                                 "tier": -1})
+            return 0, "overlay removed", b""
+        return -errno.EOPNOTSUPP, prefix, b""
 
     async def _auth_command(
         self, prefix: str, cmd: dict[str, str],
@@ -1159,6 +1254,8 @@ class Monitor:
         "osd pg-upmap-items",
         "auth add", "auth get-or-create", "auth del", "auth caps",
         "osd pool set", "osd pool rm", "osd in",
+        "osd tier add", "osd tier remove", "osd tier cache-mode",
+        "osd tier set-overlay", "osd tier remove-overlay",
     })
 
     async def _command(
@@ -1213,6 +1310,8 @@ class Monitor:
                 return await self._pool_set(cmd)
             if prefix == "osd pool rm":
                 return await self._pool_rm(cmd)
+            if prefix.startswith("osd tier "):
+                return await self._tier_command(prefix, cmd)
             if prefix == "osd in":
                 osd = int(cmd["id"])
                 om = self.osdmap

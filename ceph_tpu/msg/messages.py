@@ -267,10 +267,19 @@ OP_LIST_SNAPS = 25   # CEPH_OSD_OP_LIST_SNAPS: dump the object's SnapSet
 # off = clone id, data = json list of covered snaps
 OP_SNAP_CLONE = 26
 
+# cache tiering (CEPH_OSD_OP_CACHE_FLUSH/CACHE_EVICT/COPY_FROM,
+# src/osd/PrimaryLogPG.cc cache ops): flush writes a dirty cache
+# object back to the base pool; evict drops a clean one; copy-from
+# copies "srcpool:srcoid" (OSDOp.name) into the target object
+OP_CACHE_FLUSH = 27
+OP_CACHE_EVICT = 28
+OP_COPY_FROM = 29
+
 WRITE_OPS = frozenset({
     OP_WRITE_FULL, OP_DELETE, OP_WRITE, OP_APPEND, OP_ZERO, OP_TRUNCATE,
     OP_CREATE, OP_SETXATTR, OP_RMXATTR, OP_OMAP_SETKEYS, OP_OMAP_RMKEYS,
     OP_OMAP_CLEAR, OP_ROLLBACK, OP_SNAP_CLONE,
+    OP_CACHE_FLUSH, OP_CACHE_EVICT, OP_COPY_FROM,
 })
 
 

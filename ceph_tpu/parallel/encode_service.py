@@ -43,9 +43,17 @@ class EncodeService:
     path.
     """
 
-    def __init__(self, mesh=None, *, min_bytes: int = DEFAULT_MIN_BYTES,
+    def __init__(self, mesh=None, *, device=None,
+                 min_bytes: int = DEFAULT_MIN_BYTES,
                  window_s: float = 0.001):
         self.mesh = mesh
+        # single-device mode (round-3 weak #8 closed): with one
+        # accelerator and no mesh, the microbatching window still
+        # coalesces concurrent per-PG ops into ONE dispatch — the
+        # relay-amortization insight from PERF_LAB applied to the
+        # production I/O path.  Requests concatenate along S (GF
+        # matmul is column-independent), so no batch padding at all.
+        self.device = device
         self.min_bytes = min_bytes
         self.window_s = window_s
         self._pending: dict[bytes, list[tuple]] = {}
@@ -56,7 +64,7 @@ class EncodeService:
     # -- gating --------------------------------------------------------
 
     def active(self) -> bool:
-        return self.mesh is not None
+        return self.mesh is not None or self.device is not None
 
     def usable(self, rows: np.ndarray) -> bool:
         return self.active() and rows.size >= self.min_bytes
@@ -136,6 +144,9 @@ class EncodeService:
         bits = self._bits(M)
         k = M.shape[1]
 
+        if self.mesh is None:
+            return self._run_group_single(group, bits, k)
+
         if len(group) == 1 and "shard" in self.mesh.shape:
             _, rows, _fut = group[0]
             nsh = self.mesh.shape["shard"]
@@ -167,15 +178,47 @@ class EncodeService:
         ]
 
 
+    def _run_group_single(self, group: list[tuple], bits, k) -> list[np.ndarray]:
+        """Single-device dispatch: concatenate every request's rows
+        along S (column-independent GF matmul), pad to a power-of-two
+        width so jit shapes stay bounded, ONE kernel launch for the
+        whole window."""
+        import jax.numpy as jnp
+
+        from ceph_tpu.ops.rs_kernels import BitmatrixCodec
+
+        widths = [rows.shape[1] for _, rows, _ in group]
+        total = sum(widths)
+        S = 1 << max(total - 1, 1).bit_length()  # pow2 bucket
+        big = np.zeros((k, S), np.uint8)
+        off = 0
+        for (_, rows, _), w in zip(group, widths):
+            big[:, off:off + w] = rows
+            off += w
+        out = np.asarray(BitmatrixCodec._apply(
+            bits, jnp.asarray(big), None))
+        self.stats["single_dispatches"] += 1
+        self.stats["coalesced"] += len(group)
+        outs = []
+        off = 0
+        for w in widths:
+            outs.append(np.ascontiguousarray(out[:, off:off + w]))
+            off += w
+        return outs
+
+
 _shared: EncodeService | None = None
 
 
 def shared() -> EncodeService:
     """Process-wide service; builds a mesh over all local devices on
-    first use (inactive when the process sees a single device)."""
+    first use.  A single ACCELERATOR device gets single-device
+    coalescing mode (cpu-only processes stay inactive so host paths
+    keep their exact semantics/costs)."""
     global _shared
     if _shared is None:
         mesh = None
+        device = None
         try:
             import jax
             from jax.sharding import Mesh
@@ -185,9 +228,11 @@ def shared() -> EncodeService:
                 nsh = 2 if len(devs) % 2 == 0 else 1
                 devgrid = np.asarray(devs).reshape(len(devs) // nsh, nsh)
                 mesh = Mesh(devgrid, ("pg", "shard"))
+            elif devs and jax.default_backend() not in ("cpu",):
+                device = devs[0]
         except Exception:
             mesh = None
-        _shared = EncodeService(mesh)
+        _shared = EncodeService(mesh, device=device)
     return _shared
 
 

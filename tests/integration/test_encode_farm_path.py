@@ -120,3 +120,69 @@ class TestServiceUnit:
             assert svc.stats["tp_dispatches"] == 1
 
         asyncio.run(go())
+
+
+class TestSingleDeviceCoalescing:
+    """Single-chip microbatching (round-3 VERDICT item 6): with ONE
+    device and no mesh, the service still coalesces concurrent per-PG
+    encodes into one dispatch per window — requests concatenate along
+    S, so the PERF_LAB relay-amortization carries into production I/O.
+    The mode is device-agnostic; CI drives it with a CPU device."""
+
+    def test_unit_coalesce_one_dispatch(self):
+        async def go():
+            import jax
+
+            from ceph_tpu.ops.gf256 import gf_matmul
+
+            svc = es.EncodeService(
+                device=jax.devices()[0], min_bytes=1, window_s=0.01)
+            assert svc.active()
+            rng = np.random.default_rng(3)
+            M = rng.integers(0, 256, (3, 4), dtype=np.uint8)
+            reqs = [
+                rng.integers(0, 256, (4, 4096 + 512 * i), dtype=np.uint8)
+                for i in range(8)
+            ]
+            outs = await asyncio.gather(*(
+                svc.apply(M, r) for r in reqs))
+            for r, out in zip(reqs, outs):
+                assert np.array_equal(out, gf_matmul(M, r))
+            # all 8 landed in the window -> ONE launch
+            assert svc.stats["single_dispatches"] == 1, dict(svc.stats)
+            assert svc.stats["coalesced"] == 8
+
+        run(go())
+
+    def test_daemon_path_single_device(self):
+        async def go():
+            import jax
+
+            svc = es.EncodeService(
+                device=jax.devices()[0], min_bytes=4096, window_s=0.005)
+
+            async with Cluster(
+                n_osds=6,
+                osd_conf={"osd_ec_encode_farm": "on"},
+            ) as c:
+                for o in c.osds:
+                    o._encode_service = svc
+                    o._encode_service_resolved = True
+                await c.client.ec_profile_set("p", {
+                    "plugin": "jax", "k": "4", "m": "2",
+                    "crush-failure-domain": "host"})
+                await c.client.pool_create(
+                    "sdp", pg_num=8, pool_type="erasure",
+                    erasure_code_profile="p")
+                io = c.client.ioctx("sdp")
+                await asyncio.gather(*(
+                    io.write_full(f"o{i}", _payload(i)) for i in range(10)
+                ))
+                stats = dict(svc.stats)
+                assert stats.get("single_dispatches", 0) > 0, stats
+                # ≪N dispatches for N concurrent encodes
+                assert stats["coalesced"] > stats["single_dispatches"], stats
+                for i in range(10):
+                    assert await io.read(f"o{i}") == _payload(i)
+
+        run(go())
