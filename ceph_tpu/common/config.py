@@ -100,6 +100,38 @@ declare(
            see_also=("osd_max_pg_log_entries",)),
     Option("osd_recovery_max_active", int, 4, LEVEL_ADVANCED,
            "concurrent recovery reconciliations per osd", min=1),
+    Option("osd_max_backfills", int, 1, LEVEL_ADVANCED,
+           "concurrent PG backfills this osd will participate in, as "
+           "primary (local reservation) or replica (remote "
+           "reservation) — the reference's osd_max_backfills gating "
+           "AsyncReserver slots", min=1),
+    Option("osd_recovery_sleep", float, 0.0, LEVEL_ADVANCED,
+           "pause injected between recovery object reconciliations so "
+           "client I/O breathes (reference osd_recovery_sleep)",
+           min=0.0),
+    Option("osd_backfill_retry_interval", float, 1.0, LEVEL_ADVANCED,
+           "seconds before retrying a PG whose remote backfill "
+           "reservation was rejected (reference "
+           "osd_backfill_retry_interval, default 30s there — shorter "
+           "here to match mini-cluster timescales)", min=0.0),
+    Option("osd_op_queue_max_inflight", int, 128, LEVEL_ADVANCED,
+           "top-level ops admitted concurrently through the mClock "
+           "gate; 0 disables admission control (every op runs "
+           "immediately).  The osd_op_num_shards*threads capacity "
+           "role — under saturation dequeue order follows dmclock "
+           "tags so client ops outrank recovery", min=0),
+    Option("osd_mclock_scheduler_client_wgt", float, 10.0, LEVEL_ADVANCED,
+           "dmclock weight of the client op class (reference "
+           "osd_mclock_scheduler_client_wgt)", min=0.001),
+    Option("osd_mclock_scheduler_background_recovery_wgt", float, 1.0,
+           LEVEL_ADVANCED,
+           "dmclock weight of recovery/backfill work (reference "
+           "osd_mclock_scheduler_background_recovery_wgt)", min=0.001),
+    Option("osd_mclock_scheduler_background_best_effort_wgt", float, 1.0,
+           LEVEL_ADVANCED,
+           "dmclock weight of scrub/trim background work (reference "
+           "osd_mclock_scheduler_background_best_effort_wgt)",
+           min=0.001),
     Option("mon_target_pg_per_osd", int, 100, LEVEL_ADVANCED,
            "target PG replicas per OSD driving pg_autoscaler "
            "recommendations (reference mon_target_pg_per_osd)", min=1),

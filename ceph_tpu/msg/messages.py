@@ -737,7 +737,7 @@ class MOSDPGPush(Message):
     def __init__(
         self, pg: pg_t = pg_t(0, 0), shard: int = -1, from_osd: int = 0,
         pushes: list[tuple[str, bytes, dict[str, bytes]]] | None = None,
-        epoch: int = 0, force: bool = False,
+        epoch: int = 0, force: bool = False, tid: int = 0,
     ):
         self.pg, self.shard, self.from_osd = pg, shard, from_osd
         self.pushes = pushes or []
@@ -745,6 +745,10 @@ class MOSDPGPush(Message):
         # divergent rollback: overwrite even a newer local version (the
         # newer write is being rolled back; its log entry is stripped)
         self.force = force
+        # correlates the reply: concurrent pushes of different objects
+        # to the same (pg, shard, osd) are in flight at once under
+        # osd_recovery_max_active
+        self.tid = tid
 
     def encode_payload(self, enc):
         _enc_pg(enc, self.pg, self.shard)
@@ -756,6 +760,7 @@ class MOSDPGPush(Message):
             enc.bytes_(data)
             _enc_map_str_bytes(enc, attrs)
         enc.bool_(self.force)
+        enc.u64(self.tid)
 
     @classmethod
     def decode_payload(cls, dec):
@@ -768,24 +773,28 @@ class MOSDPGPush(Message):
         ]
         msg = cls(pg, shard, from_osd, pushes, epoch)
         msg.force = dec.bool_()
+        msg.tid = dec.u64()
         return msg
 
 
 class MOSDPGPushReply(Message):
     TYPE = 106
 
-    def __init__(self, pg: pg_t = pg_t(0, 0), shard: int = -1, from_osd: int = 0, epoch: int = 0):
+    def __init__(self, pg: pg_t = pg_t(0, 0), shard: int = -1,
+                 from_osd: int = 0, epoch: int = 0, tid: int = 0):
         self.pg, self.shard, self.from_osd, self.epoch = pg, shard, from_osd, epoch
+        self.tid = tid
 
     def encode_payload(self, enc):
         _enc_pg(enc, self.pg, self.shard)
         enc.i32(self.from_osd)
         enc.u32(self.epoch)
+        enc.u64(self.tid)
 
     @classmethod
     def decode_payload(cls, dec):
         pg, shard = _dec_pg(dec)
-        return cls(pg, shard, dec.i32(), dec.u32())
+        return cls(pg, shard, dec.i32(), dec.u32(), dec.u64())
 
 
 # -- peering / log exchange (src/messages/MOSDPGQuery.h, MOSDPGInfo.h,
@@ -1069,6 +1078,41 @@ class MOSDScrubReply(Message):
     @classmethod
     def decode_payload(cls, dec):
         return cls(dec.u64(), dec.i32(), dec.bytes_())
+
+
+class MBackfillReserve(Message):
+    """Backfill-reservation handshake between a recovering primary and
+    its acting-set replicas (src/messages/MBackfillReserve.h): REQUEST
+    asks the replica for one of its osd_max_backfills remote slots;
+    the replica answers GRANT or REJECT_TOOFULL (non-blocking — the
+    primary retries after osd_backfill_retry_interval); RELEASE frees
+    the slot when the PG goes clean."""
+
+    TYPE = 99  # MSG_OSD_BACKFILL_RESERVE (src/include/msgr.h)
+
+    REQUEST = 0
+    GRANT = 1
+    REJECT_TOOFULL = 2
+    RELEASE = 3
+
+    def __init__(self, tid: int = 0, op: int = 0, pool: int = 0,
+                 ps: int = 0, from_osd: int = 0, priority: int = 0):
+        self.tid, self.op = tid, op
+        self.pool, self.ps = pool, ps
+        self.from_osd, self.priority = from_osd, priority
+
+    def encode_payload(self, enc):
+        enc.u64(self.tid)
+        enc.u8(self.op)
+        enc.i64(self.pool)
+        enc.u32(self.ps)
+        enc.i32(self.from_osd)
+        enc.i32(self.priority)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        return cls(dec.u64(), dec.u8(), dec.i64(), dec.u32(), dec.i32(),
+                   dec.i32())
 
 
 # -- cephfs client <-> mds (src/messages/MClientRequest.h) ------------------

@@ -69,6 +69,7 @@ from ceph_tpu.msg.messages import (
     MOSDPGLog,
     MOSDPGLogAck,
     MOSDPGQuery,
+    MBackfillReserve,
     MOSDScrub,
     MOSDScrubReply,
     OP_APPEND,
@@ -236,7 +237,7 @@ class OSDDaemon:
         self._mon_conn: Connection | None = None
         self._tids = itertools.count(1)
         self._waiters: dict[int, asyncio.Future] = {}
-        self._push_waiters: dict[tuple, asyncio.Future] = {}
+        self._push_waiters: dict[int, asyncio.Future] = {}  # by push tid
         # per-object write serialization (the ObjectContext rw-lock
         # analogue): RMW read/encode/fan-out must not interleave with
         # another write to the same object
@@ -289,6 +290,55 @@ class OSDDaemon:
         self._hb_reported: dict[int, float] = {}
         self.drop_pings = False  # test hook: simulate a silent partition
         self._recovery_task: asyncio.Task | None = None
+        # backfill admission control (AsyncReserver twin, reference
+        # src/common/AsyncReserver.h + MBackfillReserve handshake):
+        # local slots gate PGs WE lead into recovery; remote slots gate
+        # how many foreign primaries may backfill onto us at once
+        from ceph_tpu.common.reserver import AsyncReserver
+
+        _mb = self.conf["osd_max_backfills"]
+        self.local_reserver = AsyncReserver(max_allowed=_mb)
+        self.remote_reserver = AsyncReserver(max_allowed=_mb)
+        self._remote_grants: dict[tuple[int, int, int], object] = {}
+        # in-flight object-reconciliation budget within granted PGs
+        # (osd_recovery_max_active role)
+        self._recovery_budget = asyncio.Semaphore(
+            self.conf["osd_recovery_max_active"])
+        self.recovery_stats = {
+            "reservation_rejects": 0, "pgs_recovered": 0,
+            "peak_local": 0, "peak_remote": 0,
+        }
+        self.conf.add_observer(
+            ("osd_max_backfills",),
+            lambda ch: (
+                self.local_reserver.set_max(ch["osd_max_backfills"]),
+                self.remote_reserver.set_max(ch["osd_max_backfills"]),
+            ),
+        )
+        # mClock admission gate (OpScheduler seam): top-level work —
+        # client ops, recovery reconciliations, scrub chunks — admits
+        # here; under saturation dequeue order follows dmclock tags so
+        # clients outrank background work.  Sub-op service never
+        # admits (see opqueue.py deadlock rule).
+        from ceph_tpu.osd.opqueue import MClockGate
+        from ceph_tpu.osd.scheduler import ClientProfile
+
+        self.op_gate = MClockGate(
+            max_inflight=self.conf["osd_op_queue_max_inflight"],
+            profiles={
+                "client": ClientProfile(
+                    weight=self.conf["osd_mclock_scheduler_client_wgt"]),
+                "recovery": ClientProfile(weight=self.conf[
+                    "osd_mclock_scheduler_background_recovery_wgt"]),
+                "best_effort": ClientProfile(weight=self.conf[
+                    "osd_mclock_scheduler_background_best_effort_wgt"]),
+            },
+        )
+        self.conf.add_observer(
+            ("osd_op_queue_max_inflight",),
+            lambda ch: self.op_gate.set_max_inflight(
+                ch["osd_op_queue_max_inflight"]),
+        )
         self._map_event = asyncio.Event()
         self.stopping = False
         # fresh per daemon start: lets the mon distinguish a fast
@@ -736,6 +786,8 @@ class OSDDaemon:
                 self._spawn_peering(self._handle_pg_log(msg))
             elif isinstance(msg, MOSDScrub):
                 asyncio.ensure_future(self._handle_scrub(msg))
+            elif isinstance(msg, MBackfillReserve):
+                await self._handle_backfill_reserve(msg)
             elif isinstance(
                 msg,
                 (
@@ -748,7 +800,7 @@ class OSDDaemon:
                 if fut and not fut.done():
                     fut.set_result(msg)
             elif isinstance(msg, MOSDPGPushReply):
-                fut = self._push_waiters.get((msg.pg, msg.shard, msg.from_osd))
+                fut = self._push_waiters.get(msg.tid)
                 if fut and not fut.done():
                     fut.set_result(msg)
         except Exception:
@@ -802,7 +854,9 @@ class OSDDaemon:
         for sec in ("global", "osd", f"osd.{self.id}"):
             for name, value in msg.sections.get(sec, {}).items():
                 try:
-                    self.conf.set(name, value, source="mon")
+                    # apply_changes (not bare set) so live observers —
+                    # backfill reserver caps, mClock knobs — re-read
+                    self.conf.apply_changes({name: value}, source="mon")
                 except (KeyError, ValueError):
                     log.warning(
                         "osd.%d: ignoring mon config %s=%r", self.id,
@@ -1164,23 +1218,25 @@ class OSDDaemon:
             else:
                 self.perf.inc("op_r")
             self.dlog.dout(4, "osd.%d: op %s", self.id, tracked.description)
-            tracked.mark_event("executing")
-            with self.tracer.span(
-                "do_op", reqid=msg.reqid, oid=msg.oid, pool=msg.pool,
-                ops=len(msg.ops),
-            ) as _sp:
-                token = self._op_span.set(_sp)
-                try:
-                    reply = await self._execute_op(msg)
-                finally:
+            tracked.mark_event("queued")
+            async with self.op_gate.admit("client"):
+                tracked.mark_event("executing")
+                with self.tracer.span(
+                    "do_op", reqid=msg.reqid, oid=msg.oid, pool=msg.pool,
+                    ops=len(msg.ops),
+                ) as _sp:
+                    token = self._op_span.set(_sp)
                     try:
-                        self._op_span.reset(token)
-                    except ValueError:
-                        # a task garbage-collected at loop teardown
-                        # runs this finally in a foreign Context; the
-                        # var dies with the task either way
-                        pass
-                _sp.tag(result=reply.result)
+                        reply = await self._execute_op(msg)
+                    finally:
+                        try:
+                            self._op_span.reset(token)
+                        except ValueError:
+                            # a task garbage-collected at loop teardown
+                            # runs this finally in a foreign Context;
+                            # the var dies with the task either way
+                            pass
+                    _sp.tag(result=reply.result)
             tracked.mark_event("replying")
             if reply.result == 0 and reply.data:
                 self.perf.inc("op_out_bytes", len(reply.data))
@@ -3071,12 +3127,33 @@ class OSDDaemon:
         """After a map change: for every PG this OSD leads, reconstruct
         missing shards/objects on the current acting set (the
         do_recovery -> recover_object path, §3.3).  Re-runs until a
-        full pass has seen the newest map (epochs can land mid-pass)."""
-        done_epoch = -1
-        while done_epoch != self.epoch and not self.stopping:
+        full pass has seen the newest map (epochs can land mid-pass).
+
+        PGs run concurrently, but admission is reservation-gated
+        (backfill_reservation.rst): each PG takes one of OUR
+        osd_max_backfills local slots, then one remote slot on every
+        acting peer (MBackfillReserve REQUEST/GRANT); a REJECT_TOOFULL
+        releases everything and retries after
+        osd_backfill_retry_interval, so cluster-wide concurrent
+        backfill load per OSD stays bounded.
+
+        A pass that leaves PGs unclean (a peer mid-restart, a dropped
+        connection) re-runs after osd_backfill_retry_interval even if
+        no new map arrives — the reference's recovery_request_timer
+        retry role.  Without it a transient error at the wrong moment
+        parks the PG in peering forever (found by the interleaving
+        fuzzer, tests/test_interleave_fuzz.py)."""
+        while not self.stopping:
             done_epoch = self.epoch
+            # GC remote grants whose requesting primary is gone — a
+            # primary that died after GRANT can never send RELEASE
+            for key in list(self._remote_grants):
+                if not self.osdmap.is_up(key[2]):
+                    res = self._remote_grants.pop(key)
+                    res.release()
             try:
                 om = self.osdmap
+                work: list[tuple[PgPool, pg_t, list[int]]] = []
                 for pid, pool in list(om.pools.items()):
                     for ps in range(pool.pg_num):
                         pg = pg_t(pid, ps)
@@ -3085,18 +3162,151 @@ class OSDDaemon:
                         )
                         if primary != self.id:
                             continue
-                        self._recovering_pgs.add((pid, ps))
-                        try:
-                            ok = await self._recover_pg(pool, pg, acting)
-                            if ok:
-                                self._clean_epoch[(pid, ps)] = done_epoch
-                        finally:
-                            self._recovering_pgs.discard((pid, ps))
+                        work.append((pool, pg, acting))
+                if work:
+                    # return_exceptions: one PG's crash must neither
+                    # abort the pass (siblings would keep running
+                    # DETACHED with reservations held) nor mask the
+                    # others' completion
+                    results = await asyncio.gather(*[
+                        self._recover_pg_reserved(pool, pg, acting,
+                                                  done_epoch)
+                        for pool, pg, acting in work
+                    ], return_exceptions=True)
+                    for (_p, pg, _a), r in zip(work, results):
+                        if isinstance(r, asyncio.CancelledError):
+                            raise r
+                        if isinstance(r, BaseException):
+                            log.exception(
+                                "osd.%d: recovery of %s crashed",
+                                self.id, pg, exc_info=r)
+                if self.epoch != done_epoch:
+                    continue  # a map landed mid-pass: re-run now
+                incomplete = [
+                    pg for _pool, pg, _a in work
+                    if self._clean_epoch.get((pg.pool, pg.ps), -1)
+                    < done_epoch
+                ]
+                if not incomplete:
+                    return
+                log.info(
+                    "osd.%d: %d pgs unclean after pass; retrying",
+                    self.id, len(incomplete))
+                await asyncio.sleep(
+                    max(self.conf["osd_backfill_retry_interval"], 0.05))
             except asyncio.CancelledError:
                 raise
             except Exception:
                 log.exception("osd.%d: recovery pass failed", self.id)
                 return
+
+    async def _recover_pg_reserved(
+        self, pool: PgPool, pg: pg_t, acting: list[int], pass_epoch: int,
+    ) -> None:
+        key = (pg.pool, pg.ps)
+        peers = sorted({
+            o for o in acting
+            if o != CRUSH_ITEM_NONE and o != self.id
+        })
+        retry = self.conf["osd_backfill_retry_interval"]
+        async with self.local_reserver.request(key, priority=1):
+            self.recovery_stats["peak_local"] = max(
+                self.recovery_stats["peak_local"],
+                self.local_reserver.in_use)
+            granted: list[int] = []
+            try:
+                while not self.stopping and self.epoch == pass_epoch:
+                    if await self._reserve_remotes(pg, peers, granted):
+                        break
+                    # partial holds across the retry sleep invite
+                    # cluster-wide deadlock (two primaries each camped
+                    # on one of the other's replicas): drop everything
+                    self.recovery_stats["reservation_rejects"] += 1
+                    await self._release_remotes(pg, granted)
+                    granted.clear()
+                    await asyncio.sleep(retry)
+                else:
+                    return
+                self._recovering_pgs.add(key)
+                try:
+                    ok = await self._recover_pg(pool, pg, acting)
+                    if ok:
+                        self._clean_epoch[key] = pass_epoch
+                        self.recovery_stats["pgs_recovered"] += 1
+                finally:
+                    self._recovering_pgs.discard(key)
+            finally:
+                await self._release_remotes(pg, granted)
+
+    async def _reserve_remotes(
+        self, pg: pg_t, peers: list[int], granted: list[int],
+    ) -> bool:
+        """GRANT from every acting peer, or False on REJECT_TOOFULL.
+
+        A peer the MAP says is down is skipped — it can take no
+        recovery load and no pushes will reach it.  A peer that is up
+        but unreachable counts as a REJECT: it may come back mid-
+        recovery and start absorbing pushes, so proceeding without its
+        slot would unbound its inbound backfill load; the retry loop
+        re-asks (either it answers, or it gets marked down — a new
+        epoch — and the pass restarts without it).  Either way a
+        best-effort RELEASE covers the race where the peer GRANTed but
+        the reply missed our timeout — without it the replica's slot
+        leaks until we restart."""
+        for o in peers:
+            tid = next(self._tids)
+            try:
+                rep = await self._sub_op(o, MBackfillReserve(
+                    tid=tid, op=MBackfillReserve.REQUEST, pool=pg.pool,
+                    ps=pg.ps, from_osd=self.id, priority=1,
+                ), tid)
+            except (OSError, asyncio.TimeoutError, ConnectionError):
+                if not self.osdmap.is_up(o):
+                    continue
+                await self._release_remotes(pg, [o])
+                return False
+            if rep.op == MBackfillReserve.GRANT:
+                granted.append(o)
+            else:
+                return False
+        return True
+
+    async def _release_remotes(self, pg: pg_t, granted: list[int]) -> None:
+        for o in granted:
+            try:
+                conn = await self._osd_conn(o)
+                await conn.send_message(MBackfillReserve(
+                    tid=next(self._tids), op=MBackfillReserve.RELEASE,
+                    pool=pg.pool, ps=pg.ps, from_osd=self.id,
+                ))
+            except (OSError, asyncio.TimeoutError, ConnectionError):
+                continue
+
+    async def _handle_backfill_reserve(self, msg: MBackfillReserve) -> None:
+        if msg.op == MBackfillReserve.REQUEST:
+            key = (msg.pool, msg.ps, msg.from_osd)
+            res = self.remote_reserver.try_request(key, msg.priority)
+            if res is not None:
+                self._remote_grants[key] = res
+                self.recovery_stats["peak_remote"] = max(
+                    self.recovery_stats["peak_remote"],
+                    self.remote_reserver.in_use)
+                op = MBackfillReserve.GRANT
+            else:
+                op = MBackfillReserve.REJECT_TOOFULL
+            await msg.conn.send_message(MBackfillReserve(
+                tid=msg.tid, op=op, pool=msg.pool, ps=msg.ps,
+                from_osd=self.id,
+            ))
+        elif msg.op == MBackfillReserve.RELEASE:
+            res = self._remote_grants.pop(
+                (msg.pool, msg.ps, msg.from_osd), None)
+            if res is not None:
+                res.release()
+        else:  # GRANT / REJECT_TOOFULL reply to our REQUEST
+            fut = self._waiters.get(msg.tid)
+            if fut and not fut.done():
+                fut.set_result(msg)
 
     def _local_objects(self, pool, pg, shard) -> list[str]:
         c = self._shard_coll(pool, pg, shard)
@@ -3300,18 +3510,38 @@ class OSDDaemon:
         else:
             objs = scope
         all_ok = True
-        for oid in sorted(objs):
-            try:
-                ok = await self._reconcile_object(
-                    pool, pg, pairs, oid, stray=oid in strays,
-                    prior_pairs=prior,
-                )
-                all_ok &= bool(ok)
-            except (OSError, asyncio.TimeoutError, ConnectionError):
+        rsleep = self.conf["osd_recovery_sleep"]
+
+        async def _one(oid: str) -> bool:
+            # osd_recovery_max_active: in-flight reconciliations per
+            # daemon, across every concurrently-reserved PG; each one
+            # then admits through the mClock gate at recovery weight,
+            # so saturated client I/O overtakes it (admission strictly
+            # BEFORE the object lock — a lock holder must never wait
+            # on admission, or slots+locks could cycle)
+            async with self._recovery_budget:
+                async with self.op_gate.admit("recovery"):
+                    ok = await self._reconcile_object(
+                        pool, pg, pairs, oid, stray=oid in strays,
+                        prior_pairs=prior,
+                    )
+                if rsleep:
+                    await asyncio.sleep(rsleep)
+                return bool(ok)
+
+        results = await asyncio.gather(
+            *[_one(oid) for oid in sorted(objs)], return_exceptions=True,
+        )
+        for oid, r in zip(sorted(objs), results):
+            if isinstance(r, (OSError, asyncio.TimeoutError, ConnectionError)):
                 log.warning(
-                    "osd.%d: reconcile %s/%s interrupted", self.id, pg, oid
+                    "osd.%d: reconcile %s/%s interrupted: %r",
+                    self.id, pg, oid, r,
                 )
                 return False
+            if isinstance(r, BaseException):
+                raise r
+            all_ok &= r
         # log sync
         for (s, o), info in peer_infos.items():
             if info.last_update >= lg.info.last_update:
@@ -3716,17 +3946,18 @@ class OSDDaemon:
     async def _push(self, pool, pg, shard, osd, oid, payload, attrs,
                     force: bool = False) -> None:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._push_waiters[(pg, shard, osd)] = fut
+        tid = next(self._tids)
+        self._push_waiters[tid] = fut
         try:
             conn = await self._osd_conn(osd)
             await conn.send_message(MOSDPGPush(
                 pg=pg, shard=shard, from_osd=self.id,
                 pushes=[(oid, payload, attrs)], epoch=self.epoch,
-                force=force,
+                force=force, tid=tid,
             ))
             await asyncio.wait_for(fut, SUBOP_TIMEOUT)
         finally:
-            self._push_waiters.pop((pg, shard, osd), None)
+            self._push_waiters.pop(tid, None)
 
     # -- scrub (src/osd/scrubber/, simplified to one pass) -------------
 
@@ -3792,11 +4023,16 @@ class OSDDaemon:
         chunk_sleep = self.conf["osd_scrub_sleep"]
         inconsistencies: list[dict] = []
         for base in range(0, len(all_oids), chunk_max):
-            for oid in all_oids[base : base + chunk_max]:
-                async with self._obj_lock(pool.id, oid):
-                    inconsistencies.extend(
-                        await self._scrub_object(pool, pg, pairs, oid, deep)
-                    )
+            # one gate admission per chunk at best-effort weight:
+            # saturated client I/O outranks the scan (admission before
+            # the object locks, per the opqueue deadlock rule)
+            async with self.op_gate.admit("best_effort"):
+                for oid in all_oids[base : base + chunk_max]:
+                    async with self._obj_lock(pool.id, oid):
+                        inconsistencies.extend(
+                            await self._scrub_object(
+                                pool, pg, pairs, oid, deep)
+                        )
             await asyncio.sleep(chunk_sleep)
 
         repaired: list[str] = []
@@ -4097,6 +4333,7 @@ class OSDDaemon:
             )
         await msg.conn.send_message(MOSDPGPushReply(
             pg=msg.pg, shard=msg.shard, from_osd=self.id, epoch=self.epoch,
+            tid=msg.tid,
         ))
 
 

@@ -1,0 +1,132 @@
+"""mClock admission gate: the op-scheduler seam wired into the daemon.
+
+The reference queues every PG work item — client ops, recovery,
+scrub — through one pluggable scheduler (src/osd/scheduler/
+OpScheduler.h; mClockScheduler.h maps item class -> dmclock
+(reservation, weight, limit)).  Here the asyncio twin: ops *admit*
+through the gate before executing; while free slots remain admission
+is immediate (work-conserving), and once ``max_inflight`` slots are
+busy, waiters park inside :class:`MClockScheduler` so that the order
+they unpark follows dmclock tags — client ops (high weight) overtake
+background recovery (low weight) exactly when the OSD is saturated,
+which is the only time ordering matters.
+
+Deadlock rule: only TOP-LEVEL work admits (client MOSDOp, recovery
+reconciliations, scrub chunks).  Sub-op service (replica writes, EC
+shard reads, pushes) never admits — a held slot can therefore never
+wait on a peer's held slot, so the distributed wait graph stays
+acyclic (the reference gets the same property from queueing only PG
+items, not message service).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from ceph_tpu.osd.scheduler import ClientProfile, MClockScheduler
+
+
+class MClockGate:
+    """Bounded-concurrency admission through dmclock ordering."""
+
+    def __init__(self, max_inflight: int = 0,
+                 profiles: dict[str, ClientProfile] | None = None):
+        self.max_inflight = int(max_inflight)
+        self.sched = MClockScheduler()
+        for name, prof in (profiles or {}).items():
+            self.sched.set_profile(name, prof)
+        self._inflight = 0
+        self._kick_handle = None
+        self.stats = {"admitted": {}, "queued": {}, "peak_inflight": 0}
+
+    def set_max_inflight(self, n: int) -> None:
+        self.max_inflight = int(n)
+        if self.max_inflight <= 0:
+            # gating switched off: flush every parked waiter, still
+            # counting their slots so the outstanding releases balance
+            while len(self.sched):
+                # now=inf: limit tags never block the flush
+                nxt = self.sched.dequeue(float("inf"))
+                if nxt is None:
+                    break
+                _klass, fut = nxt
+                if not fut.done():
+                    self._inflight += 1
+                    fut.set_result(None)
+            return
+        self._drain()
+
+    def admit(self, klass: str, cost: float = 1.0) -> "_Admission":
+        return _Admission(self, klass, cost)
+
+    # -- internals --------------------------------------------------------
+
+    async def _acquire(self, klass: str, cost: float) -> bool:
+        """Returns True when a slot was actually taken — the release
+        must mirror THAT, not the max_inflight value at release time
+        (toggling the config through 0 mid-flight must not corrupt the
+        counter)."""
+        self.stats["admitted"][klass] = (
+            self.stats["admitted"].get(klass, 0) + 1)
+        if self.max_inflight <= 0:  # gating disabled
+            return False
+        if self._inflight < self.max_inflight:
+            self._inflight += 1
+            self.stats["peak_inflight"] = max(
+                self.stats["peak_inflight"], self._inflight)
+            return True
+        self.stats["queued"][klass] = self.stats["queued"].get(klass, 0) + 1
+        fut = asyncio.get_running_loop().create_future()
+        self.sched.enqueue(klass, fut, cost=cost, now=time.monotonic())
+        try:
+            await fut
+        except asyncio.CancelledError:
+            # the slot may have been handed to us between the grant
+            # and the cancel landing; give it back
+            if fut.done() and not fut.cancelled():
+                self._release()
+            raise
+        return True
+
+    def _release(self) -> None:
+        self._inflight -= 1
+        self._drain()
+
+    def _drain(self) -> None:
+        while self._inflight < self.max_inflight:
+            nxt = self.sched.dequeue(time.monotonic())
+            if nxt is None:
+                # non-empty but nothing ready = every waiter is
+                # limit-capped; retry when the earliest L tag matures
+                if len(self.sched) and self._kick_handle is None:
+                    loop = asyncio.get_event_loop()
+                    self._kick_handle = loop.call_later(
+                        0.005, self._timer_kick)
+                return
+            _klass, fut = nxt
+            if fut.done():  # admission cancelled while queued
+                continue
+            self._inflight += 1
+            self.stats["peak_inflight"] = max(
+                self.stats["peak_inflight"], self._inflight)
+            fut.set_result(None)
+
+    def _timer_kick(self) -> None:
+        self._kick_handle = None
+        self._drain()
+
+
+class _Admission:
+    def __init__(self, gate: MClockGate, klass: str, cost: float):
+        self.gate, self.klass, self.cost = gate, klass, cost
+        self._took_slot = False
+
+    async def __aenter__(self):
+        self._took_slot = await self.gate._acquire(self.klass, self.cost)
+        return self
+
+    async def __aexit__(self, *exc):
+        if self._took_slot:
+            self._took_slot = False
+            self.gate._release()
