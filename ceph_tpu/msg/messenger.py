@@ -25,6 +25,11 @@ from ceph_tpu.msg.denc import Decoder, Encoder
 
 log = logging.getLogger("ceph_tpu.msg")
 
+# bound on the banner/HELLO/auth exchange, both directions (the
+# reference's ms_connection_ready_timeout, src/common/options/global
+# .yaml.in): a half-open peer must fail the dial, not wedge it
+HANDSHAKE_TIMEOUT = 10.0
+
 _REGISTRY: dict[int, type] = {}
 
 
@@ -291,7 +296,8 @@ class Messenger:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         conn = Connection(self, reader, writer)
-        try:
+
+        async def _handshake() -> None:
             await frames.send_banner(writer)
             await frames.recv_banner(reader)
             # HELLO: peer introduces itself first, then we do
@@ -306,7 +312,14 @@ class Messenger:
             await frames.write_frame(writer, frames.Tag.HELLO, [enc.bytes()])
             if self.auth is not None:
                 await self._auth_accept(conn)
-        except (ConnectionError, asyncio.IncompleteReadError, OSError, PermissionError):
+
+        try:
+            # a dialer that accepted TCP but never completes the
+            # banner/HELLO must not pin this task forever (the
+            # reference's ms_connection_ready_timeout role)
+            await asyncio.wait_for(_handshake(), HANDSHAKE_TIMEOUT)
+        except (ConnectionError, asyncio.IncompleteReadError, OSError,
+                PermissionError, asyncio.TimeoutError):
             writer.close()
             return
         if not getattr(conn, "_needs_auth_proof", False):
@@ -353,7 +366,35 @@ class Messenger:
             return conn
 
     async def connect(self, host: str, port: int) -> Connection:
+        """Dial, then handshake bounded by HANDSHAKE_TIMEOUT: a
+        half-open peer (accepted TCP, wedged before HELLO) must surface
+        as ConnectionError, not hang the dial — connect_to holds the
+        per-peer dial lock, so an unbounded dial would wedge EVERY
+        future message to that peer (found by the interleaving fuzzer,
+        tests/test_interleave_fuzz.py).
+
+        The TCP connect itself is deliberately NOT under the timeout:
+        on the loopback deployments we run, connect() either completes
+        or refuses immediately, and cancelling asyncio's sock_connect
+        mid-flight leaves a stale selector registration that a reused
+        fd number then trips over (the CPython _sock_write_done /
+        _ensure_fd_no_transport race — also fuzzer-found)."""
         reader, writer = await asyncio.open_connection(host, port)
+        try:
+            return await asyncio.wait_for(
+                self._handshake_out(reader, writer, host, port),
+                HANDSHAKE_TIMEOUT)
+        except asyncio.TimeoutError:
+            writer.close()
+            raise ConnectionError(
+                f"handshake with {host}:{port} timed out") from None
+        except BaseException:
+            # handshake failure: the socket must not leak (the
+            # retrying callers re-dial every pass)
+            writer.close()
+            raise
+
+    async def _handshake_out(self, reader, writer, host, port) -> Connection:
         conn = Connection(self, reader, writer)
         conn.peer_addr = (host, port)
         await frames.recv_banner(reader)
