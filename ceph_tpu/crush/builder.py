@@ -236,6 +236,115 @@ def add_osd_multi_per_domain_rule(
     return rule_id
 
 
+def _refresh_aux(b: Bucket) -> None:
+    """Recompute the per-alg auxiliary arrays after an items change
+    (make_bucket derivations, builder.c crush_bucket_add/remove_item)."""
+    if b.alg == BucketAlg.LIST:
+        total = 0
+        b.sum_weights = []
+        for w in b.item_weights:
+            total += w
+            b.sum_weights.append(total)
+    elif b.alg == BucketAlg.TREE:
+        b.node_weights = _tree_node_weights(b.items, b.item_weights)
+    elif b.alg == BucketAlg.UNIFORM:
+        if b.item_weights:
+            b.item_weights = [b.item_weights[0]] * len(b.items)
+
+
+def add_bucket(
+    map_: CrushMap, name: str, type_name: str,
+    alg: BucketAlg = BucketAlg.STRAW2,
+) -> Bucket:
+    """CrushWrapper::add_bucket + set_item_name: a new EMPTY named
+    bucket, unattached until `osd crush move` places it."""
+    if name in map_.bucket_names:
+        return map_.buckets[map_.bucket_names[name]]
+    b = make_bucket(map_, alg, map_.type_id(type_name), [], [])
+    map_.bucket_names[name] = b.id
+    return b
+
+
+def detach_item(map_: CrushMap, item: int) -> int:
+    """Unlink ``item`` from whichever bucket holds it (builder.c
+    crush_bucket_remove_item), propagating the weight loss up.
+    Returns the weight it had (16.16), or -1 if unattached."""
+    for b in map_.buckets.values():
+        for i, it in enumerate(b.items):
+            if it == item:
+                w = b.item_weights[i]
+                del b.items[i]
+                del b.item_weights[i]
+                _refresh_aux(b)
+                if w:
+                    _propagate_weight(map_, b.id, -w)
+                return w
+    return -1
+
+
+def attach_item(
+    map_: CrushMap, item: int, parent: int, weight: int,
+) -> None:
+    """Link ``item`` under bucket ``parent`` at ``weight``
+    (builder.c crush_bucket_add_item)."""
+    b = map_.buckets[parent]
+    b.items.append(item)
+    b.item_weights.append(weight)
+    _refresh_aux(b)
+    if weight:
+        _propagate_weight(map_, b.id, weight)
+    if item >= 0:
+        map_.max_devices = max(map_.max_devices, item + 1)
+
+
+def would_cycle(map_: CrushMap, item: int, parent: int) -> bool:
+    """True when linking bucket ``item`` under ``parent`` would create
+    a cycle (parent is item or sits inside item's subtree)."""
+    if item >= 0:
+        return False
+    seen = set()
+    cur = parent
+    while cur is not None and cur not in seen:
+        if cur == item:
+            return True
+        seen.add(cur)
+        cur = next(
+            (b.id for b in map_.buckets.values() if cur in b.items),
+            None,
+        )
+    return False
+
+
+def move_item(
+    map_: CrushMap, item: int, parent: int, weight: int | None = None,
+) -> bool:
+    """CrushWrapper::move_bucket / create-or-move semantics: unlink
+    from the current parent (keeping the weight unless overridden) and
+    relink under ``parent``.  Refuses a move that would create a cycle
+    (moving a bucket under its own subtree).  Returns False on cycle."""
+    if would_cycle(map_, item, parent):
+        return False
+    old_w = detach_item(map_, item)
+    if weight is None:
+        weight = old_w if old_w >= 0 else (
+            map_.buckets[item].weight if item < 0 else 0x10000)
+    attach_item(map_, item, parent, weight)
+    return True
+
+
+def remove_item(map_: CrushMap, item: int) -> bool:
+    """CrushWrapper::remove_item: unlink everywhere; a bucket is also
+    deleted from the map (caller enforces emptiness)."""
+    found = detach_item(map_, item) >= 0
+    if item < 0 and item in map_.buckets:
+        del map_.buckets[item]
+        for name, bid in list(map_.bucket_names.items()):
+            if bid == item:
+                del map_.bucket_names[name]
+        found = True
+    return found
+
+
 def reweight_item(map_: CrushMap, item: int, weight: int) -> bool:
     """CrushWrapper::adjust_item_weightf: set an item's CRUSH weight
     (16.16 fixed) wherever it appears, propagating the delta up through

@@ -609,6 +609,43 @@ class Monitor:
             if not _builder.reweight_item(
                     om.crush, op["item"], op["weight"]):
                 return  # unknown item: no epoch
+        elif kind == "crush_add_bucket":
+            from ceph_tpu.crush import builder as _builder
+
+            if op["name"] in om.crush.bucket_names:
+                return  # replay
+            _builder.add_bucket(om.crush, op["name"], op["type"])
+        elif kind == "crush_move":
+            from ceph_tpu.crush import builder as _builder
+
+            name = op["item_name"]
+            if name.startswith("osd."):
+                item = int(name[4:])
+            elif name in om.crush.bucket_names:
+                item = om.crush.bucket_names[name]
+            else:
+                return
+            parent = om.crush.bucket_names.get(op["loc"])
+            if parent is None:
+                return
+            if not _builder.move_item(
+                    om.crush, item, parent, op.get("weight")):
+                return  # cycle: no epoch
+        elif kind == "crush_rm":
+            from ceph_tpu.crush import builder as _builder
+
+            name = op["item_name"]
+            if name.startswith("osd."):
+                item = int(name[4:])
+            elif name in om.crush.bucket_names:
+                item = om.crush.bucket_names[name]
+            else:
+                return
+            if item < 0 and om.crush.buckets.get(item, None) is not None \
+                    and om.crush.buckets[item].items:
+                return  # became non-empty since validation: refuse
+            if not _builder.remove_item(om.crush, item):
+                return
         elif kind == "snap_alloc":
             pool = om.pools[op["pool"]]
             pool.snap_seq = max(pool.snap_seq, op["snapid"])
@@ -1251,6 +1288,8 @@ class Monitor:
         "osd pool selfmanaged-snap rm",
         "osd pool mksnap", "osd pool rmsnap",
         "config set", "config rm", "osd crush reweight",
+        "osd crush add-bucket", "osd crush move", "osd crush add",
+        "osd crush rm",
         "osd pg-upmap-items",
         "auth add", "auth get-or-create", "auth del", "auth caps",
         "osd pool set", "osd pool rm", "osd in",
@@ -1516,6 +1555,84 @@ class Monitor:
                     "weight": weight,
                 })
                 return 0, f"reweighted {name} to {cmd['weight']}", b""
+            if prefix == "osd crush add-bucket":
+                # OSDMonitor 'osd crush add-bucket <name> <type>'
+                name, tname = cmd["name"], cmd["type"]
+                om2 = self.osdmap
+                try:
+                    om2.crush.type_id(tname)
+                except KeyError:
+                    return -errno.EINVAL, f"unknown type {tname!r}", b""
+                if name in om2.crush.bucket_names:
+                    return 0, f"bucket {name!r} already exists", b""
+                await self._propose({
+                    "op": "crush_add_bucket", "name": name,
+                    "type": tname,
+                })
+                return 0, f"added bucket {name}", b""
+            if prefix in ("osd crush move", "osd crush add"):
+                # 'osd crush move <name> <loc>' relocates an existing
+                # item; 'osd crush add osd.N <weight> <loc>' places a
+                # device (create-or-move).  <loc> is type=name, e.g.
+                # root=default or host=host3 (CrushWrapper::move_bucket
+                # / insert_item)
+                name = cmd["name"]
+                loc = cmd.get("loc") or cmd.get("args", "")
+                if "=" not in loc:
+                    return -errno.EINVAL, f"bad location {loc!r}", b""
+                _ltype, lname = loc.split("=", 1)
+                om2 = self.osdmap
+                if lname not in om2.crush.bucket_names:
+                    return -errno.ENOENT, f"no bucket {lname!r}", b""
+                if name.startswith("osd."):
+                    item = int(name[4:])
+                    if prefix == "osd crush add" and \
+                            not om2.exists(item):
+                        return -errno.ENOENT, \
+                            f"osd.{item} does not exist", b""
+                elif prefix == "osd crush add":
+                    # the reference restricts 'crush add' to devices:
+                    # an explicit weight on a bucket would desync the
+                    # parent's stored weight from the subtree sum
+                    return -errno.EINVAL, \
+                        "'osd crush add' takes an osd.N id (use " \
+                        "'osd crush move' for buckets)", b""
+                elif name in om2.crush.bucket_names:
+                    item = om2.crush.bucket_names[name]
+                else:
+                    return -errno.ENOENT, f"no item {name!r}", b""
+                from ceph_tpu.crush.builder import would_cycle
+
+                if would_cycle(
+                        om2.crush, item,
+                        om2.crush.bucket_names[lname]):
+                    return -errno.EINVAL, \
+                        f"moving {name!r} under {lname!r} would " \
+                        "create a loop", b""
+                op = {
+                    "op": "crush_move", "item_name": name,
+                    "loc": lname,
+                }
+                if prefix == "osd crush add":
+                    op["weight"] = int(float(cmd["weight"]) * 0x10000)
+                await self._propose(op)
+                return 0, f"moved {name} under {lname}", b""
+            if prefix == "osd crush rm":
+                name = cmd["name"]
+                om2 = self.osdmap
+                if name.startswith("osd."):
+                    item = int(name[4:])
+                elif name in om2.crush.bucket_names:
+                    item = om2.crush.bucket_names[name]
+                else:
+                    return -errno.ENOENT, f"no item {name!r}", b""
+                if item < 0 and om2.crush.buckets[item].items:
+                    return -errno.ENOTEMPTY, \
+                        f"bucket {name!r} is not empty", b""
+                await self._propose({
+                    "op": "crush_rm", "item_name": name,
+                })
+                return 0, f"removed {name}", b""
             if prefix == "osd pool autoscale-status":
                 # the pg_autoscaler mgr module's sizing math
                 # (reference src/pybind/mgr/pg_autoscaler).  Advisory

@@ -121,3 +121,101 @@ class TestAutoscaleStatus:
                 assert rows["b"]["would_adjust"]
 
         run(go())
+
+
+class TestCrushTopologyAdmin:
+    """osd crush add-bucket / move / add / rm (reference OSDMonitor
+    crush admin verbs -> CrushWrapper add_bucket/move_bucket/
+    insert_item/remove_item)."""
+
+    def test_add_bucket_move_and_rm(self):
+        async def go():
+            async with Cluster(n_osds=4) as c:
+                epoch0 = c.client.osdmap.epoch
+                code, rs, _ = await c.client.command({
+                    "prefix": "osd crush add-bucket",
+                    "name": "rack1", "type": "root"})
+                assert code == 0, rs
+                await c.wait_epoch(epoch0 + 1)
+                om = c.client.osdmap
+                rid = om.crush.bucket_names["rack1"]
+                assert om.crush.buckets[rid].items == []
+
+                # move host1 under the new bucket; weights follow
+                epoch1 = om.epoch
+                code, rs, _ = await c.client.command({
+                    "prefix": "osd crush move", "name": "host1",
+                    "loc": "root=rack1"})
+                assert code == 0, rs
+                await c.wait_epoch(epoch1 + 1)
+                om = c.client.osdmap
+                rid = om.crush.bucket_names["rack1"]
+                hid = om.crush.bucket_names["host1"]
+                assert hid in om.crush.buckets[rid].items
+                default = om.crush.buckets[
+                    om.crush.bucket_names["default"]]
+                assert hid not in default.items
+                # the rack's weight equals the host subtree it gained
+                assert om.crush.buckets[rid].weight == \
+                    om.crush.buckets[hid].weight
+
+                # a cycle move is refused at command time
+                code, rs, _ = await c.client.command({
+                    "prefix": "osd crush move", "name": "rack1",
+                    "loc": "root=rack1"})
+                assert code != 0, rs
+                # 'crush add' only takes devices, and only real ones
+                code, _, _ = await c.client.command({
+                    "prefix": "osd crush add", "name": "osd.99",
+                    "weight": "1.0", "loc": "root=default"})
+                assert code != 0
+                code, _, _ = await c.client.command({
+                    "prefix": "osd crush add", "name": "host1",
+                    "weight": "1.0", "loc": "root=default"})
+                assert code != 0
+
+                # rm refuses a non-empty bucket
+                code, rs, _ = await c.client.command({
+                    "prefix": "osd crush rm", "name": "rack1"})
+                assert code != 0
+                # move the host back, then rm succeeds
+                code, rs, _ = await c.client.command({
+                    "prefix": "osd crush move", "name": "host1",
+                    "loc": "root=default"})
+                assert code == 0, rs
+                code, rs, _ = await c.client.command({
+                    "prefix": "osd crush rm", "name": "rack1"})
+                assert code == 0, rs
+                for _ in range(50):
+                    if "rack1" not in c.client.osdmap.crush.bucket_names:
+                        break
+                    await asyncio.sleep(0.1)
+                assert "rack1" not in c.client.osdmap.crush.bucket_names
+
+        run(go())
+
+    def test_crush_add_places_new_osd(self):
+        async def go():
+            async with Cluster(n_osds=4) as c:
+                # park osd.3 somewhere else: detach and re-add under
+                # host0 at half weight (create-or-move semantics)
+                epoch0 = c.client.osdmap.epoch
+                code, rs, _ = await c.client.command({
+                    "prefix": "osd crush add", "name": "osd.3",
+                    "weight": "0.5", "loc": "host=host0"})
+                assert code == 0, rs
+                await c.wait_epoch(epoch0 + 1)
+                om = c.client.osdmap
+                h0 = om.crush.buckets[om.crush.bucket_names["host0"]]
+                h3 = om.crush.buckets[om.crush.bucket_names["host3"]]
+                assert 3 in h0.items
+                assert 3 not in h3.items
+                i = h0.items.index(3)
+                assert h0.item_weights[i] == 0x8000
+                # data still placeable: write/read through the new map
+                await c.client.pool_create("t", pg_num=4, size=2)
+                io = c.client.ioctx("t")
+                await io.write_full("a", b"topology")
+                assert await io.read("a") == b"topology"
+
+        run(go())

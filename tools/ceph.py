@@ -112,6 +112,21 @@ async def amain(args, extra: list[str]) -> int:
             code, rs, data = await client.command({
                 "prefix": "osd crush reweight", "name": extra[2],
                 "weight": extra[3]})
+        elif verb == "osd" and extra[:2] == ["crush", "add-bucket"]:
+            code, rs, data = await client.command({
+                "prefix": "osd crush add-bucket", "name": extra[2],
+                "type": extra[3]})
+        elif verb == "osd" and extra[:2] == ["crush", "move"]:
+            code, rs, data = await client.command({
+                "prefix": "osd crush move", "name": extra[2],
+                "loc": extra[3]})
+        elif verb == "osd" and extra[:2] == ["crush", "add"]:
+            code, rs, data = await client.command({
+                "prefix": "osd crush add", "name": extra[2],
+                "weight": extra[3], "loc": extra[4]})
+        elif verb == "osd" and extra[:2] == ["crush", "rm"]:
+            code, rs, data = await client.command({
+                "prefix": "osd crush rm", "name": extra[2]})
         else:
             print(f"unknown command: {verb} {' '.join(extra)}", file=sys.stderr)
             return 2
