@@ -107,23 +107,35 @@ def main() -> int:
                 data = out = None  # drop the failed attempt's buffers too
         assert data is not None, "no batch size fit in device memory"
 
-        best = float("inf")
+        times = []
         rounds, pause = 6, 3.0
         for r in range(rounds):
             t0 = time.perf_counter()
             out = loop_encode(data, jnp.int32(ITERS))
             jax.block_until_ready(out)
             _ = np.asarray(out[0, :8])  # host round-trip barrier
-            best = min(best, time.perf_counter() - t0)
+            times.append(time.perf_counter() - t0)
             if r < rounds - 1:
                 time.sleep(pause)
-        gbs = (k * S * ITERS) / best / 1e9
+        samples = sorted((k * S * ITERS) / t / 1e9 for t in times)
+        gbs = samples[-1]  # best-of-6: co-tenant bursts only subtract
 
+    extra = {}
+    if on_tpu:
+        # full spread in the artifact so the headline survives scrutiny
+        # (the chip is co-tenant-shared; see docstring)
+        extra = {
+            "samples_gb_s": [round(s, 2) for s in samples],
+            "median_gb_s": round(
+                float(np.median(np.asarray(samples))), 2),
+            "min_gb_s": round(samples[0], 2),
+        }
     print(json.dumps({
         "metric": "RS(8,3) erasure encode throughput, 1 chip",
         "value": round(gbs, 2),
         "unit": "GB/s",
         "vs_baseline": round(gbs / 40.0, 3),
+        **extra,
     }))
     return 0
 

@@ -21,7 +21,16 @@ Capabilities:
   written; writes copy-up the parent object first, exactly the
   reference's object-granularity COW;
 - **exclusive lock** via the in-OSD ``lock`` object class on the header
-  (librbd's exclusive_lock feature over cls_lock).
+  (librbd's exclusive_lock feature over cls_lock);
+- **object-map / fast-diff** (``features=["object-map"]``,
+  src/librbd/object_map/): a 2-bit-per-object state vector that
+  short-circuits reads of nonexistent objects and diffs two snapshots
+  without touching data objects (ceph_tpu/rbd/objectmap.py);
+- **journaling** (``features=["journaling"]``, src/librbd/journal/):
+  write-ahead event log on the metadata pool, replayed on open after a
+  crash and consumed by rbd-mirror (ceph_tpu/rbd/journal.py);
+- **mirroring** (src/tools/rbd_mirror/): journal-based one-way replay
+  into a second cluster, with promote/demote (ceph_tpu/rbd/mirror.py).
 """
 
 from __future__ import annotations
@@ -29,6 +38,8 @@ from __future__ import annotations
 import asyncio
 import errno
 import json
+
+from ceph_tpu.rbd import objectmap as _OM
 
 RBD_DIRECTORY = "rbd_directory"
 DEFAULT_ORDER = 22  # 4 MiB objects, the reference default
@@ -46,8 +57,12 @@ class RBD:
         self.data = data_ioctx or meta_ioctx
 
     async def create(
-        self, name: str, size: int, order: int = DEFAULT_ORDER
+        self, name: str, size: int, order: int = DEFAULT_ORDER,
+        features: tuple[str, ...] | list[str] = (),
     ) -> None:
+        for f in features:
+            if f not in ("object-map", "fast-diff", "journaling"):
+                raise RBDError(errno.EINVAL, f"unknown feature {f!r}")
         existing = await self._dir()
         if name in existing:
             raise RBDError(errno.EEXIST, f"image {name!r} exists")
@@ -56,6 +71,8 @@ class RBD:
             "size": str(size).encode(),
             "order": str(order).encode(),
             "object_prefix": f"rbd_data.{name}".encode(),
+            "features": ",".join(features).encode(),
+            "primary": b"1",
         })
         await self.meta.omap_set(RBD_DIRECTORY, {name: b"1"})
 
@@ -97,7 +114,11 @@ class RBD:
         return sorted(await self._dir())
 
     async def remove(self, name: str) -> None:
-        img = await self.open(name)
+        # replay=False: re-applying journal events into an image about
+        # to be destroyed is wasted work, and an unreplayable event
+        # (e.g. a crash-torn WRITE past a later shrink) would make the
+        # image undeletable
+        img = await self.open(name, replay=False)
         if img.snaps:
             raise RBDError(errno.ENOTEMPTY, "image has snapshots")
         await img.remove_data()
@@ -108,13 +129,18 @@ class RBD:
                 raise
         await self.meta.omap_rm_keys(RBD_DIRECTORY, [name])
 
-    async def open(self, name: str) -> "Image":
+    async def open(self, name: str, replay: bool = True) -> "Image":
+        """``replay=False`` opens without journal crash-replay — the
+        stance of a NON-OWNING reader (rbd-mirror): replaying another
+        client's in-flight events would make this handle a second
+        writer and advance the owner's commit_pos under it."""
         try:
             meta = await self.meta.omap_get(f"rbd_header.{name}")
         except OSError as e:
             raise RBDError(errno.ENOENT, f"no image {name!r}") from e
         if "size" not in meta:
             raise RBDError(errno.ENOENT, f"no image {name!r}")
+        feats = meta.get("features", b"").decode()
         img = Image(
             self, name,
             size=int(meta["size"]),
@@ -122,8 +148,11 @@ class RBD:
             prefix=meta["object_prefix"].decode(),
             snaps=json.loads(meta.get("snaps", b"{}")),
             parent=json.loads(meta["parent"]) if "parent" in meta else None,
+            features=frozenset(f for f in feats.split(",") if f),
+            primary=meta.get("primary", b"1") == b"1",
         )
         img._apply_snapc()
+        await img._init_features(replay=replay)
         return img
 
 
@@ -132,7 +161,9 @@ class Image:
 
     def __init__(self, rbd: RBD, name: str, size: int, order: int,
                  prefix: str, snaps: dict | None = None,
-                 parent: dict | None = None):
+                 parent: dict | None = None,
+                 features: frozenset[str] = frozenset(),
+                 primary: bool = True):
         self.rbd = rbd
         self.name = name
         self._size = size
@@ -143,10 +174,63 @@ class Image:
         self.snaps: dict[str, dict] = snaps or {}
         #: layering link: {"image", "snap", "snapid", "overlap"} or None
         self.parent = parent
+        self.features = features
+        #: mirroring role: a demoted (non-primary) image refuses writes
+        self.primary = primary
         # per-image data handle: the image's own SnapContext lives here
         self._io = rbd.data.dup()
         self._read_snap_name: str | None = None
         self._parent_img: "Image | None" = None  # lazy, header cached
+        self.objmap = None  # ObjectMap when the feature is on
+        self.journal = None  # Journal when the feature is on
+        self._replaying = False
+
+    def _n_objs(self, size: int | None = None) -> int:
+        size = self._size if size is None else size
+        return (size + self.obj_size - 1) // self.obj_size
+
+    async def _init_features(self, replay: bool = True) -> None:
+        if "object-map" in self.features or "fast-diff" in self.features:
+            from ceph_tpu.rbd.objectmap import ObjectMap
+
+            self.objmap = await ObjectMap(
+                self.rbd.meta, self.name, self._n_objs()).load()
+        if "journaling" in self.features:
+            from ceph_tpu.rbd.journal import Journal
+
+            self.journal = Journal(self.rbd.meta, self.name)
+            if replay:
+                await self._journal_replay()
+
+    async def _journal_replay(self) -> None:
+        """Open-time crash recovery (librbd journal replay): re-apply
+        every event past commit_pos; events are idempotent."""
+        pos = await self.journal.commit_pos()
+        events = await self.journal.events_after(pos)
+        if not events:
+            return
+        self._replaying = True
+        try:
+            for seq, head, payload in events:
+                await self._apply_journal_event(head, payload)
+                await self.journal.commit(seq)
+        finally:
+            self._replaying = False
+
+    async def _apply_journal_event(self, head: dict, payload: bytes) -> None:
+        from ceph_tpu.rbd import journal as J
+
+        ev = head["event"]
+        if ev == J.WRITE:
+            await self.write(head["off"], payload)
+        elif ev == J.RESIZE:
+            await self.resize(head["size"])
+        elif ev == J.SNAP_CREATE:
+            if head["name"] not in self.snaps:
+                await self.snap_create(head["name"])
+        elif ev == J.SNAP_REMOVE:
+            if head["name"] in self.snaps:
+                await self.snap_remove(head["name"])
 
     # -- basics --------------------------------------------------------
 
@@ -184,12 +268,24 @@ class Image:
         the next write."""
         if snap_name in self.snaps:
             raise RBDError(errno.EEXIST, f"snap {snap_name!r} exists")
+        if self.journal is not None and not self._replaying:
+            from ceph_tpu.rbd import journal as J
+
+            await self.journal.append(
+                J.SNAP_CREATE, {"name": snap_name})
         snapid = await self._io.selfmanaged_snap_create()
         self.snaps[snap_name] = {
             "id": snapid, "size": self._size, "protected": False,
         }
         self._apply_snapc()
         await self._save_header()
+        if self.objmap is not None:
+            # freeze the map under the snap's name, then downgrade the
+            # head's EXISTS to EXISTS_CLEAN: from now on EXISTS means
+            # 'dirtied since this snapshot' (fast-diff invariant)
+            await self.objmap.snapshot_copy(snapid).save()
+            self.objmap.freeze_clean()
+            await self.objmap.save()
         return snapid
 
     def snap_list(self) -> list[dict]:
@@ -231,12 +327,22 @@ class Image:
         info = self._snap(snap_name)
         if info.get("protected"):
             raise RBDError(errno.EBUSY, f"snap {snap_name!r} is protected")
+        if self.journal is not None and not self._replaying:
+            from ceph_tpu.rbd import journal as J
+
+            await self.journal.append(
+                J.SNAP_REMOVE, {"name": snap_name})
         if self._read_snap_name == snap_name:
             self._read_snap_name = None  # handle falls back to head
         del self.snaps[snap_name]
         self._apply_snapc()
         await self._save_header()
         await self._io.selfmanaged_snap_remove(info["id"])
+        if self.objmap is not None:
+            from ceph_tpu.rbd.objectmap import ObjectMap
+
+            await ObjectMap(
+                self.rbd.meta, self.name, 0, info["id"]).remove()
 
     async def snap_rollback(self, snap_name: str) -> None:
         """librbd snap_rollback: restore head data to the snapshot."""
@@ -270,6 +376,16 @@ class Image:
         ))
         self._size = info["size"]
         await self._save_header(size=str(self._size).encode())
+        if self.objmap is not None:
+            # head data now equals the snapshot: adopt its frozen map
+            from ceph_tpu.rbd.objectmap import ObjectMap
+
+            snap_map = await ObjectMap(
+                self.rbd.meta, self.name,
+                self._n_objs(info["size"]), snapid).load()
+            self.objmap._bits = bytearray(snap_map._bits)
+            self.objmap.n_objs = snap_map.n_objs
+            await self.objmap.save()
 
     # -- exclusive lock (cls_lock over the header) ---------------------
 
@@ -363,8 +479,23 @@ class Image:
     async def write(self, off: int, data: bytes) -> None:
         if self._read_snap_name is not None:
             raise RBDError(errno.EROFS, "image is set to a snapshot")
+        if not self.primary:
+            raise RBDError(errno.EROFS, "image is non-primary (demoted)")
         if off + len(data) > self._size:
             raise RBDError(errno.EINVAL, "write past image size")
+        seq = None
+        if self.journal is not None and not self._replaying:
+            from ceph_tpu.rbd import journal as J
+
+            # write-ahead: the event is durable before any data object
+            # changes (journal replay re-applies it after a crash)
+            seq = await self.journal.append(J.WRITE, {"off": off}, data)
+        if self.objmap is not None:
+            # mark EXISTS before the data lands: a crash leaves a
+            # false EXISTS (harmless), never a false NONEXISTENT
+            await self._objmap_mark(
+                [e[0] for e in self._extents(off, len(data))],
+                _OM.OBJECT_EXISTS)
         pos = 0
         writes = []
         for objno, obj_off, n in self._extents(off, len(data)):
@@ -372,6 +503,13 @@ class Image:
                 objno, obj_off, data[pos : pos + n]))
             pos += n
         await asyncio.gather(*writes)
+        if seq is not None:
+            await self.journal.commit(seq)
+
+    async def _objmap_mark(self, objnos, state: int) -> None:
+        changed = [self.objmap.set(o, state) for o in list(objnos)]
+        if any(changed):
+            await self.objmap.save()
 
     async def _write_one(self, objno: int, obj_off: int, chunk: bytes) -> None:
         if self.parent is not None:
@@ -397,6 +535,18 @@ class Image:
             return b""
 
         async def _one(objno: int, obj_off: int, n: int) -> bytes:
+            if (
+                read_snap is None and self.objmap is not None
+                and self.objmap.get(objno) == _OM.OBJECT_NONEXISTENT
+            ):
+                # object-map fast path: provably no data object — skip
+                # the OSD round trip, fall straight to parent/zeros
+                chunk = b""
+                if self.parent is not None:
+                    pdata = await self._parent_read(objno)
+                    if pdata is not None:
+                        chunk = pdata[obj_off : obj_off + n]
+                return chunk.ljust(n, b"\0")
             io = self._io
             if read_snap is not None:
                 io = self._io.dup()
@@ -422,6 +572,20 @@ class Image:
         return b"".join(parts)
 
     async def resize(self, new_size: int) -> None:
+        if self.journal is not None and not self._replaying:
+            from ceph_tpu.rbd import journal as J
+
+            seq = await self.journal.append(J.RESIZE, {"size": new_size})
+        else:
+            seq = None
+        await self._resize_inner(new_size)
+        if self.objmap is not None:
+            self.objmap.resize(self._n_objs(new_size))
+            await self.objmap.save()
+        if seq is not None:
+            await self.journal.commit(seq)
+
+    async def _resize_inner(self, new_size: int) -> None:
         if new_size < self._size:
             # drop whole objects past the end; trim the boundary object
             first_dead = (new_size + self.obj_size - 1) // self.obj_size
@@ -468,7 +632,48 @@ class Image:
             if e.errno != errno.ENOENT:
                 raise
 
+    # -- fast-diff / mirroring roles -----------------------------------
+
+    async def fast_diff(
+        self, from_snap: str | None = None,
+    ) -> list[tuple[int, int]]:
+        """librbd diff_iterate with whole-object=true over the object
+        maps (src/librbd/api/DiffIterate.cc fast-diff path): byte
+        extents that may differ from ``from_snap`` (None = allocated
+        extents), WITHOUT reading any data object."""
+        if self.objmap is None:
+            raise RBDError(errno.EOPNOTSUPP, "fast-diff requires object-map")
+        since = None
+        if from_snap is not None:
+            from ceph_tpu.rbd.objectmap import ObjectMap
+
+            info = self._snap(from_snap)
+            since = await ObjectMap(
+                self.rbd.meta, self.name,
+                self._n_objs(info["size"]), info["id"]).load()
+        out = []
+        for objno in self.objmap.diff(since):
+            base = objno * self.obj_size
+            out.append((base, min(self.obj_size, self._size - base)))
+        return out
+
+    async def demote(self) -> None:
+        """rbd mirror demote: this side stops accepting writes (the
+        peer may promote)."""
+        self.primary = False
+        await self.rbd.meta.omap_set(
+            f"rbd_header.{self.name}", {"primary": b"0"})
+
+    async def promote(self) -> None:
+        self.primary = True
+        await self.rbd.meta.omap_set(
+            f"rbd_header.{self.name}", {"primary": b"1"})
+
     async def remove_data(self) -> None:
+        if self.objmap is not None:
+            await self.objmap.remove()
+        if self.journal is not None:
+            await self.journal.destroy()
         n_objs = (self._size + self.obj_size - 1) // self.obj_size
         await asyncio.gather(*(
             self._remove_quiet(self._oid(i)) for i in range(n_objs)
