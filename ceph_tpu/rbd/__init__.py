@@ -209,28 +209,43 @@ class Image:
         events = await self.journal.events_after(pos)
         if not events:
             return
-        self._replaying = True
-        try:
-            for seq, head, payload in events:
-                await self._apply_journal_event(head, payload)
-                await self.journal.commit(seq)
-        finally:
-            self._replaying = False
+        for seq, head, payload in events:
+            await self._apply_journal_event(head, payload)
+            await self.journal.commit(seq)
 
     async def _apply_journal_event(self, head: dict, payload: bytes) -> None:
+        """Apply one journaled event to the data path — shared by
+        open-time crash replay and rbd-mirror replay (the single
+        dispatch over event types; keep it the only one).
+
+        Runs with the guards the PUBLIC ops enforce suspended: replay
+        must succeed on a demoted image (mirror failover with a
+        pending event would otherwise make the image unopenable), and
+        a WRITE journaled before a later-applied shrink may exceed the
+        current size — grow for the apply; the RESIZE event that
+        follows in the log restores the final geometry."""
         from ceph_tpu.rbd import journal as J
 
-        ev = head["event"]
-        if ev == J.WRITE:
-            await self.write(head["off"], payload)
-        elif ev == J.RESIZE:
-            await self.resize(head["size"])
-        elif ev == J.SNAP_CREATE:
-            if head["name"] not in self.snaps:
-                await self.snap_create(head["name"])
-        elif ev == J.SNAP_REMOVE:
-            if head["name"] in self.snaps:
-                await self.snap_remove(head["name"])
+        saved_primary, self.primary = self.primary, True
+        self._replaying = True
+        try:
+            ev = head["event"]
+            if ev == J.WRITE:
+                end = head["off"] + len(payload)
+                if end > self._size:
+                    await self.resize(end)
+                await self.write(head["off"], payload)
+            elif ev == J.RESIZE:
+                await self.resize(head["size"])
+            elif ev == J.SNAP_CREATE:
+                if head["name"] not in self.snaps:
+                    await self.snap_create(head["name"])
+            elif ev == J.SNAP_REMOVE:
+                if head["name"] in self.snaps:
+                    await self.snap_remove(head["name"])
+        finally:
+            self.primary = saved_primary
+            self._replaying = False
 
     # -- basics --------------------------------------------------------
 
@@ -640,21 +655,41 @@ class Image:
         """librbd diff_iterate with whole-object=true over the object
         maps (src/librbd/api/DiffIterate.cc fast-diff path): byte
         extents that may differ from ``from_snap`` (None = allocated
-        extents), WITHOUT reading any data object."""
+        extents), WITHOUT reading any data object.
+
+        EXISTS in a map means 'dirtied since the PREVIOUS snapshot',
+        so the endpoint maps alone can't see a write that landed
+        between two intermediate snapshots and was then frozen to
+        EXISTS_CLEAN — the union over every snapshot map taken after
+        ``from_snap``, plus head, can."""
         if self.objmap is None:
             raise RBDError(errno.EOPNOTSUPP, "fast-diff requires object-map")
-        since = None
-        if from_snap is not None:
+        if from_snap is None:
+            changed = set(self.objmap.diff(None))
+        else:
             from ceph_tpu.rbd.objectmap import ObjectMap
 
             info = self._snap(from_snap)
             since = await ObjectMap(
                 self.rbd.meta, self.name,
                 self._n_objs(info["size"]), info["id"]).load()
+            later = [
+                s for s in self.snaps.values() if s["id"] > info["id"]
+            ]
+            maps = [
+                await ObjectMap(
+                    self.rbd.meta, self.name,
+                    self._n_objs(s["size"]), s["id"]).load()
+                for s in sorted(later, key=lambda s: s["id"])
+            ] + [self.objmap]
+            changed = set()
+            for m in maps:
+                changed.update(m.diff(since))
         out = []
-        for objno in self.objmap.diff(since):
+        for objno in sorted(changed):
             base = objno * self.obj_size
-            out.append((base, min(self.obj_size, self._size - base)))
+            if base < self._size:
+                out.append((base, min(self.obj_size, self._size - base)))
         return out
 
     async def demote(self) -> None:
@@ -674,7 +709,6 @@ class Image:
             await self.objmap.remove()
         if self.journal is not None:
             await self.journal.destroy()
-        n_objs = (self._size + self.obj_size - 1) // self.obj_size
         await asyncio.gather(*(
-            self._remove_quiet(self._oid(i)) for i in range(n_objs)
+            self._remove_quiet(self._oid(i)) for i in range(self._n_objs())
         ))

@@ -23,7 +23,6 @@ import asyncio
 import errno
 
 from ceph_tpu.rbd import RBD, Image, RBDError
-from ceph_tpu.rbd import journal as J
 
 
 class MirrorDaemon:
@@ -78,56 +77,52 @@ class MirrorDaemon:
         cached = self._dst_imgs.get(name)
         if cached is not None:
             return cached
+        hdr_oid = f"rbd_header.{name}"
         try:
-            img = await self.dst.open(name)
+            img = await self.dst.open(name, replay=False)
+            hdr = await self.dst.meta.omap_get(hdr_oid)
+            complete = hdr.get("mirror_bootstrapped") == b"1"
         except RBDError as e:
             if e.errno != errno.ENOENT:
                 raise
-            # bootstrap: full image sync, then journal replay takes
-            # over.  The copy is non-primary from birth.  No journaling
-            # feature on the copy — its writes come only from replay.
+            # bootstrap: the copy is non-primary from birth and no
+            # journaling feature — its writes come only from replay
             await self.dst.create(
                 name, src_img.size(), order=src_img.order,
                 features=tuple(
                     f for f in src_img.features if f != "journaling"),
             )
             img = await self.dst.open(name)
+            complete = False
+        if not complete:
+            # (re)run the full object copy: a crash mid-bootstrap left
+            # a half-synced image that MUST NOT pass as replicated —
+            # the completion flag is written only after the last
+            # object lands (and the demote happens before any data, so
+            # no crash window leaves both sides primary)
             await img.demote()
             img.primary = True  # temporarily, for the initial copy
-            step = img.obj_size
-            for off in range(0, src_img.size(), step):
-                n = min(step, src_img.size() - off)
-                data = await src_img.read(off, n)
-                if data.strip(b"\0"):
-                    await img.write(off, data)
-            img.primary = False
+            try:
+                step = img.obj_size
+                for off in range(0, src_img.size(), step):
+                    n = min(step, src_img.size() - off)
+                    data = await src_img.read(off, n)
+                    if data.strip(b"\0"):
+                        await img.write(off, data)
+            finally:
+                img.primary = False
+            await self.dst.meta.omap_set(
+                hdr_oid, {"mirror_bootstrapped": b"1"})
             self.stats["images_bootstrapped"] += 1
         self._dst_imgs[name] = img
         return img
 
     async def _apply(self, dst_img: Image, head: dict, payload: bytes) -> None:
-        """Replay one source event onto the (non-primary) destination:
-        flip primary for the duration — replay is the ONE writer a
-        demoted image admits (the reference routes this through the
-        journal Replay handler under the exclusive lock)."""
-        dst_img.primary = True
-        try:
-            ev = head["event"]
-            if ev == J.WRITE:
-                end = head["off"] + len(payload)
-                if end > dst_img.size():
-                    await dst_img.resize(end)
-                await dst_img.write(head["off"], payload)
-            elif ev == J.RESIZE:
-                await dst_img.resize(head["size"])
-            elif ev == J.SNAP_CREATE:
-                if head["name"] not in dst_img.snaps:
-                    await dst_img.snap_create(head["name"])
-            elif ev == J.SNAP_REMOVE:
-                if head["name"] in dst_img.snaps:
-                    await dst_img.snap_remove(head["name"])
-        finally:
-            dst_img.primary = False
+        """Replay one source event onto the (non-primary) destination
+        through the SAME dispatcher open-time crash replay uses
+        (Image._apply_journal_event) — one switch over event types,
+        with the demoted-image and size guards suspended there."""
+        await dst_img._apply_journal_event(head, payload)
 
     # -- continuous mode ---------------------------------------------------
 

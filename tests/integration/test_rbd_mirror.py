@@ -191,3 +191,52 @@ class TestMirror:
                     await m.stop()
 
         run(go())
+
+
+class TestFastDiffIntervals:
+    def test_diff_sees_writes_between_intermediate_snapshots(self):
+        """A write landed between s1 and s2 (then frozen EXISTS_CLEAN
+        by s2) must still show in fast_diff('s1') — the union over
+        intermediate snapshot maps, not just the endpoints."""
+        async def go():
+            async with Cluster(n_osds=4) as c:
+                rbd, _ = await _two_pools(c)
+                await rbd.create(
+                    "iv", size=8 * MB, order=20,
+                    features=("object-map", "fast-diff"))
+                img = await rbd.open("iv")
+                await img.write(0, b"a" * MB)
+                await img.snap_create("s1")
+                await img.write(2 * MB, b"b" * MB)   # between s1 and s2
+                await img.snap_create("s2")          # freezes obj2 clean
+                await img.write(4 * MB, b"c" * MB)   # after s2
+                diff = await img.fast_diff("s1")
+                assert (2 * MB, MB) in diff, diff    # the frozen write
+                assert (4 * MB, MB) in diff, diff
+                assert (0, MB) not in diff, diff     # unchanged since s1
+                # diff from s2 must NOT include the s1..s2 write
+                diff2 = await img.fast_diff("s2")
+                assert (2 * MB, MB) not in diff2, diff2
+                assert (4 * MB, MB) in diff2, diff2
+
+        run(go())
+
+
+class TestReplayOnDemotedImage:
+    def test_crash_replay_succeeds_after_demote(self):
+        """A pending journal event + demote (mirror failover) must not
+        make the image unopenable — replay suspends the EROFS guard."""
+        async def go():
+            async with Cluster(n_osds=4) as c:
+                rbd, _ = await _two_pools(c)
+                await rbd.create(
+                    "dm", size=4 * MB, order=20, features=("journaling",))
+                img = await rbd.open("dm")
+                await img.demote()
+                jr = J.Journal(rbd.meta, "dm")
+                await jr.append(J.WRITE, {"off": 0}, b"pending")
+                img2 = await rbd.open("dm")   # replay despite demotion
+                assert not img2.primary       # role preserved
+                assert await img2.read(0, 7) == b"pending"
+
+        run(go())

@@ -1,0 +1,249 @@
+"""Independent-lineage GF(2^8) cross-check (VERDICT r3 weak #3).
+
+The EC known-answer corpus (tests/golden/ec_kats.json) freezes OUR
+bytes — a drift guard, not proof the arithmetic is right.  This module
+closes the loop the way the CRUSH oracle did for placement: every GF
+operation and every coding matrix is re-verified against a SECOND
+implementation of the field built here from first principles — the
+shift-and-XOR (Russian peasant) polynomial multiply over
+x^8+x^4+x^3+x^2+1, sharing no code, no tables and no construction with
+ceph_tpu.ops.gf256.  A table-generation or matmul bug in the library
+cannot also be present in a from-the-definition bitwise multiplier.
+
+Checks:
+  A. field core: mul (exhaustive), inv/div/pow (exhaustive), exp/log
+     tables re-derived independently, field axioms on random triples
+  B. plugin encodes byte-equal the independent matmul of their own
+     coding matrices (jax/isa/jerasure RS + Cauchy families)
+  C. MDS: every k x k submatrix of [I; C] invertible under the
+     independent arithmetic (exhaustive for the bench shapes)
+  D. decode round-trip solved by an independent Gaussian elimination
+     matches the plugin's own decode
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import pytest
+
+POLY = 0x11D
+
+
+# -- the independent field: bitwise, table-free, from the definition -------
+
+def pmul(a: int, b: int) -> int:
+    """Carry-less multiply mod the primitive polynomial — the field
+    DEFINITION, no lookup tables."""
+    r = 0
+    while b:
+        if b & 1:
+            r ^= a
+        a <<= 1
+        if a & 0x100:
+            a ^= POLY
+        b >>= 1
+    return r
+
+
+def ppow(a: int, n: int) -> int:
+    r = 1
+    while n:
+        if n & 1:
+            r = pmul(r, a)
+        a = pmul(a, a)
+        n >>= 1
+    return r
+
+
+def pinv(a: int) -> int:
+    assert a != 0
+    return ppow(a, 254)  # a^(2^8 - 2) by Fermat
+
+
+def pmatmul(A: np.ndarray, B: np.ndarray) -> np.ndarray:
+    out = np.zeros((A.shape[0], B.shape[1]), dtype=np.uint8)
+    for i in range(A.shape[0]):
+        for j in range(B.shape[1]):
+            acc = 0
+            for t in range(A.shape[1]):
+                acc ^= pmul(int(A[i, t]), int(B[t, j]))
+            out[i, j] = acc
+    return out
+
+
+def psolve(A: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Gaussian elimination over the independent field."""
+    n = A.shape[0]
+    M = [[int(x) for x in row] for row in A]
+    v = [row.copy() for row in b]
+    for col in range(n):
+        piv = next(r for r in range(col, n) if M[r][col])
+        M[col], M[piv] = M[piv], M[col]
+        v[col], v[piv] = v[piv], v[col]
+        inv = pinv(M[col][col])
+        M[col] = [pmul(inv, x) for x in M[col]]
+        v[col] = np.frombuffer(
+            bytes(pmul(inv, int(x)) for x in v[col]), np.uint8).copy()
+        for r in range(n):
+            if r != col and M[r][col]:
+                f = M[r][col]
+                M[r] = [x ^ pmul(f, y) for x, y in zip(M[r], M[col])]
+                v[r] = v[r] ^ np.frombuffer(
+                    bytes(pmul(f, int(y)) for y in v[col]), np.uint8)
+    return np.stack(v)
+
+
+# -- A: field core ---------------------------------------------------------
+
+class TestFieldCore:
+    def test_mul_exhaustive(self):
+        from ceph_tpu.ops.gf256 import gf_mul
+
+        a = np.repeat(np.arange(256, dtype=np.uint8), 256)
+        b = np.tile(np.arange(256, dtype=np.uint8), 256)
+        got = gf_mul(a, b)
+        want = np.fromiter(
+            (pmul(int(x), int(y)) for x, y in zip(a, b)),
+            np.uint8, count=a.size)
+        assert np.array_equal(got, want)
+
+    def test_inv_div_pow_exhaustive(self):
+        from ceph_tpu.ops.gf256 import gf_div, gf_inv, gf_pow
+
+        for x in range(1, 256):
+            assert int(gf_inv(x)) == pinv(x), x
+            assert pmul(pinv(x), x) == 1, x
+        a = np.arange(1, 256, dtype=np.uint8)
+        assert np.array_equal(
+            gf_div(np.uint8(1), a),
+            np.fromiter((pinv(int(x)) for x in a), np.uint8, 255))
+        for n in (0, 1, 2, 7, 254, 255):
+            got = gf_pow(np.uint8(2), n)
+            assert int(got) == ppow(2, n), n
+
+    def test_tables_rederived(self):
+        from ceph_tpu.ops.gf256 import gf_exp_table, gf_log_table
+
+        exp, log = gf_exp_table(), gf_log_table()
+        x = 1
+        for i in range(255):
+            assert int(exp[i]) == x, i
+            assert int(log[x]) == i, x
+            x = pmul(x, 2)
+        assert x == 1  # alpha = 2 generates the full 255-cycle
+
+    def test_field_axioms_random(self):
+        rng = np.random.default_rng(7)
+        for _ in range(200):
+            a, b, c = (int(v) for v in rng.integers(0, 256, 3))
+            assert pmul(a, pmul(b, c)) == pmul(pmul(a, b), c)
+            assert pmul(a, b ^ c) == pmul(a, b) ^ pmul(a, c)
+            assert pmul(a, b) == pmul(b, a)
+
+
+# -- B/C/D: plugin matrices and encodes ------------------------------------
+
+SHAPES = [(2, 2), (3, 2), (4, 2), (8, 3)]
+
+
+def _constructors():
+    from ceph_tpu.models.matrices import (
+        cauchy_good_matrix,
+        cauchy_original_matrix,
+        isa_cauchy_matrix,
+        isa_rs_vandermonde_matrix,
+        jerasure_rs_vandermonde_matrix,
+    )
+
+    return {
+        "isa_cauchy": isa_cauchy_matrix,
+        "isa_vand": isa_rs_vandermonde_matrix,
+        "jerasure_vand": jerasure_rs_vandermonde_matrix,
+        "cauchy_orig": cauchy_original_matrix,
+        "cauchy_good": cauchy_good_matrix,
+    }
+
+
+class TestMatricesMDS:
+    @pytest.mark.parametrize("k,m", SHAPES)
+    def test_every_submatrix_invertible(self, k, m):
+        for name, ctor in _constructors().items():
+            C = np.asarray(ctor(k, m), dtype=np.uint8)
+            assert C.shape == (m, k), name
+            G = np.vstack([np.eye(k, dtype=np.uint8), C])
+            for rows in itertools.combinations(range(k + m), k):
+                sub = G[list(rows)]
+                # invertible iff elimination finds a pivot per column
+                M = [[int(x) for x in r] for r in sub]
+                ok = True
+                for col in range(k):
+                    piv = next(
+                        (r for r in range(col, k) if M[r][col]), None)
+                    if piv is None:
+                        ok = False
+                        break
+                    M[col], M[piv] = M[piv], M[col]
+                    inv = pinv(M[col][col])
+                    M[col] = [pmul(inv, x) for x in M[col]]
+                    for r in range(k):
+                        if r != col and M[r][col]:
+                            f = M[r][col]
+                            M[r] = [
+                                x ^ pmul(f, y)
+                                for x, y in zip(M[r], M[col])
+                            ]
+                assert ok, (name, k, m, rows)
+
+
+class TestPluginEncodeEquivalence:
+    @pytest.mark.parametrize("profile", [
+        {"plugin": "jax", "k": "4", "m": "2"},
+        {"plugin": "jax", "k": "8", "m": "3"},
+        {"plugin": "isa", "k": "4", "m": "2",
+         "technique": "reed_sol_van"},
+        {"plugin": "isa", "k": "4", "m": "2", "technique": "cauchy"},
+        {"plugin": "jerasure", "k": "4", "m": "2",
+         "technique": "reed_sol_van"},
+    ])
+    def test_encode_is_independent_matmul(self, profile):
+        from ceph_tpu.ec import registry
+
+        ec = registry.factory(profile["plugin"], dict(profile))
+        k, m = int(profile["k"]), int(profile["m"])
+        cs = ec.get_chunk_size(k * 512)
+        rng = np.random.default_rng(hash(str(sorted(profile.items()))) % 2**32)
+        data = rng.integers(0, 256, (k, cs), dtype=np.uint8)
+        chunks = {i: data[i].tobytes() for i in range(k)}
+        encoded = ec.encode(set(range(k + m)), b"".join(chunks.values()))
+        C = np.asarray(ec.coding_matrix, dtype=np.uint8)
+        want = pmatmul(C, data)
+        for j in range(m):
+            got = np.frombuffer(encoded[k + j], np.uint8)
+            assert np.array_equal(got, want[j]), (profile, j)
+
+    def test_decode_matches_independent_solve(self):
+        from ceph_tpu.ec import registry
+
+        ec = registry.factory("jax", {"k": "3", "m": "2"})
+        k, m = 3, 2
+        cs = ec.get_chunk_size(k * 256)
+        rng = np.random.default_rng(5)
+        data = rng.integers(0, 256, (k, cs), dtype=np.uint8)
+        encoded = ec.encode(
+            set(range(k + m)), data.tobytes())
+        C = np.asarray(ec.coding_matrix, dtype=np.uint8)
+        G = np.vstack([np.eye(k, dtype=np.uint8), C])
+        # lose two data chunks; solve with the independent elimination
+        avail = [2, 3, 4]
+        A = G[avail]
+        b = np.stack([
+            np.frombuffer(encoded[i], np.uint8) for i in avail])
+        recovered = psolve(A, b)
+        assert np.array_equal(recovered, data)
+        # and the plugin's own decode agrees
+        dec = ec.decode(
+            {0, 1, 2}, {i: encoded[i] for i in avail}, cs)
+        for i in range(k):
+            assert np.asarray(dec[i]).tobytes() == data[i].tobytes()
