@@ -128,54 +128,30 @@ from ceph_tpu.store import MemStore, Transaction, coll_t, ghobject_t
 
 log = logging.getLogger("ceph_tpu.osd")
 
-NO_SHARD = -1
-STRIPE_UNIT = 4096  # logical bytes per data chunk per stripe
-SUBOP_TIMEOUT = 30.0
-
-SIZE_ATTR = "_size"
-HINFO_ATTR = "hinfo"
-VERSION_ATTR = "_v"  # object_info version (oi attr analogue)
-USER_XATTR_PREFIX = "u_"  # client xattrs, namespaced off internal attrs
-
-
-def _read_extents(store, c, o, extents) -> bytes:
-    """Serve a multi-run ranged read from ONE covering store read:
-    checksummed engines (BlockStore) verify each blob once instead of
-    once per run — CLAY sub-chunk repairs issue many runs per chunk."""
-    lo = min(eo for eo, _ln in extents)
-    hi = max(eo + ln for eo, ln in extents)
-    span = bytes(store.read(c, o, lo, hi - lo))
-    # per-run slices clamp at the object size exactly like the
-    # individual reads they replace (no padding)
-    return b"".join(span[eo - lo : eo - lo + ln] for eo, ln in extents)
-
-
-class ECFetchError(Exception):
-    """A version-consistent EC fetch could not complete."""
-
-    def __init__(self, eno: int):
-        super().__init__(errno.errorcode.get(eno, str(eno)))
-        self.errno = eno
+# shared constants/helpers moved to pgutil (re-exported here: external
+# users import object_to_pg/VERSION_ATTR/_v_parse from this module)
+from ceph_tpu.osd.pgutil import (  # noqa: E402,F401
+    ECConnErrors,
+    ECFetchError,
+    HINFO_ATTR,
+    NO_SHARD,
+    SIZE_ATTR,
+    STRIPE_UNIT,
+    SUBOP_TIMEOUT,
+    USER_XATTR_PREFIX,
+    VERSION_ATTR,
+    _read_extents,
+    _v_bytes,
+    _v_parse,
+    object_to_pg,
+)
+from ceph_tpu.osd.ec_backend import ECBackendMixin  # noqa: E402
+from ceph_tpu.osd.recovery import RecoveryMixin  # noqa: E402
+from ceph_tpu.osd.scrubber import ScrubMixin  # noqa: E402
+from ceph_tpu.osd.tiering import TieringMixin  # noqa: E402
 
 
-def _v_bytes(v: eversion_t) -> bytes:
-    return v.key().encode()
-
-
-def _v_parse(raw: bytes | None) -> eversion_t:
-    if not raw:
-        return ZERO
-    e, v = raw.decode().split(".")
-    return eversion_t(int(e), int(v))
-
-
-def object_to_pg(pool: PgPool, oid: str) -> pg_t:
-    """object_locator_to_pg (src/osd/osd_types.cc): name hash -> raw pg
-    (the mapping pipeline folds it into pg_num)."""
-    return pg_t(pool.id, int(ceph_str_hash_rjenkins(oid)))
-
-
-class OSDDaemon:
+class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
     def __init__(
         self,
         osd_id: int,
@@ -1345,1320 +1321,6 @@ class OSDDaemon:
             )
         return await self._rep_read_vector(pool, pg, acting, msg)
 
-    # -- cache tiering (PrimaryLogPG HitSet/TierAgent, src/osd/HitSet.h)
-
-    def _hitset(self, pool_id: int) -> "OrderedDict":
-        from collections import OrderedDict as _OD
-
-        hs = getattr(self, "_hitsets", None)
-        if hs is None:
-            hs = self._hitsets = {}
-        if pool_id not in hs:
-            hs[pool_id] = _OD()
-        return hs[pool_id]
-
-    def _hitset_touch(self, pool_id: int, oid: str) -> None:
-        """Approximate recency (the reference's HitSet stack reduced to
-        one explicit-object window, src/osd/HitSet.h ExplicitHashHitSet):
-        most-recent at the end, bounded."""
-        hs = self._hitset(pool_id)
-        hs[oid] = time.monotonic()
-        hs.move_to_end(oid)
-        while len(hs) > 4096:
-            hs.popitem(last=False)
-
-    async def _pool_op(self, pool_id: int, oid: str, ops: list) -> "MOSDOpReply":
-        """The daemon as a CLIENT of another pool (the tiering
-        flush/promote I/O, PrimaryLogPG::start_copy using the
-        objecter).  Minimal resend-on-EAGAIN."""
-        import errno as _errno
-
-        for _try in range(8):
-            om = self.osdmap
-            pool = om.get_pg_pool(pool_id)
-            if pool is None:
-                return MOSDOpReply(result=-_errno.ENOENT, epoch=self.epoch)
-            pg = object_to_pg(pool, oid)
-            _, primary = self._acting(pool, pg)
-            addr = om.osd_addrs.get(primary)
-            if primary < 0 or addr is None:
-                await asyncio.sleep(0.2)
-                continue
-            tid = next(self._tids)
-            m = MOSDOp(pool=pool_id, oid=oid, ops=list(ops), tid=tid,
-                       epoch=om.epoch)
-            if m.is_write():
-                m.reqid = f"osd.{self.id}:{tid}"
-            fut: asyncio.Future = asyncio.get_running_loop().create_future()
-            self._waiters[tid] = fut
-            try:
-                conn = await self.messenger.connect_to(
-                    ("osd", primary), *addr)
-                await conn.send_message(m)
-                reply = await asyncio.wait_for(fut, 30.0)
-            except (ConnectionError, OSError, asyncio.TimeoutError):
-                await asyncio.sleep(0.2)
-                continue
-            finally:
-                self._waiters.pop(tid, None)
-            if reply.result == -_errno.EAGAIN:
-                await asyncio.sleep(0.1 * (_try + 1))
-                continue
-            return reply
-        return MOSDOpReply(result=-_errno.ETIMEDOUT, epoch=self.epoch)
-
-    async def _tier_internal_op(
-        self, pool, oid: str, ops: list, *, have_lock: bool = False,
-    ) -> int:
-        """Run a replicated write vector on OUR pool as an internal op
-        (agent flush/evict, promote): full primary pipeline, replicas
-        included, marked so the tier hook doesn't recurse.
-        ``have_lock``: the caller already holds the object lock."""
-        m = MOSDOp(pool=pool.id, oid=oid, ops=list(ops),
-                   tid=next(self._tids), epoch=self.epoch)
-        m._tier_internal = True
-        m._have_obj_lock = have_lock
-        m.reqid = f"osd.{self.id}:{m.tid}"
-        reply = await self._execute_op(m)
-        return reply.result
-
-    async def _tier_prepare(self, pool, pg, msg) -> "MOSDOpReply | None":
-        """The cache-pool op admission (PrimaryLogPG::maybe_handle_cache
-        + do_cache_redirect/promote_object, writeback mode):
-
-        - CACHE_FLUSH / CACHE_EVICT / COPY_FROM vectors are handled
-          here entirely;
-        - an op whose object misses the cache promotes it from the
-          base pool first (whole-object, data only — documented lite
-          scope vs the reference's omap/xattr copy);
-        - deletes propagate to the base synchronously (the reference
-          whiteouts + flushes; same visible result);
-        - writes mark the object dirty (xattr), reads/writes record
-          hits.  Returns a reply to short-circuit, or None to continue
-          with the (possibly rewritten) vector."""
-        import errno as _errno
-
-        from ceph_tpu.msg.messages import (
-            OP_CACHE_EVICT,
-            OP_CACHE_FLUSH,
-            OP_COPY_FROM,
-            OSDOp,
-        )
-
-        base_pid = int(pool.extra["tier_of"])
-        c = self._shard_coll(pool, pg, NO_SHARD)
-        o = ghobject_t(msg.oid)
-        present = self.store.exists(c, o) and not self._is_whiteout(c, o)
-
-        kinds = {op.op for op in msg.ops}
-        if OP_CACHE_FLUSH in kinds:
-            if not present:
-                return MOSDOpReply(tid=msg.tid, result=-_errno.ENOENT,
-                                   epoch=self.epoch)
-            rc = await self._tier_flush(pool, base_pid, c, o, msg.oid,
-                                        have_lock=True)
-            return MOSDOpReply(tid=msg.tid, result=rc, epoch=self.epoch)
-        if OP_CACHE_EVICT in kinds:
-            if not present:
-                return MOSDOpReply(tid=msg.tid, result=-_errno.ENOENT,
-                                   epoch=self.epoch)
-            if self._tier_dirty(c, o):
-                return MOSDOpReply(tid=msg.tid, result=-_errno.EBUSY,
-                                   epoch=self.epoch)
-            rc = await self._tier_internal_op(
-                pool, msg.oid, [OSDOp(OP_DELETE)], have_lock=True)
-            self._hitset(pool.id).pop(msg.oid, None)
-            self.perf.inc("tier_evict")
-            return MOSDOpReply(tid=msg.tid, result=rc, epoch=self.epoch)
-        if OP_COPY_FROM in kinds:
-            op = next(op for op in msg.ops if op.op == OP_COPY_FROM)
-            spool, _, soid = (op.name or "").partition(":")
-            reply = await self._pool_op(
-                int(spool), soid, [OSDOp(OP_READ)])
-            if reply.result != 0:
-                return MOSDOpReply(tid=msg.tid, result=reply.result,
-                                   epoch=self.epoch)
-            # the copy is DIRTY (writeback: it exists only here until
-            # flushed — an unflushed-evictable copy would be lost)
-            msg.ops = [
-                OSDOp(OP_WRITE_FULL, data=reply.data),
-                OSDOp(OP_SETXATTR, name="cache.dirty", data=b"1"),
-            ]
-            return None  # continue as a normal replicated write
-
-        self._hitset_touch(pool.id, msg.oid)
-        if present:
-            self.perf.inc("tier_hit")
-        else:
-            self.perf.inc("tier_miss")
-            # promote-on-miss (reads AND writes: writeback promotes
-            # before mutating, PrimaryLogPG::promote_object)
-            reply = await self._pool_op(base_pid, msg.oid, [OSDOp(OP_READ)])
-            if reply.result == 0:
-                rc = await self._tier_internal_op(pool, msg.oid, [
-                    OSDOp(OP_WRITE_FULL, data=reply.data),
-                ], have_lock=True)
-                if rc != 0:
-                    return MOSDOpReply(tid=msg.tid, result=rc,
-                                       epoch=self.epoch)
-                self.perf.inc("tier_promote")
-            elif reply.result != -_errno.ENOENT:
-                return MOSDOpReply(tid=msg.tid, result=reply.result,
-                                   epoch=self.epoch)
-
-        if msg.is_write():
-            if any(op.op == OP_DELETE for op in msg.ops):
-                # propagate the delete to the base FIRST (lite
-                # stand-in for whiteout + flush): if the base refuses,
-                # the op fails — a cache-only delete would resurrect
-                # on the next promote
-                reply = await self._pool_op(
-                    base_pid, msg.oid, [OSDOp(OP_DELETE)])
-                if reply.result not in (0, -_errno.ENOENT):
-                    return MOSDOpReply(tid=msg.tid, result=reply.result,
-                                       epoch=self.epoch)
-            else:
-                msg.ops = list(msg.ops) + [
-                    OSDOp(OP_SETXATTR, name="cache.dirty", data=b"1")]
-        return None
-
-    def _tier_dirty(self, c: coll_t, o: ghobject_t) -> bool:
-        try:
-            return self.store.getattr(c, o, "u_cache.dirty") == b"1"
-        except (KeyError, FileNotFoundError, OSError):
-            return False
-
-    async def _tier_flush(self, pool, base_pid: int, c, o, oid: str,
-                          *, have_lock: bool = False) -> int:
-        """Write a dirty cache object back to the base pool, then mark
-        it clean (CEPH_OSD_OP_CACHE_FLUSH, PrimaryLogPG::start_flush)."""
-        from ceph_tpu.msg.messages import OP_RMXATTR, OSDOp
-
-        try:
-            data = self.store.read(c, o)
-        except (FileNotFoundError, OSError):
-            return -errno.ENOENT
-        if self._tier_dirty(c, o):
-            reply = await self._pool_op(
-                base_pid, oid, [OSDOp(OP_WRITE_FULL, data=bytes(data))])
-            if reply.result != 0:
-                return reply.result
-            rc = await self._tier_internal_op(
-                pool, oid, [OSDOp(OP_RMXATTR, name="cache.dirty")],
-                have_lock=have_lock)
-            if rc != 0:
-                return rc
-        self.perf.inc("tier_flush")
-        return 0
-
-    async def _tier_agent(self) -> None:
-        """The TierAgent loop (PrimaryLogPG::agent_work): under
-        target_max_bytes pressure, flush dirty objects then evict cold
-        clean ones, per cache pool, for the PGs this OSD leads."""
-        interval = self.conf["osd_tier_agent_interval"]
-        while not self.stopping:
-            await asyncio.sleep(interval)
-            om = self.osdmap
-            if om is None:
-                continue
-            for pool in list(om.pools.values()):
-                try:
-                    target = int(pool.extra.get("target_max_bytes", "0"))
-                except (TypeError, ValueError):
-                    continue
-                if (
-                    not target
-                    or not pool.extra.get("tier_of")
-                    or pool.extra.get("cache_mode") != "writeback"
-                ):
-                    continue
-                try:
-                    await self._tier_agent_pool(pool, target)
-                except Exception:
-                    log.exception("osd.%d: tier agent failed", self.id)
-
-    async def _tier_agent_pool(self, pool, target: int) -> None:
-        from ceph_tpu.msg.messages import OSDOp
-
-        base_pid = int(pool.extra["tier_of"])
-        mine: list[tuple[str, int, coll_t, ghobject_t]] = []
-        total = 0
-        for ps in range(pool.pg_num):
-            pg = pg_t(pool.id, ps)
-            _a, primary = self._acting(pool, pg)
-            if primary != self.id:
-                continue
-            c = coll_t(pool.id, ps, NO_SHARD)
-            if not self.store.collection_exists(c):
-                continue
-            for o in self.store.collection_list(c):
-                if o.name == PGMETA_OID or o.snap >= 0:
-                    continue
-                if self._is_whiteout(c, o):
-                    continue
-                try:
-                    size = self.store.stat(c, o)
-                except (FileNotFoundError, OSError):
-                    continue
-                mine.append((o.name, size, c, o))
-                total += size
-        if total <= target:
-            return
-        # coldest first: hitset order is recency (absent = coldest)
-        hs = self._hitset(pool.id)
-        rank = {oid: i for i, oid in enumerate(hs)}
-        mine.sort(key=lambda t: rank.get(t[0], -1))
-        for oid, size, c, o in mine:
-            if total <= target * 0.8:
-                break
-            # flush-then-evict is ATOMIC vs client ops on this object:
-            # the object lock spans both, so a write can't land between
-            # the flush and the delete and be silently dropped
-            async with self._obj_lock(pool.id, oid):
-                if self._tier_dirty(c, o):
-                    if await self._tier_flush(pool, base_pid, c, o, oid,
-                                              have_lock=True) != 0:
-                        continue
-                if await self._tier_internal_op(
-                        pool, oid, [OSDOp(OP_DELETE)],
-                        have_lock=True) == 0:
-                    self.perf.inc("tier_evict")
-                    hs.pop(oid, None)
-                    total -= size
-
-    # -- EC backend ----------------------------------------------------
-
-    def _shard_coll(self, pool: PgPool, pg: pg_t, shard: int) -> coll_t:
-        return coll_t(pool.id, pool.raw_pg_to_pg(pg).ps, shard)
-
-    def _ensure_coll(self, t: Transaction, c: coll_t) -> None:
-        if not self.store.collection_exists(c):
-            t.create_collection(c)
-
-    def _ec_live(self, pool, acting) -> tuple[list, int | None] | None:
-        """(live shard pairs, my_shard) or None when the op must bounce."""
-        live = [
-            (shard, osd)
-            for shard, osd in enumerate(acting)
-            if osd != CRUSH_ITEM_NONE
-        ]
-        if len(live) < pool.min_size:
-            return None
-        my_shard = next((s for s, o in live if o == self.id), None)
-        if my_shard is None:
-            # a primary that holds no shard of the live set would mint
-            # versions from a PG log it never writes, defeating the
-            # stale-shard guards — bounce the op instead
-            return None
-        return live, my_shard
-
-    async def _ec_fan_out_write(
-        self, pool, pg, live, oid, shard_payloads, attrs, version,
-        *, off: int = 0, truncate: int = -1, rmattrs: list[str] | None = None,
-        reqid: str = "", prev_version=None, _retried: bool = False,
-        clone_snap: int = 0, clone_snaps: bytes = b"",
-    ) -> int:
-        """Fan one versioned shard write out to the live set; returns 0
-        or the first failing shard's errno (the ECBackend ECSubWrite
-        fan-out, src/osd/ECBackend.cc:943).
-
-        ``prev_version`` (None = unguarded) is the base version this
-        write was computed against: every shard must be AT that version
-        or the write is refused with ESTALE — a shard that missed
-        earlier writes is reconciled (recovery roll-forward) and the
-        fan-out retried once, mirroring the reference's write-blocks-on-
-        missing-object rule (PrimaryLogPG::is_missing_object wait)."""
-        from ceph_tpu.common.fault_injector import FAULTS
-
-        await FAULTS.check("osd.ec_fan_out")
-        guarded = prev_version is not None
-        parent_sp = self._op_span.get()
-        waits = []
-        local: list[tuple[int, bytes]] = []
-        estale = False
-        for shard, osd in live:
-            payload = shard_payloads.get(shard, b"")
-            if not isinstance(payload, bytes):
-                payload = payload.tobytes()
-            if osd == self.id:
-                c = self._shard_coll(pool, pg, shard)
-                o = ghobject_t(oid, shard=shard)
-                if guarded and self._object_version(c, o) != prev_version:
-                    estale = True
-                    continue
-                local.append((shard, payload))
-            else:
-                tid = next(self._tids)
-                waits.append(self._traced_sub_op(
-                    "ec_sub_write", parent_sp, shard, osd, reqid,
-                    self._sub_op(osd, MOSDECSubOpWrite(
-                        tid=tid, pg=pg, shard=shard, from_osd=self.id,
-                        oid=oid, off=off, data=payload, attrs=attrs,
-                        epoch=self.epoch, truncate=truncate,
-                        version=version,
-                        rmattrs=rmattrs or [], reqid=reqid,
-                        prev_version=prev_version, guarded=guarded,
-                        clone_snap=clone_snap, clone_snaps=clone_snaps,
-                    ), tid)))
-        first_err = 0
-        if waits:
-            for rep in await asyncio.gather(*waits):
-                if rep.result == -errno.ESTALE:
-                    estale = True
-                elif rep.result != 0 and first_err == 0:
-                    first_err = rep.result
-        if first_err:
-            return first_err
-        if not estale:
-            # the primary's OWN shard applies only after every remote
-            # accepted: a demoted primary whose fan-out the cluster
-            # rejects must not poison its local shard with a write
-            # nobody else has (that one divergent shard would cost the
-            # pg its availability margin)
-            for shard, payload in local:
-                await self._apply_shard_write_async(
-                    pool, pg, shard, oid, payload, attrs, version=version,
-                    off=off, truncate=truncate, rmattrs=rmattrs,
-                    reqid=reqid, clone_snap=clone_snap,
-                    clone_snaps=clone_snaps,
-                )
-        if estale:
-            if _retried:
-                return -errno.EAGAIN
-            # roll the lagging shard(s) forward, then retry once; if the
-            # object state moved past our base meanwhile, the client
-            # must redo the RMW from the new base
-            pairs = [(s, o) for s, o in live]
-            try:
-                await self._reconcile_object(
-                    pool, pg, pairs, oid, have_lock=True)
-            except Exception:
-                log.exception(
-                    "osd.%d: pre-write reconcile of %s failed", self.id, oid)
-                return -errno.EAGAIN
-            acting_like = [CRUSH_ITEM_NONE] * pool.size
-            for s, o in live:
-                acting_like[s] = o
-            served = await self._ec_served_version(
-                pool, pg, acting_like, oid)
-            if served != prev_version:
-                return -errno.EAGAIN
-            return await self._ec_fan_out_write(
-                pool, pg, live, oid, shard_payloads, attrs, version,
-                off=off, truncate=truncate, rmattrs=rmattrs, reqid=reqid,
-                prev_version=prev_version, _retried=True,
-                clone_snap=clone_snap, clone_snaps=clone_snaps,
-            )
-        return 0
-
-    async def _ec_write_vector(
-        self, pool, pg, acting, msg, ec, sinfo, admit_epoch: int | None = None
-    ) -> MOSDOpReply:
-        """EC write-class op vector: full writes encode directly; partial
-        writes (write/append/zero/truncate) run the read-modify-write
-        pipeline over the dirty stripe range — the ECCommon RMW pipeline
-        (reference src/osd/ECCommon.cc:623-707 start_rmw/try_state_to_reads
-        + ExtentCache) re-designed as a single batched read → mutate →
-        re-encode → fan-out pass."""
-        ops = msg.ops
-        snapc = self._effective_snapc(pool, msg)
-        if snapc.snaps and not snapc.valid():
-            return MOSDOpReply(tid=msg.tid, result=-errno.EINVAL, epoch=self.epoch)
-        if any(o.op == OP_DELETE for o in ops):
-            if len(ops) != 1:
-                return MOSDOpReply(tid=msg.tid, result=-errno.EINVAL, epoch=self.epoch)
-            return await self._ec_delete(
-                pool, pg, acting, msg, snapc, admit_epoch)
-        lv = self._ec_live(pool, acting)
-        if lv is None:
-            return MOSDOpReply(tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
-        live, my_shard = lv
-        # duplicate-op detection: a resend of an already-applied
-        # non-idempotent vector is answered, not re-applied (reference:
-        # pg-log reqid dup lookup in PrimaryLogPG::do_op)
-        lg = self._pg_log(self._shard_coll(pool, pg, my_shard))
-        if msg.reqid and msg.reqid in lg.reqids:
-            # the log claims this op already applied — but a fan-out
-            # that died mid-write may have reached fewer than k shards
-            # (the retry exists BECAUSE something failed).  Verify the
-            # logged version is actually served before vouching for it;
-            # if not, reconcile (roll forward if >= k shards carry it,
-            # else divergent-rollback) and re-apply when rolled back.
-            logged_v = lg.reqids[msg.reqid]
-            served = await self._ec_served_version(
-                pool, pg, acting, msg.oid, lg)
-            if served is not None and served >= logged_v:
-                return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
-            pairs = self._pg_members(pool, acting)
-            try:
-                await self._reconcile_object(
-                    pool, pg, pairs, msg.oid, have_lock=True)
-            except Exception:
-                log.exception(
-                    "osd.%d: dup-retry reconcile of %s failed", self.id,
-                    msg.oid)
-            served = await self._ec_served_version(
-                pool, pg, acting, msg.oid, lg)
-            if served is not None and served >= logged_v:
-                return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
-            if msg.reqid in lg.reqids:
-                # reconcile did not strip it (e.g. zombie entry adopted
-                # from a peer log): drop it here so the op re-applies
-                t0 = Transaction()
-                self._ensure_coll(t0, self._shard_coll(pool, pg, my_shard))
-                lg.rollback_divergent(t0, msg.oid, served or ZERO)
-                if t0.ops:
-                    if getattr(self.store, "blocking_commit", False):
-                        await asyncio.to_thread(
-                            self.store.queue_transaction, t0)
-                    else:
-                        self.store.queue_transaction(t0)
-            # fall through: apply the vector afresh
-        for o in ops:
-            if o.op in (OP_OMAP_SETKEYS, OP_OMAP_RMKEYS, OP_OMAP_CLEAR):
-                # EC pools have no omap (reference restriction:
-                # pool_requires_alignment / MODE_EC forbids omap ops)
-                return MOSDOpReply(tid=msg.tid, result=-errno.EOPNOTSUPP, epoch=self.epoch)
-
-        # -- current object state (skipped for a leading WRITE_FULL
-        # when no snapshots are in play) ----
-        exists, cur_size = False, 0
-        cur_v = ZERO  # stale-shard write guard base (see _ec_fan_out_write)
-        ss = SnapSet()
-        local_ss_raw = self._getattr_quiet(
-            self._shard_coll(pool, pg, my_shard),
-            ghobject_t(msg.oid, shard=my_shard), SS_ATTR)
-        if ops[0].op != OP_WRITE_FULL or snapc.snaps or local_ss_raw:
-            try:
-                exists, _wo, cur_size, cur_v, ss, _attrs = \
-                    await self._ec_head_state(pool, pg, acting, msg.oid)
-            except ECFetchError as e:
-                return MOSDOpReply(
-                    tid=msg.tid, result=-e.errno, epoch=self.epoch)
-        else:
-            # whole-object replace: the primary's own shard version is
-            # the guard base; a mismatch on any shard reconciles first
-            cur_v = self._object_version(
-                self._shard_coll(pool, pg, my_shard),
-                ghobject_t(msg.oid, shard=my_shard))
-
-        # make_writeable: clone-on-write under a newer SnapContext
-        clone_snap_arg, clone_snaps_arg = 0, b""
-        if exists and ss.needs_cow(snapc):
-            cl = ss.make_clone(snapc, cur_size)
-            clone_snap_arg = cl.id
-            clone_snaps_arg = encode_snaps(cl.snaps)
-        else:
-            ss.advance_seq(snapc)
-
-        # -- fold the vector into (full | edits) + size + attr deltas ---
-        full: np.ndarray | None = None
-        edits: list[tuple] = []   # (off, np.ndarray) | ("zfill", off)
-        size = cur_size
-        attr_sets: dict[str, bytes] = {}
-        attr_rms: list[str] = []
-        touched = False
-        for o in ops:
-            if o.op == OP_CREATE:
-                if o.off and exists:  # off=1 -> exclusive
-                    return MOSDOpReply(tid=msg.tid, result=-errno.EEXIST, epoch=self.epoch)
-                touched = True
-            elif o.op == OP_WRITE_FULL:
-                full = np.frombuffer(o.data, np.uint8)
-                edits, size = [], len(o.data)
-                touched = exists = True
-            elif o.op == OP_WRITE:
-                edits.append((o.off, np.frombuffer(o.data, np.uint8)))
-                size = max(size, o.off + len(o.data))
-                touched = exists = True
-            elif o.op == OP_APPEND:
-                edits.append((size, np.frombuffer(o.data, np.uint8)))
-                size += len(o.data)
-                touched = exists = True
-            elif o.op == OP_ZERO:
-                end = min(size, o.off + o.length)
-                if o.off < end:
-                    edits.append((o.off, np.zeros(end - o.off, np.uint8)))
-                touched = exists = True
-            elif o.op == OP_TRUNCATE:
-                if o.off < size:
-                    # bytes past the cut must read as zero if the object
-                    # regrows later in this vector
-                    edits.append(("zfill", o.off))
-                size = o.off
-                touched = exists = True
-            elif o.op == OP_SETXATTR:
-                attr_sets[USER_XATTR_PREFIX + o.name] = bytes(o.data)
-            elif o.op == OP_RMXATTR:
-                attr_rms.append(USER_XATTR_PREFIX + o.name)
-            elif o.op == OP_ROLLBACK:
-                # restore head from the clone serving o.off
-                # (PrimaryLogPG::_rollback_to, EC flavor)
-                target = ss.resolve(o.off)
-                if target is None or (target == NOSNAP and not exists):
-                    return MOSDOpReply(
-                        tid=msg.tid, result=-errno.ENOENT,
-                        epoch=self.epoch)
-                if target == NOSNAP:
-                    continue  # head already serves that snap
-                try:
-                    csz, cattrs, cchunks = await self._ec_fetch(
-                        pool, pg, acting, msg.oid, ec, snap=target)
-                except ECFetchError as e:
-                    return MOSDOpReply(
-                        tid=msg.tid, result=-e.errno, epoch=self.epoch)
-                logical = await self._ecu_decode_concat(sinfo, ec, cchunks)
-                full = np.asarray(logical[:csz], np.uint8)
-                edits, size = [], csz
-                for name, v in (cattrs or {}).items():
-                    if name.startswith(USER_XATTR_PREFIX):
-                        attr_sets[name] = v
-                touched = exists = True
-            else:
-                return MOSDOpReply(tid=msg.tid, result=-errno.EOPNOTSUPP, epoch=self.epoch)
-
-        version = self._next_version(
-            self._shard_coll(pool, pg, my_shard), admit_epoch)
-        if version is None:
-            return MOSDOpReply(
-                tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
-        base_attrs = {
-            SIZE_ATTR: str(size).encode(),
-            VERSION_ATTR: _v_bytes(version),
-            **attr_sets,
-        }
-        if ss.seq or ss.clones:
-            base_attrs[SS_ATTR] = ss.to_bytes()
-        base_attrs[WHITEOUT_ATTR] = b"0"
-
-        # -- xattr-only vector: metadata write, no data churn -----------
-        if not touched and full is None and not edits:
-            if not exists:
-                base_attrs[SIZE_ATTR] = b"0"
-            r = await self._ec_fan_out_write(
-                pool, pg, live, msg.oid, {}, base_attrs, version,
-                rmattrs=attr_rms, reqid=msg.reqid, prev_version=cur_v,
-                clone_snap=clone_snap_arg, clone_snaps=clone_snaps_arg,
-            )
-            return MOSDOpReply(tid=msg.tid, result=r, epoch=self.epoch)
-
-        cs, sw = sinfo.chunk_size, sinfo.stripe_width
-        new_shard_len = sinfo.logical_to_next_chunk_offset(size)
-
-        if full is not None:
-            # whole-object replace: no read needed; edits (if any) land
-            # on the known content
-            padded = np.zeros(sinfo.logical_to_next_stripe_offset(size), np.uint8)
-            padded[: len(full)] = full
-            for e in edits:
-                if e[0] == "zfill":
-                    padded[e[1]:] = 0
-                else:
-                    off, buf = e
-                    padded[off : off + len(buf)] = buf
-            if len(padded):
-                shards = await self._ecu_encode(sinfo, ec, padded)
-            else:
-                shards = {s: np.zeros(0, np.uint8) for s in range(ec.get_chunk_count())}
-            hinfo = ecutil.HashInfo(ec.get_chunk_count())
-            hinfo.append(0, shards)
-            base_attrs[HINFO_ATTR] = hinfo.to_bytes()
-            r = await self._ec_fan_out_write(
-                pool, pg, live, msg.oid, shards, base_attrs, version,
-                off=0, truncate=new_shard_len, rmattrs=attr_rms,
-                reqid=msg.reqid, prev_version=cur_v,
-                clone_snap=clone_snap_arg, clone_snaps=clone_snaps_arg,
-            )
-            if r == 0:
-                self._extent_cache_put(pool.id, msg.oid, version, 0, padded)
-            else:
-                self._extent_cache_drop(pool.id, msg.oid)
-            return MOSDOpReply(tid=msg.tid, result=r, epoch=self.epoch)
-
-        # -- RMW over the dirty stripe range ----------------------------
-        real_edits: list[tuple[int, np.ndarray]] = []
-        for e in edits:
-            if e[0] == "zfill":
-                # zero through the stripe boundary, not just to the
-                # final size: a truncate-down must scrub the stale tail
-                # of its last stripe, or a later extension (which relies
-                # on the "bytes past size are zero" invariant) would
-                # resurrect old bytes
-                hi = max(size, sinfo.logical_to_next_stripe_offset(e[1]))
-                if e[1] < hi:
-                    real_edits.append((e[1], np.zeros(hi - e[1], np.uint8)))
-            else:
-                real_edits.append(e)
-        # truncate/create never dirty stripes by themselves: shard-level
-        # truncate keeps whole stripes, and store gap/extend writes
-        # zero-fill — the parity of all-zero data is all zeros, so holes
-        # stay consistent without re-encoding
-        dirty = [
-            (sinfo.logical_to_prev_stripe_offset(off),
-             sinfo.logical_to_next_stripe_offset(off + len(buf)))
-            for off, buf in real_edits if len(buf)
-        ]
-        if not dirty:
-            # pure truncate / create / zero-beyond-end
-            r = await self._ec_fan_out_write(
-                pool, pg, live, msg.oid, {}, base_attrs, version,
-                truncate=new_shard_len,
-                rmattrs=attr_rms + (
-                    [HINFO_ATTR] if exists and size != cur_size else []
-                ),
-                reqid=msg.reqid, prev_version=cur_v,
-                clone_snap=clone_snap_arg, clone_snaps=clone_snaps_arg,
-            )
-            return MOSDOpReply(tid=msg.tid, result=r, epoch=self.epoch)
-        d_lo = min(d[0] for d in dirty)
-        d_hi = max(d[1] for d in dirty)
-        old_end = sinfo.logical_to_next_stripe_offset(cur_size) if exists else 0
-        buf = np.zeros(d_hi - d_lo, np.uint8)
-        read_hi = min(d_hi, old_end)
-        if exists and d_lo < read_hi:
-            cached = self._extent_cache_get(
-                pool.id, msg.oid, cur_v, d_lo, read_hi)
-            if cached is not None:
-                # hot stripe: the bytes we last wrote at cur_v ARE the
-                # on-disk content — skip the shard read entirely
-                buf[: read_hi - d_lo] = cached
-            else:
-                c_lo = sinfo.logical_to_prev_chunk_offset(d_lo)
-                c_len = sinfo.logical_to_prev_chunk_offset(read_hi) - c_lo
-                try:
-                    _sz, _a, chunks = await self._ec_fetch(
-                        pool, pg, acting, msg.oid, ec,
-                        chunk_off=c_lo, chunk_len=c_len,
-                        fast_read=pool.fast_read,
-                    )
-                except ECFetchError as e:
-                    return MOSDOpReply(tid=msg.tid, result=-e.errno, epoch=self.epoch)
-                old_logical = await self._ecu_decode_concat(sinfo, ec, chunks)
-                buf[: len(old_logical)] = old_logical
-        for off, data in real_edits:
-            lo = max(off, d_lo)
-            hi = min(off + len(data), d_hi)
-            if lo < hi:
-                buf[lo - d_lo : hi - d_lo] = data[lo - off : hi - off]
-        shards = await self._ecu_encode(sinfo, ec, buf)
-        # the cumulative-append crc chain cannot survive an overwrite;
-        # deep scrub falls back to the parity-equation check (the
-        # reference's ec_overwrites pools drop hinfo the same way)
-        r = await self._ec_fan_out_write(
-            pool, pg, live, msg.oid, shards, base_attrs, version,
-            off=sinfo.logical_to_prev_chunk_offset(d_lo),
-            truncate=new_shard_len,
-            rmattrs=attr_rms + [HINFO_ATTR], reqid=msg.reqid,
-            prev_version=cur_v,
-            clone_snap=clone_snap_arg, clone_snaps=clone_snaps_arg,
-        )
-        if r == 0:
-            self._extent_cache_put(pool.id, msg.oid, version, d_lo, buf)
-        else:
-            self._extent_cache_drop(pool.id, msg.oid)
-        return MOSDOpReply(tid=msg.tid, result=r, epoch=self.epoch)
-
-    def _apply_shard_write(
-        self, pool, pg, shard, oid, payload: bytes, attrs,
-        delete=False, version: eversion_t = ZERO,
-        off: int = 0, truncate: int | None = None,
-        rmattrs: list[str] | None = None, reqid: str = "",
-    ) -> None:
-        """Apply a shard write + (when versioned) its pg-log entry in
-        ONE transaction — the reference couples data and log the same
-        way (ECTransaction appends log entries to the shard txn)."""
-        self.store.queue_transaction(
-            self._shard_write_txn(pool, pg, shard, oid, payload, attrs,
-                                  delete, version, off, truncate, rmattrs,
-                                  reqid)
-        )
-
-    async def _apply_shard_write_async(
-        self, pool, pg, shard, oid, payload: bytes, attrs,
-        delete=False, version: eversion_t = ZERO,
-        off: int = 0, truncate: int | None = None,
-        rmattrs: list[str] | None = None, reqid: str = "",
-        clone_snap: int = 0, clone_snaps: bytes = b"",
-    ) -> None:
-        """Same, but journaling stores fsync: run their commit on a
-        worker thread so one OSD's disk flush never stalls the whole
-        event loop (the reference's journaling happens on dedicated
-        finisher threads for the same reason)."""
-        t = self._shard_write_txn(
-            pool, pg, shard, oid, payload, attrs, delete, version,
-            off, truncate, rmattrs, reqid, clone_snap, clone_snaps,
-        )
-        if getattr(self.store, "blocking_commit", False):
-            await asyncio.to_thread(self.store.queue_transaction, t)
-        else:
-            self.store.queue_transaction(t)
-
-    def _shard_write_txn(
-        self, pool, pg, shard, oid, payload, attrs, delete, version,
-        off: int = 0, truncate: int | None = None,
-        rmattrs: list[str] | None = None, reqid: str = "",
-        clone_snap: int = 0, clone_snaps: bytes = b"",
-    ) -> Transaction:
-        """``truncate`` semantics: None keeps legacy whole-replace
-        (truncate to len(payload)); -1 leaves the length alone (ranged
-        RMW writes and metadata-only writes); >= 0 sets the exact shard
-        length after the write (store truncate zero-fills on extend).
-        ``clone_snap`` != 0 snapshots the local head shard into
-        (oid, snap=clone_snap) before applying (make_writeable COW)."""
-        c = self._shard_coll(pool, pg, shard)
-        o = ghobject_t(oid, shard=shard)
-        t = Transaction()
-        self._ensure_coll(t, c)
-        if clone_snap:
-            cl = ghobject_t(oid, snap=clone_snap, shard=shard)
-            if self.store.exists(c, o) and not self.store.exists(c, cl):
-                t.clone(c, o, cl)
-                t.setattrs(c, cl, {SNAPS_ATTR: clone_snaps})
-        if delete:
-            if self.store.exists(c, o):
-                t.remove(c, o)
-        else:
-            t.touch(c, o)
-            if payload:
-                t.write(c, o, off, payload)
-            if truncate is None:
-                if off == 0:
-                    t.truncate(c, o, len(payload))
-            elif truncate >= 0:
-                t.truncate(c, o, truncate)
-            if attrs:
-                t.setattrs(c, o, attrs)
-            for name in rmattrs or ():
-                t.rmattr(c, o, name)
-        if version > ZERO:
-            lg = self._pg_log(c)
-            if version > lg.info.last_update:
-                prior = self._object_version(c, o)
-                lg.append(t, pg_log_entry_t(
-                    DELETE if delete else MODIFY, oid, version, prior,
-                    reqid,
-                ))
-                lg.trim(t, self._log_keep)
-        return t
-
-    async def _ec_head_state(self, pool, pg, acting, oid):
-        """Probe the EC head object: (exists, whiteout, size, version,
-        SnapSet, attrs).  exists is False for a whiteout head (data-
-        plane absent) but the SnapSet still anchors its clones."""
-        ec = self._ec_for(pool)
-        try:
-            sz, attrs, _ = await self._ec_fetch(
-                pool, pg, acting, oid, ec, want_data=False)
-        except ECFetchError as e:
-            if e.errno != errno.ENOENT:
-                raise  # degraded, not absent: callers surface the errno
-            return False, False, 0, ZERO, SnapSet(), {}
-        ss = SnapSet.from_bytes(attrs.get(SS_ATTR))
-        wo = attrs.get(WHITEOUT_ATTR) == b"1"
-        v = _v_parse(attrs.get(VERSION_ATTR))
-        return (not wo), wo, (0 if wo else sz), v, ss, attrs
-
-    async def _ec_served_version(
-        self, pool, pg, acting, oid, lg=None
-    ) -> "eversion_t | None":
-        """The object version a consistent k-shard subset currently
-        serves (None = nothing decodable right now).  An absent object
-        whose newest log entry is a DELETE counts as served at the
-        delete's version (the write wasn't lost — it was superseded)."""
-        ec = self._ec_for(pool)
-        try:
-            _sz, attrs, _ = await self._ec_fetch(
-                pool, pg, acting, oid, ec, want_data=False)
-        except ECFetchError as e:
-            if e.errno != errno.ENOENT:
-                return None
-            if lg is not None:
-                for v in sorted(lg.entries, reverse=True):
-                    if lg.entries[v].oid == oid:
-                        if lg.entries[v].op == DELETE:
-                            return v
-                        break
-            return ZERO
-        return _v_parse(attrs.get(VERSION_ATTR))
-
-    async def _traced_sub_op(self, name, parent, shard, osd, reqid, coro):
-        """Child span per shard sub-op (the reference opens jaeger
-        child spans per ECSubRead/Write, ECCommon.cc:440-445)."""
-        with self.tracer.span(
-            name, parent=parent, shard=shard, osd=osd, reqid=reqid,
-        ):
-            return await coro
-
-    def _ec_avail(self, acting) -> dict[int, int]:
-        """shard -> osd for the currently usable members of an acting
-        set (shared by the normal and fast_read fetch paths)."""
-        return {
-            shard: osd for shard, osd in enumerate(acting)
-            if osd != CRUSH_ITEM_NONE and self.osdmap.is_up(osd)
-        }
-
-    async def _ec_fetch_fast(
-        self, pool, pg, acting, oid, ec, *,
-        chunk_off: int = 0, chunk_len: int = 0, snap: int = NOSNAP,
-    ):
-        """fast_read flavor (reference ECCommon.cc:531 + the fast_read
-        pool option): fan the ranged read to EVERY available shard at
-        once and complete from the first k version-consistent replies —
-        latency is the fastest k of n shards instead of a fixed-k read
-        plus retry rounds."""
-        import numpy as np
-
-        k = ec.get_data_chunk_count()
-        avail = {
-            shard: osd for shard, osd in enumerate(acting)
-            if osd != CRUSH_ITEM_NONE and self.osdmap.is_up(osd)
-        }
-        if len(avail) < k:
-            raise ECFetchError(errno.EIO)
-        async def read_one(s, o):
-            return s, await self._read_shard_quiet(
-                pool, pg, s, o, oid, off=chunk_off, length=chunk_len,
-                snap=snap,
-            )
-
-        tasks = [
-            asyncio.ensure_future(read_one(s, o)) for s, o in avail.items()
-        ]
-        got: dict[int, tuple] = {}
-        enoent = 0
-        try:
-            for fut in asyncio.as_completed(tasks):
-                shard, (payload, attrs, eno) = await fut
-                if payload is None:
-                    if eno == errno.ENOENT:
-                        enoent += 1
-                    continue
-                got[shard] = (payload, attrs or {})
-                # complete as soon as k shards agree on the newest
-                # version seen so far
-                versions = {
-                    s2: _v_parse(a.get(VERSION_ATTR))
-                    for s2, (_p, a) in got.items()
-                }
-                vmax = max(versions.values())
-                fresh = [s2 for s2, v in versions.items() if v == vmax]
-                if len(fresh) >= k:
-                    self.perf.inc("ec_fast_read")
-                    attrs = got[fresh[0]][1]
-                    chunks = {
-                        s2: np.frombuffer(got[s2][0], np.uint8)
-                        for s2 in fresh[:k]
-                    }
-                    if SIZE_ATTR not in attrs:
-                        raise ECFetchError(errno.ENOENT)
-                    return int(attrs[SIZE_ATTR]), attrs, chunks
-        finally:
-            for t in tasks:
-                if not t.done():
-                    t.cancel()
-        if enoent and enoent == len(tasks) - len(got):
-            raise ECFetchError(errno.ENOENT)
-        raise ECFetchError(errno.EIO)
-
-    async def _ec_fetch(
-        self, pool, pg, acting, oid, ec, *,
-        chunk_off: int = 0, chunk_len: int = 0, want_data: bool = True,
-        snap: int = NOSNAP, fast_read: bool = False,
-    ):
-        """Version-consistent EC shard fetch — the ECCommon read
-        pipeline (reference src/osd/ECCommon.cc:440-445 fans ECSubRead
-        to all shards concurrently; stale shards are excluded and the
-        read retried with a different shard set).
-
-        Returns ``(size, attrs, chunks)``; ``chunks`` maps shard id to
-        the requested chunk byte range (empty when ``want_data`` is
-        False — a probe).  ``chunk_len == 0`` reads to the shard end.
-        Raises :class:`ECFetchError` with ENOENT for a fully-absent
-        object, EIO otherwise.
-        """
-        if (
-            fast_read and want_data
-            and getattr(ec, "mds_any_k", False)
-            and ec.get_sub_chunk_count() == 1
-        ):
-            # decode-from-any-k is only sound for MDS codes; non-MDS
-            # plugins (shec/lrc) and sub-chunk codes take the
-            # minimum_to_decode-driven path below
-            try:
-                return await self._ec_fetch_fast(
-                    pool, pg, acting, oid, ec,
-                    chunk_off=chunk_off, chunk_len=chunk_len, snap=snap,
-                )
-            except ECFetchError:
-                raise
-            except Exception:
-                log.exception(
-                    "osd.%d: fast_read fetch failed; normal path", self.id)
-        k = ec.get_data_chunk_count()
-        avail = self._ec_avail(acting)
-        excluded: dict[int, int] = {}  # shard -> errno seen
-        for _attempt in range(len(acting) + 1):
-            usable = {s: o for s, o in avail.items() if s not in excluded}
-            want = set(range(k))
-            try:
-                minimum = ec.minimum_to_decode(want, set(usable))
-            except Exception:
-                break  # not enough shards left to decode
-            need_shards = sorted(set(minimum))
-            if want_data:
-                reads = (
-                    self._read_shard_quiet(
-                        pool, pg, s, usable[s], oid,
-                        off=chunk_off, length=chunk_len, snap=snap,
-                    )
-                    for s in need_shards
-                )
-            else:
-                reads = (
-                    self._read_shard_quiet(
-                        pool, pg, s, usable[s], oid, off=0, length=1,
-                        snap=snap,
-                    )
-                    for s in need_shards
-                )
-            results = await asyncio.gather(*reads)
-            chunks: dict[int, np.ndarray] = {}
-            shard_attrs: dict[int, dict[str, bytes]] = {}
-            failed = False
-            for shard, (payload, a, eno) in zip(need_shards, results):
-                if payload is None:
-                    excluded[shard] = eno
-                    failed = True
-                else:
-                    chunks[shard] = np.frombuffer(payload, np.uint8)
-                    shard_attrs[shard] = a or {}
-            if failed:
-                continue
-            # a revived OSD may hold a STALE chunk from before it went
-            # down: all chunks used in one decode must carry the same
-            # object version (object_info consistency; the reference
-            # reaches this via peering/recovery before serving)
-            versions = {
-                s: _v_parse(a.get(VERSION_ATTR)) for s, a in shard_attrs.items()
-            }
-            vmax = max(versions.values(), default=ZERO)
-            stale = [s for s, v in versions.items() if v < vmax]
-            if stale:
-                for s in stale:
-                    excluded[s] = errno.ESTALE
-                continue
-            attrs = next(iter(shard_attrs.values()), {})
-            if not attrs or SIZE_ATTR not in attrs:
-                raise ECFetchError(errno.ENOENT)
-            return int(attrs[SIZE_ATTR]), attrs, (chunks if want_data else {})
-        if excluded and all(e == errno.ENOENT for e in excluded.values()):
-            raise ECFetchError(errno.ENOENT)
-        raise ECFetchError(errno.EIO)
-
-    async def _ec_read_vector(
-        self, pool, pg, acting, msg, ec, sinfo
-    ) -> MOSDOpReply:
-        """EC read-class op vector served from ONE version-consistent
-        shard snapshot: ranged reads fetch only the covering stripes
-        (objecter-style extent math) and xattrs ride the same attrs."""
-        ops = msg.ops
-        try:
-            if any(o.op == OP_LIST_SNAPS for o in ops):
-                _ex, _wo, _sz, _v, ss, _a = await self._ec_head_state(
-                    pool, pg, acting, msg.oid)
-                return MOSDOpReply(
-                    tid=msg.tid, result=0, epoch=self.epoch,
-                    data=ss.to_bytes())
-            read_snap = NOSNAP
-            if msg.snapid != NOSNAP:
-                # find_object_context: route the read at a clone
-                _ex, _wo, _sz, _v, ss, _a = await self._ec_head_state(
-                    pool, pg, acting, msg.oid)
-                target = ss.resolve(msg.snapid)
-                if target is None or (target == NOSNAP and (
-                        msg.snapid <= ss.seq or not _ex)):
-                    return MOSDOpReply(
-                        tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch)
-                if target != NOSNAP:
-                    read_snap = target
-        except ECFetchError as e:
-            return MOSDOpReply(
-                tid=msg.tid, result=-e.errno, epoch=self.epoch)
-        reads = [o for o in ops if o.op == OP_READ]
-        chunk_off = chunk_len = 0
-        if reads:
-            lo = min(o.off for o in reads)
-            chunk_off = sinfo.logical_to_prev_chunk_offset(lo)
-            if not any(o.length == 0 for o in reads):
-                hi = max(o.off + o.length for o in reads)
-                chunk_len = sinfo.logical_to_next_chunk_offset(hi) - chunk_off
-        try:
-            size, attrs, chunks = await self._ec_fetch(
-                pool, pg, acting, msg.oid, ec,
-                chunk_off=chunk_off, chunk_len=chunk_len,
-                want_data=bool(reads), snap=read_snap,
-                fast_read=pool.fast_read,
-            )
-        except ECFetchError as e:
-            return MOSDOpReply(tid=msg.tid, result=-e.errno, epoch=self.epoch)
-        if read_snap == NOSNAP and attrs.get(WHITEOUT_ATTR) == b"1":
-            return MOSDOpReply(
-                tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch)
-        logical = None
-        base = 0
-        if reads and chunks and any(len(v) for v in chunks.values()):
-            logical = await self._ecu_decode_concat(sinfo, ec, chunks)
-            base = sinfo.aligned_chunk_offset_to_logical_offset(chunk_off)
-        outs: list[tuple[int, bytes, dict[str, bytes]]] = []
-        first_read: bytes | None = None
-        for o in ops:
-            r, d, kv = 0, b"", {}
-            if o.op == OP_READ:
-                end = size if o.length == 0 else min(o.off + o.length, size)
-                if logical is not None and o.off < end:
-                    d = logical[o.off - base : end - base].tobytes()
-                if first_read is None:  # summarize the FIRST read op,
-                    first_read = d      # even when it returned 0 bytes
-            elif o.op == OP_STAT:
-                pass
-            elif o.op == OP_GETXATTR:
-                v = attrs.get(USER_XATTR_PREFIX + o.name)
-                if v is None:
-                    r = -errno.ENODATA
-                else:
-                    d = v
-            elif o.op == OP_GETXATTRS:
-                kv = {
-                    name[len(USER_XATTR_PREFIX):]: v
-                    for name, v in attrs.items()
-                    if name.startswith(USER_XATTR_PREFIX)
-                }
-            else:
-                # omap reads: EC pools have no omap (reference restriction)
-                r = -errno.EOPNOTSUPP
-            outs.append((r, d, kv))
-        result = next((r for r, _d, _kv in outs if r != 0), 0)
-        return MOSDOpReply(
-            tid=msg.tid, result=result, epoch=self.epoch, size=size,
-            data=first_read or b"", outs=outs,
-        )
-
-    async def _read_shard_quiet(
-        self, pool, pg, shard, osd, oid, *, off: int = 0, length: int = 0,
-        extents: list[tuple[int, int]] | None = None, snap: int = NOSNAP,
-    ):
-        """_read_shard with transport failures mapped to EIO."""
-        try:
-            return await self._read_shard(
-                pool, pg, shard, osd, oid, off=off, length=length,
-                extents=extents, snap=snap,
-            )
-        except (OSError, asyncio.TimeoutError, ConnectionError):
-            return None, None, errno.EIO
-
-    async def _read_shard(
-        self, pool, pg, shard, osd, oid, *, off: int = 0, length: int = 0,
-        extents: list[tuple[int, int]] | None = None, snap: int = NOSNAP,
-    ):
-        """Ranged chunk read of one shard: (payload, attrs, errno).
-        ``length == 0`` reads to the shard end.  ``extents`` returns
-        the concatenation of multiple byte runs (sub-chunk repair).
-        ``snap`` != NOSNAP reads the clone shard object instead."""
-        if osd == self.id:
-            c = self._shard_coll(pool, pg, shard)
-            o = (ghobject_t(oid, shard=shard) if snap == NOSNAP
-                 else ghobject_t(oid, snap=snap, shard=shard))
-            if not self.store.exists(c, o):
-                return None, None, errno.ENOENT
-            if extents:
-                data = _read_extents(self.store, c, o, extents)
-            else:
-                data = self.store.read(
-                    c, o, off, None if length == 0 else length
-                )
-            return data, self.store.getattrs(c, o), 0
-        tid = next(self._tids)
-        rep = await self._traced_sub_op(
-            "ec_sub_read", self._op_span.get(), shard, osd,
-            "", self._sub_op(osd, MOSDECSubOpRead(
-                tid=tid, pg=pg, shard=shard, from_osd=self.id, oid=oid,
-                off=off, length=length, want_attrs=True, epoch=self.epoch,
-                extents=extents or [], snap=snap,
-            ), tid))
-        if rep.result != 0:
-            return None, None, -rep.result
-        return rep.data, rep.attrs, 0
-
-    async def _ec_delete(self, pool, pg, acting, msg, snapc=None,
-                         admit_epoch: int | None = None) -> MOSDOpReply:
-        my_shard = next(
-            (s for s, o in enumerate(acting) if o == self.id), None
-        )
-        if my_shard is None:
-            # same guard as _ec_write_full: never mint versions from a
-            # shard log this OSD doesn't own
-            return MOSDOpReply(tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
-        lg = self._pg_log(self._shard_coll(pool, pg, my_shard))
-        if msg.reqid and msg.reqid in lg.reqids:
-            return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
-        # snapshots: a delete under a newer SnapContext clones first;
-        # if clones anchor to this name, leave a whiteout head (the
-        # snapdir role) instead of removing the shard objects
-        if snapc is not None and (snapc.snaps or self._getattr_quiet(
-                self._shard_coll(pool, pg, my_shard),
-                ghobject_t(msg.oid, shard=my_shard), SS_ATTR)):
-            try:
-                exists, _wo, cur_size, cur_v, ss, _ = \
-                    await self._ec_head_state(pool, pg, acting, msg.oid)
-            except ECFetchError as e:
-                return MOSDOpReply(
-                    tid=msg.tid, result=-e.errno, epoch=self.epoch)
-            if not exists and ss.clones:
-                # already a whiteout (or absent) but clones anchor here:
-                # a second DELETE must not remove the snapdir head
-                return MOSDOpReply(
-                    tid=msg.tid, result=-errno.ENOENT, epoch=self.epoch)
-            clone_snap_arg, clone_snaps_arg = 0, b""
-            if exists and ss.needs_cow(snapc):
-                cl = ss.make_clone(snapc, cur_size)
-                clone_snap_arg = cl.id
-                clone_snaps_arg = encode_snaps(cl.snaps)
-            else:
-                ss.advance_seq(snapc)
-            if ss.clones and exists:
-                lv = self._ec_live(pool, acting)
-                if lv is None:
-                    return MOSDOpReply(
-                        tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
-                live, _ = lv
-                version = self._next_version(
-                    self._shard_coll(pool, pg, my_shard), admit_epoch)
-                if version is None:
-                    return MOSDOpReply(
-                        tid=msg.tid, result=-errno.EAGAIN,
-                        epoch=self.epoch)
-                wo_attrs = {
-                    SIZE_ATTR: b"0",
-                    VERSION_ATTR: _v_bytes(version),
-                    WHITEOUT_ATTR: b"1",
-                    SS_ATTR: ss.to_bytes(),
-                }
-                r = await self._ec_fan_out_write(
-                    pool, pg, live, msg.oid, {}, wo_attrs, version,
-                    truncate=0, reqid=msg.reqid, prev_version=cur_v,
-                    clone_snap=clone_snap_arg, clone_snaps=clone_snaps_arg,
-                )
-                return MOSDOpReply(tid=msg.tid, result=r, epoch=self.epoch)
-        self._extent_cache_drop(pool.id, msg.oid)
-        version = self._next_version(
-            self._shard_coll(pool, pg, my_shard), admit_epoch)
-        if version is None:
-            return MOSDOpReply(
-                tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
-        waits = []
-        for shard, osd in enumerate(acting):
-            if osd == CRUSH_ITEM_NONE:
-                continue
-            if osd == self.id:
-                await self._apply_shard_write_async(
-                    pool, pg, shard, msg.oid, b"", {}, delete=True,
-                    version=version, reqid=msg.reqid,
-                )
-            else:
-                tid = next(self._tids)
-                waits.append(self._sub_op(osd, MOSDECSubOpWrite(
-                    tid=tid, pg=pg, shard=shard, from_osd=self.id,
-                    oid=msg.oid, off=0, data=b"", attrs={},
-                    epoch=self.epoch, delete=True, version=version,
-                    reqid=msg.reqid,
-                ), tid))
-        if waits:
-            await asyncio.gather(*waits)
-        return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
-
-    async def _handle_sub_write(self, msg: MOSDECSubOpWrite) -> None:
-        from ceph_tpu.common.fault_injector import FAULTS
-
-        pool = self.osdmap.get_pg_pool(msg.pg.pool)
-        result = 0
-        try:
-            await FAULTS.check("osd.ec_sub_write_apply")
-            if msg.version > ZERO and msg.version.epoch < self.epoch:
-                # a sub-write minted under an older map (the version
-                # carries the sender's ADMISSION epoch): accept it only
-                # if the sender still leads this pg in OUR map — a
-                # demoted primary's in-flight fan-out must not land
-                # (the reference's require_same_or_newer_map gate)
-                _u, _up, _a, cur_primary = self.osdmap.pg_to_up_acting_osds(
-                    pg_t(msg.pg.pool, msg.pg.ps), folded=True)
-                if msg.from_osd != cur_primary:
-                    result = -errno.ESTALE
-            skip = False
-            if msg.guard > ZERO:
-                c = self._shard_coll(pool, msg.pg, msg.shard)
-                o = ghobject_t(msg.oid, shard=msg.shard)
-                skip = self._object_version(c, o) > msg.guard
-            if msg.guarded and not skip and result == 0:
-                c = self._shard_coll(pool, msg.pg, msg.shard)
-                o = ghobject_t(msg.oid, shard=msg.shard)
-                if self._object_version(c, o) != msg.prev_version:
-                    # this shard missed earlier writes (or holds a
-                    # divergent newer one): recovery must reconcile it
-                    # before it may accept new versions, or a partial
-                    # write would stamp stale data current
-                    result = -errno.ESTALE
-            if not skip and result == 0:
-                await self._apply_shard_write_async(
-                    pool, msg.pg, msg.shard, msg.oid, msg.data, msg.attrs,
-                    delete=msg.delete, version=msg.version,
-                    off=msg.off, truncate=msg.truncate,
-                    rmattrs=msg.rmattrs, reqid=msg.reqid,
-                    clone_snap=msg.clone_snap, clone_snaps=msg.clone_snaps,
-                )
-        except OSError as e:
-            result = -(e.errno or errno.EIO)
-        await msg.conn.send_message(MOSDECSubOpWriteReply(
-            tid=msg.tid, pg=msg.pg, shard=msg.shard, from_osd=self.id,
-            result=result, epoch=self.epoch,
-        ))
-
-    async def _handle_sub_read(self, msg: MOSDECSubOpRead) -> None:
-        pool = self.osdmap.get_pg_pool(msg.pg.pool)
-        c = self._shard_coll(pool, msg.pg, msg.shard)
-        o = (ghobject_t(msg.oid, shard=msg.shard) if msg.snap == NOSNAP
-             else ghobject_t(msg.oid, snap=msg.snap, shard=msg.shard))
-        if not self.store.exists(c, o):
-            rep = MOSDECSubOpReadReply(
-                tid=msg.tid, pg=msg.pg, shard=msg.shard, from_osd=self.id,
-                result=-errno.ENOENT, epoch=self.epoch,
-            )
-        else:
-            try:
-                if msg.extents:
-                    data = _read_extents(self.store, c, o, msg.extents)
-                else:
-                    data = self.store.read(
-                        c, o, msg.off, None if msg.length == 0 else msg.length
-                    )
-                self.perf.inc("subop_read_bytes", len(data))
-                attrs = self.store.getattrs(c, o) if msg.want_attrs else {}
-                rep = MOSDECSubOpReadReply(
-                    tid=msg.tid, pg=msg.pg, shard=msg.shard,
-                    from_osd=self.id, result=0, data=data, attrs=attrs,
-                    epoch=self.epoch,
-                )
-            except OSError as e:
-                # e.g. a checksum-at-rest failure (BlockStore EIO): the
-                # primary excludes this shard and reconstructs from the
-                # others (the reference's shard-EIO path,
-                # ECBackend::handle_sub_read error handling)
-                rep = MOSDECSubOpReadReply(
-                    tid=msg.tid, pg=msg.pg, shard=msg.shard,
-                    from_osd=self.id, result=-(e.errno or 5),
-                    epoch=self.epoch,
-                )
-        await msg.conn.send_message(rep)
-
     # -- watch/notify (PrimaryLogPG watch/notify + MWatchNotify) -------
 
     async def _watch_notify_vector(self, pool, pg, msg) -> MOSDOpReply:
@@ -3121,1220 +1783,3 @@ class OSDDaemon:
             epoch=self.epoch,
         ))
 
-    # -- recovery ------------------------------------------------------
-
-    async def _recover_all(self) -> None:
-        """After a map change: for every PG this OSD leads, reconstruct
-        missing shards/objects on the current acting set (the
-        do_recovery -> recover_object path, §3.3).  Re-runs until a
-        full pass has seen the newest map (epochs can land mid-pass).
-
-        PGs run concurrently, but admission is reservation-gated
-        (backfill_reservation.rst): each PG takes one of OUR
-        osd_max_backfills local slots, then one remote slot on every
-        acting peer (MBackfillReserve REQUEST/GRANT); a REJECT_TOOFULL
-        releases everything and retries after
-        osd_backfill_retry_interval, so cluster-wide concurrent
-        backfill load per OSD stays bounded.
-
-        A pass that leaves PGs unclean (a peer mid-restart, a dropped
-        connection) re-runs after osd_backfill_retry_interval even if
-        no new map arrives — the reference's recovery_request_timer
-        retry role.  Without it a transient error at the wrong moment
-        parks the PG in peering forever (found by the interleaving
-        fuzzer, tests/test_interleave_fuzz.py)."""
-        while not self.stopping:
-            done_epoch = self.epoch
-            # GC remote grants whose requesting primary is gone — a
-            # primary that died after GRANT can never send RELEASE
-            for key in list(self._remote_grants):
-                if not self.osdmap.is_up(key[2]):
-                    res = self._remote_grants.pop(key)
-                    res.release()
-            try:
-                om = self.osdmap
-                work: list[tuple[PgPool, pg_t, list[int]]] = []
-                for pid, pool in list(om.pools.items()):
-                    for ps in range(pool.pg_num):
-                        pg = pg_t(pid, ps)
-                        _, _, acting, primary = om.pg_to_up_acting_osds(
-                            pg, folded=True
-                        )
-                        if primary != self.id:
-                            continue
-                        work.append((pool, pg, acting))
-                if work:
-                    # return_exceptions: one PG's crash must neither
-                    # abort the pass (siblings would keep running
-                    # DETACHED with reservations held) nor mask the
-                    # others' completion
-                    results = await asyncio.gather(*[
-                        self._recover_pg_reserved(pool, pg, acting,
-                                                  done_epoch)
-                        for pool, pg, acting in work
-                    ], return_exceptions=True)
-                    for (_p, pg, _a), r in zip(work, results):
-                        if isinstance(r, asyncio.CancelledError):
-                            raise r
-                        if isinstance(r, BaseException):
-                            log.exception(
-                                "osd.%d: recovery of %s crashed",
-                                self.id, pg, exc_info=r)
-                if self.epoch != done_epoch:
-                    continue  # a map landed mid-pass: re-run now
-                incomplete = [
-                    pg for _pool, pg, _a in work
-                    if self._clean_epoch.get((pg.pool, pg.ps), -1)
-                    < done_epoch
-                ]
-                if not incomplete:
-                    return
-                log.info(
-                    "osd.%d: %d pgs unclean after pass; retrying",
-                    self.id, len(incomplete))
-                await asyncio.sleep(
-                    max(self.conf["osd_backfill_retry_interval"], 0.05))
-            except asyncio.CancelledError:
-                raise
-            except Exception:
-                log.exception("osd.%d: recovery pass failed", self.id)
-                return
-
-    async def _recover_pg_reserved(
-        self, pool: PgPool, pg: pg_t, acting: list[int], pass_epoch: int,
-    ) -> None:
-        key = (pg.pool, pg.ps)
-        peers = sorted({
-            o for o in acting
-            if o != CRUSH_ITEM_NONE and o != self.id
-        })
-        retry = self.conf["osd_backfill_retry_interval"]
-        async with self.local_reserver.request(key, priority=1):
-            self.recovery_stats["peak_local"] = max(
-                self.recovery_stats["peak_local"],
-                self.local_reserver.in_use)
-            granted: list[int] = []
-            try:
-                while not self.stopping and self.epoch == pass_epoch:
-                    if await self._reserve_remotes(pg, peers, granted):
-                        break
-                    # partial holds across the retry sleep invite
-                    # cluster-wide deadlock (two primaries each camped
-                    # on one of the other's replicas): drop everything
-                    self.recovery_stats["reservation_rejects"] += 1
-                    await self._release_remotes(pg, granted)
-                    granted.clear()
-                    await asyncio.sleep(retry)
-                else:
-                    return
-                self._recovering_pgs.add(key)
-                try:
-                    ok = await self._recover_pg(pool, pg, acting)
-                    if ok:
-                        self._clean_epoch[key] = pass_epoch
-                        self.recovery_stats["pgs_recovered"] += 1
-                finally:
-                    self._recovering_pgs.discard(key)
-            finally:
-                await self._release_remotes(pg, granted)
-
-    async def _reserve_remotes(
-        self, pg: pg_t, peers: list[int], granted: list[int],
-    ) -> bool:
-        """GRANT from every acting peer, or False on REJECT_TOOFULL.
-
-        A peer the MAP says is down is skipped — it can take no
-        recovery load and no pushes will reach it.  A peer that is up
-        but unreachable counts as a REJECT: it may come back mid-
-        recovery and start absorbing pushes, so proceeding without its
-        slot would unbound its inbound backfill load; the retry loop
-        re-asks (either it answers, or it gets marked down — a new
-        epoch — and the pass restarts without it).  Either way a
-        best-effort RELEASE covers the race where the peer GRANTed but
-        the reply missed our timeout — without it the replica's slot
-        leaks until we restart."""
-        for o in peers:
-            tid = next(self._tids)
-            try:
-                rep = await self._sub_op(o, MBackfillReserve(
-                    tid=tid, op=MBackfillReserve.REQUEST, pool=pg.pool,
-                    ps=pg.ps, from_osd=self.id, priority=1,
-                ), tid)
-            except (OSError, asyncio.TimeoutError, ConnectionError):
-                if not self.osdmap.is_up(o):
-                    continue
-                await self._release_remotes(pg, [o])
-                return False
-            if rep.op == MBackfillReserve.GRANT:
-                granted.append(o)
-            else:
-                return False
-        return True
-
-    async def _release_remotes(self, pg: pg_t, granted: list[int]) -> None:
-        for o in granted:
-            try:
-                conn = await self._osd_conn(o)
-                await conn.send_message(MBackfillReserve(
-                    tid=next(self._tids), op=MBackfillReserve.RELEASE,
-                    pool=pg.pool, ps=pg.ps, from_osd=self.id,
-                ))
-            except (OSError, asyncio.TimeoutError, ConnectionError):
-                continue
-
-    async def _handle_backfill_reserve(self, msg: MBackfillReserve) -> None:
-        if msg.op == MBackfillReserve.REQUEST:
-            key = (msg.pool, msg.ps, msg.from_osd)
-            res = self.remote_reserver.try_request(key, msg.priority)
-            if res is not None:
-                self._remote_grants[key] = res
-                self.recovery_stats["peak_remote"] = max(
-                    self.recovery_stats["peak_remote"],
-                    self.remote_reserver.in_use)
-                op = MBackfillReserve.GRANT
-            else:
-                op = MBackfillReserve.REJECT_TOOFULL
-            await msg.conn.send_message(MBackfillReserve(
-                tid=msg.tid, op=op, pool=msg.pool, ps=msg.ps,
-                from_osd=self.id,
-            ))
-        elif msg.op == MBackfillReserve.RELEASE:
-            res = self._remote_grants.pop(
-                (msg.pool, msg.ps, msg.from_osd), None)
-            if res is not None:
-                res.release()
-        else:  # GRANT / REJECT_TOOFULL reply to our REQUEST
-            fut = self._waiters.get(msg.tid)
-            if fut and not fut.done():
-                fut.set_result(msg)
-
-    def _local_objects(self, pool, pg, shard) -> list[str]:
-        c = self._shard_coll(pool, pg, shard)
-        if not self.store.collection_exists(c):
-            return []
-        return sorted(
-            {o.name for o in self.store.collection_list(c)} - {PGMETA_OID}
-        )
-
-    def _pg_members(
-        self, pool: PgPool, acting: list[int]
-    ) -> list[tuple[int, int]]:
-        """(shard, osd) pairs of the acting set; replicated members all
-        use NO_SHARD collections."""
-        if pool.is_erasure():
-            return [
-                (s, o) for s, o in enumerate(acting) if o != CRUSH_ITEM_NONE
-            ]
-        return [(NO_SHARD, o) for o in acting if o != CRUSH_ITEM_NONE]
-
-    async def _recover_pg(self, pool: PgPool, pg: pg_t, acting: list[int]) -> bool:
-        """Peering-lite + recovery for one PG this OSD leads.
-
-        1. collect pg_info from every acting member (MOSDPGQuery);
-        2. adopt log entries from any member ahead of us (we may have
-           been the one that was down);
-        3. scope the object set: exact per-peer missing sets when the
-           log covers everyone (PGLog::proc_replica_log), full
-           backfill over the union of object lists otherwise;
-        4. reconcile each object to its newest version (reconstruct +
-           MOSDPGPush / replayed delete);
-        5. bring lagging members' logs current (MOSDPGLog).
-        """
-        pairs = self._pg_members(pool, acting)
-        if self.id not in [o for _, o in pairs]:
-            return True
-        # prior-set (PastIntervals role): still-up members of previous
-        # acting sets serve as extra data SOURCES — a fully-remapped PG
-        # pulls from its old home
-        prior = self._prior_pairs(pool, pg, pairs)
-        my_shard = next(s for s, o in pairs if o == self.id)
-        myc = self._shard_coll(pool, pg, my_shard)
-        lg = self._pg_log(myc)
-
-        peer_infos: dict[tuple[int, int], MOSDPGInfo] = {}
-        for s, o in pairs:
-            if o == self.id:
-                continue
-            try:
-                peer_infos[(s, o)] = await self._pg_query(
-                    pool, pg, s, o, since=lg.info.last_update
-                )
-            except (OSError, asyncio.TimeoutError, ConnectionError):
-                continue  # unreachable; next map change retries
-
-        # merge peers' witnessed interval chains into ours
-        # (PastIntervals sharing via pg info): a member that joined in
-        # a later interval learns the older homes it never saw
-        import json as _json
-
-        def _merge_chain(raw: bytes) -> bool:
-            if not raw:
-                return False
-            try:
-                chain = _json.loads(raw)
-            except ValueError:
-                return False
-            hist = self._past_acting.setdefault((pg.pool, pg.ps), [])
-            changed = False
-            for a in chain:
-                if a != acting and a not in hist:
-                    hist.append(a)
-                    del hist[:-16]
-                    changed = True
-            return changed
-
-        merged = False
-        for info in peer_infos.values():
-            merged |= _merge_chain(getattr(info, "past_acting", b""))
-        if merged:
-            self._save_past_acting()
-            prior = self._prior_pairs(pool, pg, pairs)
-
-        pre_adopt_lu = lg.info.last_update
-        ahead = [
-            i for i in peer_infos.values()
-            if i.last_update > lg.info.last_update
-        ]
-        gapped = False
-        if ahead:
-            best = max(ahead, key=lambda i: i.last_update)
-            # a peer whose log_tail moved past our state means its
-            # entries_after(our lu) delta has a hole: everything in the
-            # trimmed range must come from backfill, and our own log
-            # must admit the gap (set_tail) so covers() stays truthful
-            gapped = best.log_tail > pre_adopt_lu
-            t = Transaction()
-            self._ensure_coll(t, myc)
-            if gapped:
-                lg.set_tail(t, best.log_tail)
-            for raw in best.entries:
-                e = pg_log_entry_t.decode(raw)
-                if e.version > lg.info.last_update:
-                    lg.append(t, e)
-            lg.trim(t, self._log_keep)
-            if not t.empty():
-                self.store.queue_transaction(t)
-
-        # scope; prior intervals force the backfill enumeration — the
-        # data may live entirely on members our log knows nothing about
-        scope: set[str] | None = None if (gapped or prior) else set()
-        if scope is not None:
-            for info in peer_infos.values():
-                miss = lg.missing_from(info.last_update)
-                if miss is None:
-                    scope = None
-                    break
-                scope |= set(miss.items)
-        if ahead and scope is not None:
-            # entries adopted above may name objects my own shard lacks
-            for raw in max(ahead, key=lambda i: i.last_update).entries:
-                e = pg_log_entry_t.decode(raw)
-                scope.add(e.oid)
-        strays: set[str] = set()
-        if scope is None:
-            # backfill: reconcile the union of object lists, but the
-            # member with the newest pre-recovery state is authoritative
-            # for WHICH objects exist — an object only held by stale
-            # members is a stray (deleted while they were down), never
-            # resurrected (reference backfill removes strays the same
-            # way)
-            objs = set(self._local_objects(pool, pg, my_shard))
-            lists: dict[tuple[int, int], set[str]] = {
-                (my_shard, self.id): set(objs)
-            }
-            lus = {(my_shard, self.id): pre_adopt_lu}
-            worklist = [
-                ((s, o), None) for s, o in prior
-            ] + [(k, i) for k, i in peer_infos.items()]
-            chain_grew = False
-            queried: set[tuple[int, int]] = {(my_shard, self.id)}
-            qi = 0
-            while qi < len(worklist):
-                (s, o), info = worklist[qi]
-                qi += 1
-                if (s, o) in queried:
-                    continue
-                queried.add((s, o))
-                if o == self.id:
-                    # a past interval where WE held a different shard:
-                    # serve the listing locally (querying self raises)
-                    try:
-                        lists[(s, o)] = set(
-                            self._local_objects(pool, pg, s))
-                    except FileNotFoundError:
-                        continue
-                    lus[(s, o)] = self._pg_log(
-                        self._shard_coll(pool, pg, s)).info.last_update
-                    objs |= lists[(s, o)]
-                    continue
-                try:
-                    full = await self._pg_query(
-                        pool, pg, s, o, since=lg.info.last_update,
-                        want_objects=True,
-                    )
-                except (OSError, asyncio.TimeoutError, ConnectionError):
-                    continue
-                lists[(s, o)] = {oid for oid, _v in full.objects}
-                lus[(s, o)] = (
-                    info.last_update if info is not None
-                    else full.last_update
-                )
-                objs |= lists[(s, o)]
-                if _merge_chain(getattr(full, "past_acting", b"")):
-                    # chain-follow: the old home knew an even older one
-                    chain_grew = True
-                    prior = self._prior_pairs(pool, pg, pairs)
-                    for pair in prior:
-                        if pair not in queried:
-                            worklist.append((pair, None))
-                if info is None and full.last_update > lg.info.last_update:
-                    # adopt the prior member's log delta so ops from
-                    # the foreign interval (e.g. DELETEs) replay here
-                    # instead of the old state resurrecting
-                    t2 = Transaction()
-                    self._ensure_coll(t2, myc)
-                    if full.log_tail > lg.info.last_update:
-                        lg.set_tail(t2, full.log_tail)
-                    for raw in full.entries:
-                        e = pg_log_entry_t.decode(raw)
-                        if e.version > lg.info.last_update:
-                            lg.append(t2, e)
-                            objs.add(e.oid)
-                    lg.trim(t2, self._log_keep)
-                    if not t2.empty():
-                        self.store.queue_transaction(t2)
-            if chain_grew:
-                self._save_past_acting()  # one write after the drain
-            auth = max(lus, key=lambda k: lus[k])
-            strays = objs - lists[auth]
-        else:
-            objs = scope
-        all_ok = True
-        rsleep = self.conf["osd_recovery_sleep"]
-
-        async def _one(oid: str) -> bool:
-            # osd_recovery_max_active: in-flight reconciliations per
-            # daemon, across every concurrently-reserved PG; each one
-            # then admits through the mClock gate at recovery weight,
-            # so saturated client I/O overtakes it (admission strictly
-            # BEFORE the object lock — a lock holder must never wait
-            # on admission, or slots+locks could cycle)
-            async with self._recovery_budget:
-                async with self.op_gate.admit("recovery"):
-                    ok = await self._reconcile_object(
-                        pool, pg, pairs, oid, stray=oid in strays,
-                        prior_pairs=prior,
-                    )
-                if rsleep:
-                    await asyncio.sleep(rsleep)
-                return bool(ok)
-
-        results = await asyncio.gather(
-            *[_one(oid) for oid in sorted(objs)], return_exceptions=True,
-        )
-        for oid, r in zip(sorted(objs), results):
-            if isinstance(r, (OSError, asyncio.TimeoutError, ConnectionError)):
-                log.warning(
-                    "osd.%d: reconcile %s/%s interrupted: %r",
-                    self.id, pg, oid, r,
-                )
-                return False
-            if isinstance(r, BaseException):
-                raise r
-            all_ok &= r
-        # log sync
-        for (s, o), info in peer_infos.items():
-            if info.last_update >= lg.info.last_update:
-                continue
-            entries = [
-                e.encode() for e in lg.entries_after(info.last_update)
-            ]
-            try:
-                await self._pg_log_send(pool, pg, s, o, entries, lg.info.log_tail)
-            except (OSError, asyncio.TimeoutError, ConnectionError):
-                continue
-        # only a FULLY verified pass (every object confirmed on every
-        # target) may forget the prior intervals — a swallowed push
-        # failure must keep the old home reachable for the retry
-        if all_ok:
-            if self._past_acting.pop((pg.pool, pg.ps), None) is not None:
-                self._save_past_acting()
-        else:
-            log.warning(
-                "osd.%d: %s recovery pass incomplete; retaining past "
-                "intervals", self.id, pg)
-        return all_ok
-
-    async def _reconcile_object(
-        self, pool: PgPool, pg: pg_t, pairs: list[tuple[int, int]], oid: str,
-        stray: bool = False, have_lock: bool = False,
-        prior_pairs: list[tuple[int, int]] | None = None,
-    ) -> bool:
-        """Bring one object to its newest version on every acting
-        member: replay deletes, remove strays, reconstruct
-        stale/missing shards from the members holding the newest
-        version.
-
-        Serializes against client writes via the object lock — probing
-        mid-write would see a partial fan-out and wrongly roll it back
-        (``have_lock`` for callers inside the write path that already
-        hold it)."""
-        with self.tracer.span(
-            "recover_object", pg=str(pg), oid=oid,
-        ):
-            if not have_lock:
-                async with self._obj_lock(pool.id, oid):
-                    return await self._reconcile_object_locked(
-                        pool, pg, pairs, oid, stray, prior_pairs)
-            return await self._reconcile_object_locked(
-                pool, pg, pairs, oid, stray, prior_pairs)
-
-    async def _reconcile_object_locked(
-        self, pool: PgPool, pg: pg_t, pairs: list[tuple[int, int]], oid: str,
-        stray: bool = False,
-        prior_pairs: list[tuple[int, int]] | None = None,
-    ) -> bool:
-        """Returns True when the object verifiably reached every
-        target (False = retry on a later pass)."""
-        from ceph_tpu.common.fault_injector import FAULTS
-
-        await FAULTS.check("osd.recover_object")
-        is_ec = pool.is_erasure()
-        my_shard = next(s for s, o in pairs if o == self.id)
-        lg = self._pg_log(self._shard_coll(pool, pg, my_shard))
-        latest: pg_log_entry_t | None = None
-        for v in sorted(lg.entries, reverse=True):
-            if lg.entries[v].oid == oid:
-                latest = lg.entries[v]
-                break
-
-        state: dict[tuple[int, int], tuple[bool, eversion_t, dict]] = {}
-        for s, o in pairs:
-            try:
-                payload, attrs = await self._probe_shard(pool, pg, s, o, oid)
-            except (OSError, asyncio.TimeoutError, ConnectionError):
-                continue  # unreachable: not a source nor target now
-            if payload is None:
-                state[(s, o)] = (False, ZERO, {})
-            else:
-                state[(s, o)] = (
-                    True, _v_parse((attrs or {}).get(VERSION_ATTR)), attrs or {}
-                )
-        # prior-interval members: extra SOURCES (never targets) — data
-        # a full remap left on the old acting set
-        prior_state: dict[tuple[int, int], tuple[bool, eversion_t, dict]] = {}
-        for s, o in prior_pairs or ():
-            try:
-                payload, attrs = await self._probe_shard(pool, pg, s, o, oid)
-            except (OSError, asyncio.TimeoutError, ConnectionError):
-                continue
-            if payload is not None:
-                prior_state[(s, o)] = (
-                    True, _v_parse((attrs or {}).get(VERSION_ATTR)), attrs or {}
-                )
-
-        delete_entry = latest is not None and latest.op == DELETE
-        if delete_entry or (stray and latest is None):
-            # logged delete replay, or a backfill stray (only stale
-            # members hold it; its DELETE entry was trimmed)
-            guard = latest.version if latest else lg.info.last_update
-            for (s, o), (present, _v, _a) in state.items():
-                if present:
-                    await self._recovery_delete(pool, pg, s, o, oid, guard)
-            return True
-
-        all_state = {**prior_state, **state}
-        versions = [v for (p, v, _a) in all_state.values() if p]
-        if not versions:
-            return True  # nothing anywhere to recover from
-        vmax = max(versions)
-        sources = {
-            s: o for (s, o), (p, v, _a) in all_state.items()
-            if p and v == vmax
-        }
-        targets = [
-            (s, o) for (s, o), (p, v, _a) in state.items()
-            if not p or v < vmax
-        ]
-        if not targets:
-            return True
-        log.info(
-            "osd.%d: recovering %s/%s to %s on %s", self.id, pg, oid,
-            vmax, targets,
-        )
-        self.perf.inc("recovery_ops")
-        src_attrs = next(
-            a for (s, o), (p, v, a) in all_state.items() if p and v == vmax
-        )
-        if not is_ec:
-            s0, o0 = next(iter(sources.items()))
-            payload, _a, _e = await self._read_shard_quiet(
-                pool, pg, s0, o0, oid
-            )
-            if payload is None:
-                return False
-            results = await asyncio.gather(*(
-                self._push(pool, pg, s, o, oid, payload, src_attrs)
-                for s, o in targets
-            ), return_exceptions=True)  # a dead target must not abort
-            return not any(              # the rest of the recovery pass
-                isinstance(r, BaseException) for r in results)
-        ec = self._ec_for(pool)
-        sinfo = self._sinfo(ec)
-        k = ec.get_data_chunk_count()
-        force_push = False
-        if len(sources) < k:
-            # vmax is not reconstructible (a client write died mid
-            # fan-out): ROLL BACK to the newest version at least k
-            # shards agree on, overwriting the partial newer shards —
-            # the reference's divergent-entry rollback (PGLog merge_log)
-            # expressed at shard granularity.  The rolled-back write's
-            # log entries are stripped so a client retry re-applies it.
-            # rollback candidates come from the CURRENT interval only:
-            # prior-interval members hold old versions by definition,
-            # and letting them vote would roll back writes whose newer
-            # copies merely sit on temporarily-down current members
-            by_v: dict = {}
-            for (s, o), (p, v, _a) in state.items():
-                if p:
-                    by_v.setdefault(v, []).append((s, o))
-            candidates = [v for v, lst in by_v.items() if len(lst) >= k]
-            if not candidates:
-                log.error(
-                    "osd.%d: %s/%s unrecoverable: %d/%d consistent shards",
-                    self.id, pg, oid, len(sources), k,
-                )
-                return False
-            v_star = max(candidates)
-            log.warning(
-                "osd.%d: %s/%s rolling back %s -> %s (partial write)",
-                self.id, pg, oid, vmax, v_star,
-            )
-            vmax = v_star
-            sources = dict(by_v[v_star])
-            targets = [
-                (s, o) for (s, o), (p, v, _a) in state.items()
-                if not p or v != v_star
-            ]
-            src_attrs = next(
-                a for (s, o), (p, v, a) in state.items()
-                if p and v == v_star
-            )
-            force_push = True
-            t = Transaction()
-            self._ensure_coll(t, self._shard_coll(pool, pg, my_shard))
-            lg.rollback_divergent(t, oid, v_star)
-            if getattr(self.store, "blocking_commit", False):
-                await asyncio.to_thread(self.store.queue_transaction, t)
-            else:
-                self.store.queue_transaction(t)
-        need = {s for s, _ in targets}
-        # single-shard repair of a regenerating code: thread
-        # minimum_to_decode's (sub-chunk offset, count) runs down to
-        # ranged shard reads so only sub_chunk_no/q of each helper
-        # crosses the wire (reference ECCommon.cc:262-299 +
-        # ErasureCodeClay::repair_one_lost_chunk) — CLAY's whole point
-        repair_extents: dict[int, list[tuple[int, int]]] | None = None
-        if (
-            len(need) == 1 and ec.get_sub_chunk_count() > 1
-            and not getattr(self, "disable_subchunk_repair", False)
-        ):
-            try:
-                if ec.is_repair(need, set(sources)):
-                    minimum = ec.minimum_to_decode(need, set(sources))
-                    cs = sinfo.chunk_size
-                    sub = cs // ec.get_sub_chunk_count()
-                    size = int(src_attrs.get(SIZE_ATTR, b"0"))
-                    ns = max(
-                        1, sinfo.logical_to_next_chunk_offset(size) // cs
-                    )
-                    repair_extents = {
-                        s: [
-                            (stripe * cs + o * sub, c * sub)
-                            for stripe in range(ns)
-                            for o, c in runs
-                        ]
-                        for s, runs in minimum.items()
-                    }
-            except Exception:
-                repair_extents = None  # fall back to full-chunk reads
-        # helper-shard reads and shard pushes both fan out concurrently
-        # (the reference's ECSubRead/MOSDPGPush are fire-and-gather)
-        chunks: dict[int, np.ndarray] = {}
-        used_packed = False
-        if repair_extents is not None and set(repair_extents) <= set(sources):
-            src_items = [(s, sources[s]) for s in sorted(repair_extents)]
-            payloads = await asyncio.gather(*(
-                self._read_shard_quiet(
-                    pool, pg, s, o, oid, extents=repair_extents[s]
-                )
-                for s, o in src_items
-            ))
-            for (s, o), (payload, _a, _e) in zip(src_items, payloads):
-                if payload is not None:
-                    chunks[s] = np.frombuffer(payload, np.uint8)
-            if len(chunks) < len(repair_extents):
-                chunks = {}  # a helper vanished: retry with full reads
-            else:
-                used_packed = True
-        if not chunks:
-            src_items = list(sources.items())
-            payloads = await asyncio.gather(*(
-                self._read_shard_quiet(pool, pg, s, o, oid)
-                for s, o in src_items
-            ))
-            for (s, o), (payload, _a, _e) in zip(src_items, payloads):
-                if payload is not None:
-                    chunks[s] = np.frombuffer(payload, np.uint8)
-            if len(chunks) < k:
-                log.error(
-                    "osd.%d: %s/%s recovery aborted: %d/%d source reads "
-                    "succeeded", self.id, pg, oid, len(chunks), k,
-                )
-                return False
-        # the timed decode stage (BASELINE.md #5; reference
-        # ECBackend.cc:365-431 handle_recovery_read_complete): measured
-        # IN the running daemon, not inferred from microbenches
-        _t0 = time.perf_counter()
-        rebuilt = await ecutil.decode_shards_async(
-            sinfo, ec, chunks, need, packed_repair=used_packed,
-            service=self.encode_service,
-        )
-        self.perf.inc("recovery_decode_seconds",
-                      time.perf_counter() - _t0)
-        self.perf.inc("recovery_decode_bytes",
-                      sum(v.nbytes for v in rebuilt.values()))
-        results = await asyncio.gather(*(
-            self._push(pool, pg, s, o, oid, rebuilt[s].tobytes(), src_attrs,
-                       force=force_push)
-            for s, o in targets
-        ), return_exceptions=True)  # dead targets retry on the next pass
-        return not any(isinstance(r, BaseException) for r in results)
-
-    async def _recovery_delete(
-        self, pool, pg, shard, osd, oid, guard: eversion_t
-    ) -> None:
-        """Replay of a logged delete on a stale member (unlogged: the
-        log itself syncs separately).  ``guard`` protects a concurrent
-        re-create: members whose object is newer than the delete keep
-        it."""
-        if osd == self.id:
-            c = self._shard_coll(pool, pg, shard)
-            if self._object_version(c, ghobject_t(oid, shard=shard)) > guard:
-                return
-            await self._apply_shard_write_async(
-                pool, pg, shard, oid, b"", {}, delete=True
-            )
-            return
-        tid = next(self._tids)
-        await self._sub_op(osd, MOSDECSubOpWrite(
-            tid=tid, pg=pg, shard=shard, from_osd=self.id, oid=oid,
-            off=0, data=b"", attrs={}, epoch=self.epoch, delete=True,
-            guard=guard,
-        ), tid)
-
-    async def _pg_query(
-        self, pool, pg, shard, osd, since, want_objects: bool = False
-    ) -> MOSDPGInfo:
-        if osd == self.id:
-            raise ValueError("query self")
-        tid = next(self._tids)
-        return await self._sub_op(osd, MOSDPGQuery(
-            tid=tid, pg=pg, shard=shard, from_osd=self.id, since=since,
-            want_objects=want_objects, epoch=self.epoch,
-        ), tid)
-
-    async def _pg_log_send(self, pool, pg, shard, osd, entries, tail) -> None:
-        tid = next(self._tids)
-        await self._sub_op(osd, MOSDPGLog(
-            tid=tid, pg=pg, shard=shard, from_osd=self.id,
-            entries=entries, epoch=self.epoch, tail=tail,
-        ), tid)
-
-    def _spawn_peering(self, coro) -> None:
-        """Run a peering handler as its own task, strongly referenced
-        (the loop holds tasks weakly)."""
-        task = asyncio.ensure_future(coro)
-        tasks = getattr(self, "_peering_tasks", None)
-        if tasks is None:
-            tasks = self._peering_tasks = set()
-        tasks.add(task)
-        task.add_done_callback(tasks.discard)
-
-    async def _wait_for_epoch(self, epoch: int, timeout: float = 10.0) -> None:
-        """Peering messages are meaningful only at (or after) the
-        sender's epoch — the reference queues them behind map catch-up
-        (OSD::wait_for_new_map).  Without this, a primary splitting a
-        PG can query a peer that hasn't refiled yet, read an empty
-        child collection, and wrongly conclude the PG is clean."""
-        if self.epoch >= epoch:
-            return
-        try:
-            await self._request_map_fill()
-        except (ConnectionError, OSError):
-            pass
-        loop = asyncio.get_running_loop()
-        deadline = loop.time() + timeout
-        while (self.epoch < epoch and loop.time() < deadline
-               and not self.stopping):
-            await asyncio.sleep(0.05)
-
-    async def _handle_pg_query(self, msg: MOSDPGQuery) -> None:
-        await self._wait_for_epoch(msg.epoch)
-        pool = self.osdmap.get_pg_pool(msg.pg.pool)
-        c = self._shard_coll(pool, msg.pg, msg.shard)
-        lg = self._pg_log(c)
-        entries = [e.encode() for e in lg.entries_after(msg.since)]
-        objects: list[tuple[str, bytes]] = []
-        if msg.want_objects and self.store.collection_exists(c):
-            for name in self._local_objects(pool, msg.pg, msg.shard):
-                o = ghobject_t(name, shard=msg.shard)
-                try:
-                    v = self.store.getattr(c, o, VERSION_ATTR)
-                except (FileNotFoundError, KeyError):
-                    v = b""
-                objects.append((name, v))
-        import json as _json
-
-        if not self._past_acting_loaded:
-            self._load_past_acting()
-        chain = self._past_acting.get((msg.pg.pool, msg.pg.ps), [])
-        await msg.conn.send_message(MOSDPGInfo(
-            tid=msg.tid, pg=msg.pg, shard=msg.shard, from_osd=self.id,
-            last_update=lg.info.last_update, log_tail=lg.info.log_tail,
-            entries=entries, objects=objects, epoch=self.epoch,
-            past_acting=_json.dumps(chain).encode() if chain else b"",
-        ))
-
-    async def _handle_pg_log(self, msg: MOSDPGLog) -> None:
-        await self._wait_for_epoch(msg.epoch)
-        pool = self.osdmap.get_pg_pool(msg.pg.pool)
-        c = self._shard_coll(pool, msg.pg, msg.shard)
-        lg = self._pg_log(c)
-        t = Transaction()
-        self._ensure_coll(t, c)
-        lg.set_tail(t, msg.tail)
-        for raw in msg.entries:
-            e = pg_log_entry_t.decode(raw)
-            if e.version > lg.info.last_update:
-                lg.append(t, e)
-        lg.trim(t, self._log_keep)
-        if not t.empty():
-            self.store.queue_transaction(t)
-        await msg.conn.send_message(MOSDPGLogAck(
-            tid=msg.tid, pg=msg.pg, shard=msg.shard, from_osd=self.id,
-            result=0, epoch=self.epoch,
-        ))
-
-    async def _probe_shard(self, pool, pg, shard, osd, oid):
-        """Presence probe: zero-length read with attrs."""
-        if osd == self.id:
-            c = self._shard_coll(pool, pg, shard)
-            o = ghobject_t(oid, shard=shard)
-            if not self.store.exists(c, o):
-                return None, None
-            return b"", self.store.getattrs(c, o)
-        tid = next(self._tids)
-        rep = await self._sub_op(osd, MOSDECSubOpRead(
-            tid=tid, pg=pg, shard=shard, from_osd=self.id, oid=oid,
-            off=0, length=1, want_attrs=True, epoch=self.epoch,
-        ), tid)
-        if rep.result != 0:
-            return None, None
-        return rep.data, rep.attrs
-
-    async def _push(self, pool, pg, shard, osd, oid, payload, attrs,
-                    force: bool = False) -> None:
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        tid = next(self._tids)
-        self._push_waiters[tid] = fut
-        try:
-            conn = await self._osd_conn(osd)
-            await conn.send_message(MOSDPGPush(
-                pg=pg, shard=shard, from_osd=self.id,
-                pushes=[(oid, payload, attrs)], epoch=self.epoch,
-                force=force, tid=tid,
-            ))
-            await asyncio.wait_for(fut, SUBOP_TIMEOUT)
-        finally:
-            self._push_waiters.pop(tid, None)
-
-    # -- scrub (src/osd/scrubber/, simplified to one pass) -------------
-
-    async def _handle_scrub(self, msg: MOSDScrub) -> None:
-        import json
-
-        try:
-            report = await self.scrub_pg(
-                msg.pool, msg.ps, deep=msg.deep,
-                repair=getattr(msg, "repair", False))
-            reply = MOSDScrubReply(
-                tid=msg.tid, result=0, report=json.dumps(report).encode()
-            )
-        except Exception as e:
-            log.exception("osd.%d: scrub failed", self.id)
-            reply = MOSDScrubReply(
-                tid=msg.tid, result=-errno.EIO, report=str(e).encode()
-            )
-        try:
-            await msg.conn.send_message(reply)
-        except ConnectionError:
-            pass
-
-    async def scrub_pg(
-        self, pool_id: int, ps: int, deep: bool = False,
-        repair: bool = False,
-    ) -> dict:
-        """Consistency check of one PG across its acting set, CHUNKED so
-        client I/O interleaves (reference src/osd/scrubber/: chunked
-        scrubs that block writes only on the objects in the current
-        chunk).  Shallow compares object sets and versions; ``deep``
-        additionally verifies every shard payload's crc32c against the
-        stored HashInfo chain (or the parity equations for RMW'd
-        objects).  ``repair`` reconstructs bad shards from the
-        surviving ones afterwards — the `ceph pg repair` verb
-        (scrub_backend authoritative-copy repair role)."""
-        pool = self.osdmap.get_pg_pool(pool_id)
-        if pool is None:
-            return {"error": f"no pool {pool_id}"}
-        pg = pg_t(pool_id, ps)
-        _, _, acting, primary = self.osdmap.pg_to_up_acting_osds(pg, folded=True)
-        if primary != self.id:
-            return {"error": f"osd.{self.id} is not primary for {pool_id}.{ps}"}
-        pairs = self._pg_members(pool, acting)
-
-        # enumerate the object set (bulk; per-object state is probed
-        # fresh under the object lock as each chunk is scrubbed)
-        names: set[str] = set()
-        for s_, o_ in pairs:
-            if o_ == self.id:
-                names.update(self._local_objects(pool, pg, s_))
-            else:
-                try:
-                    info = await self._pg_query(
-                        pool, pg, s_, o_, since=ZERO, want_objects=True
-                    )
-                    names.update(n for n, _v in info.objects)
-                except (OSError, asyncio.TimeoutError, ConnectionError):
-                    pass
-        all_oids = sorted(names)
-
-        chunk_max = self.conf["osd_scrub_chunk_max"]
-        chunk_sleep = self.conf["osd_scrub_sleep"]
-        inconsistencies: list[dict] = []
-        for base in range(0, len(all_oids), chunk_max):
-            # one gate admission per chunk at best-effort weight:
-            # saturated client I/O outranks the scan (admission before
-            # the object locks, per the opqueue deadlock rule)
-            async with self.op_gate.admit("best_effort"):
-                for oid in all_oids[base : base + chunk_max]:
-                    async with self._obj_lock(pool.id, oid):
-                        inconsistencies.extend(
-                            await self._scrub_object(
-                                pool, pg, pairs, oid, deep)
-                        )
-            await asyncio.sleep(chunk_sleep)
-
-        repaired: list[str] = []
-        if repair and inconsistencies:
-            bad_oids = sorted({i["object"] for i in inconsistencies})
-            for oid in bad_oids:
-                # hold the object lock across re-verify + repair so a
-                # concurrent client write can neither be torn by the
-                # force-pushes nor produce a false inconsistency
-                async with self._obj_lock(pool.id, oid):
-                    incs = await self._scrub_object(
-                        pool, pg, pairs, oid, deep)
-                    if not incs:
-                        continue  # fixed itself (e.g. write raced scan)
-                    try:
-                        await self._repair_object(pool, pg, pairs, oid, incs)
-                        repaired.append(oid)
-                    except Exception:
-                        log.exception(
-                            "osd.%d: repair of %s/%s failed",
-                            self.id, pg, oid)
-            # re-verify: the report carries what survived repair
-            remaining: list[dict] = []
-            for oid in bad_oids:
-                async with self._obj_lock(pool.id, oid):
-                    remaining.extend(
-                        await self._scrub_object(pool, pg, pairs, oid, deep)
-                    )
-            inconsistencies = remaining
-        self._scrub_stamps[(pool_id, ps)] = (
-            time.monotonic(),
-            time.monotonic() if deep else
-            self._scrub_stamps.get((pool_id, ps), (0.0, 0.0))[1],
-        )
-        return {
-            "pg": f"{pool_id}.{ps}",
-            "acting": [o for _, o in pairs],
-            "objects": len(all_oids),
-            "deep": deep,
-            "repaired": repaired,
-            "inconsistencies": inconsistencies,
-        }
-
-    async def _scrub_object(
-        self, pool, pg, pairs, oid: str, deep: bool
-    ) -> list[dict]:
-        """One object's scrub checks (caller holds the object lock)."""
-        from ceph_tpu.native import crc32c
-
-        out: list[dict] = []
-        versions: dict[str, bytes | None] = {}
-        payloads: dict[int, bytes] = {}
-        hinfos: dict[int, bytes | None] = {}
-        crcs: dict[str, int] = {}
-        present = 0
-        for s, o in pairs:
-            key = f"{s}@osd.{o}"
-            if deep:
-                payload, attrs, _e = await self._read_shard_quiet(
-                    pool, pg, s, o, oid)
-            else:
-                try:
-                    payload, attrs = await self._probe_shard(
-                        pool, pg, s, o, oid)
-                except (OSError, asyncio.TimeoutError, ConnectionError):
-                    payload, attrs = None, None
-            if payload is None:
-                versions[key] = None
-                continue
-            present += 1
-            versions[key] = (attrs or {}).get(VERSION_ATTR, b"")
-            if deep:
-                crcs[key] = crc32c(payload)
-                payloads[s] = payload
-                hinfos[s] = (attrs or {}).get(HINFO_ATTR)
-        if present == 0:
-            return out  # deleted everywhere between listing and scrub
-        have = {k: v for k, v in versions.items() if v is not None}
-        if len(have) != len(pairs) or len(set(have.values())) > 1:
-            out.append({
-                "object": oid, "kind": "shallow",
-                "versions": {
-                    k: (v.decode() if v else None)
-                    for k, v in versions.items()
-                },
-            })
-            return out
-        if not deep:
-            return out
-        # deep: payload crc vs the stored HashInfo chain; RMW'd objects
-        # have no hinfo (the overwrite broke the append chain) — verify
-        # the parity equations instead by re-encoding the data shards
-        hinfo_raw = None
-        if pool.is_erasure() and hinfos:
-            chains = {h for h in hinfos.values() if h is not None}
-            if len(chains) == 1 and all(
-                h is not None for h in hinfos.values()
-            ):
-                hinfo_raw = chains.pop()
-                hi = ecutil.HashInfo.from_bytes(hinfo_raw)
-                for s, o in pairs:
-                    key = f"{s}@osd.{o}"
-                    if key not in crcs:
-                        continue
-                    want = hi.get_chunk_hash(s)
-                    if want != crcs[key]:
-                        out.append({
-                            "object": oid, "kind": "deep-crc",
-                            "member": key, "shard": s,
-                            "stored": want, "computed": crcs[key],
-                        })
-            elif chains:
-                out.append({
-                    "object": oid, "kind": "deep-hinfo-mismatch",
-                    "members": sorted(
-                        f"{s}" for s, h in hinfos.items() if h is not None
-                    ),
-                })
-        if pool.is_erasure() and hinfo_raw is None and payloads:
-            ec = self._ec_for(pool)
-            sinfo = self._sinfo(ec)
-            k = ec.get_data_chunk_count()
-            import numpy as _np
-
-            if all(s in payloads for s in range(k)) and len(payloads[0]):
-                chunks = {
-                    s: _np.frombuffer(payloads[s], _np.uint8)
-                    for s in range(k)
-                }
-                logical = ecutil.decode_concat(sinfo, ec, chunks)
-                expect = ecutil.encode(sinfo, ec, logical)
-                for s, payload in payloads.items():
-                    if s in expect and expect[s].tobytes() != payload:
-                        out.append({
-                            "object": oid, "kind": "deep-parity",
-                            "member": f"{s}", "shard": s,
-                        })
-        if not pool.is_erasure() and len(set(crcs.values())) > 1:
-            out.append({
-                "object": oid, "kind": "deep-replica-crc", "crcs": crcs,
-            })
-        return out
-
-    async def _repair_object(self, pool, pg, pairs, oid, incs) -> None:
-        """`pg repair`: rebuild the authoritative copy of a damaged
-        object and push it over the bad members (reference
-        scrub_backend authoritative-copy selection + repair_object)."""
-        kinds = {i["kind"] for i in incs}
-        if pool.is_erasure():
-            bad_shards = {
-                i["shard"] for i in incs if "shard" in i
-            }
-            if bad_shards and not kinds - {"deep-crc", "deep-parity"}:
-                # corrupt shard payloads at a consistent version:
-                # reconstruct from the k+ clean shards and push over
-                ec = self._ec_for(pool)
-                sinfo = self._sinfo(ec)
-                good = {}
-                src_attrs = None
-                for s, o in pairs:
-                    if s in bad_shards:
-                        continue
-                    payload, attrs, _e = await self._read_shard_quiet(
-                        pool, pg, s, o, oid)
-                    if payload is not None:
-                        import numpy as _np
-
-                        good[s] = _np.frombuffer(payload, _np.uint8)
-                        src_attrs = src_attrs or attrs
-                _t0 = time.perf_counter()
-                rebuilt = await ecutil.decode_shards_async(
-                    sinfo, ec, good, bad_shards,
-                    service=self.encode_service,
-                )
-                self.perf.inc("recovery_decode_seconds",
-                              time.perf_counter() - _t0)
-                self.perf.inc("recovery_decode_bytes",
-                              sum(v.nbytes for v in rebuilt.values()))
-                osd_of = dict(pairs)
-                await asyncio.gather(*(
-                    self._push(pool, pg, s, osd_of[s], oid,
-                               rebuilt[s].tobytes(), src_attrs or {},
-                               force=True)
-                    for s in bad_shards
-                ))
-                return
-        if "deep-replica-crc" in kinds:
-            # replicated payload divergence at one version: the
-            # majority crc wins (primary breaks ties) and is pushed
-            # over the minority — authoritative-copy selection
-            crcs = next(
-                i["crcs"] for i in incs if i["kind"] == "deep-replica-crc")
-            from collections import Counter
-
-            winner_crc, _n = Counter(crcs.values()).most_common(1)[0]
-            winner_key = next(
-                k for k, v in sorted(crcs.items()) if v == winner_crc)
-            ws, wo = winner_key.split("@osd.")
-            payload, attrs, _e = await self._read_shard_quiet(
-                pool, pg, int(ws), int(wo), oid)
-            if payload is None:
-                return
-            await asyncio.gather(*(
-                self._push(pool, pg, s, o, oid, payload, attrs or {},
-                           force=True)
-                for s, o in pairs
-                if crcs.get(f"{s}@osd.{o}") != winner_crc
-            ))
-            return
-        # version-level divergence (shallow / hinfo mismatch): the
-        # recovery reconciliation machinery is the repair (caller holds
-        # the object lock)
-        await self._reconcile_object(pool, pg, pairs, oid, have_lock=True)
-
-    async def _scrub_scheduler(self) -> None:
-        """Background scrub scheduling (reference
-        src/osd/scrubber/osd_scrub_sched.cc role): periodically scrub
-        the PG this OSD leads with the stalest stamp; deep scrubs on
-        their own (longer) cadence."""
-        interval = self.conf["osd_scrub_interval"]
-        deep_interval = self.conf["osd_deep_scrub_interval"]
-        if interval <= 0:
-            return
-        tick = max(0.05, min(interval, deep_interval or interval) / 4)
-        while not self.stopping:
-            await asyncio.sleep(tick)
-            try:
-                om = self.osdmap
-                if om is None:
-                    continue
-                now = time.monotonic()
-                due: list[tuple[float, int, int, bool]] = []
-                for pid, pool in om.pools.items():
-                    for ps in range(pool.pg_num):
-                        _u, _up, _a, primary = om.pg_to_up_acting_osds(
-                            pg_t(pid, ps), folded=True)
-                        if primary != self.id:
-                            continue
-                        if (pid, ps) not in self._scrub_stamps:
-                            # stamps are in-RAM (the reference persists
-                            # them in pg info): seed at first sight so a
-                            # restart doesn't deep-scrub everything at
-                            # once — first scrub lands one interval out
-                            self._scrub_stamps[(pid, ps)] = (now, now)
-                            continue
-                        last, last_deep = self._scrub_stamps[(pid, ps)]
-                        if deep_interval and now - last_deep > deep_interval:
-                            due.append((last_deep, pid, ps, True))
-                        elif now - last > interval:
-                            due.append((last, pid, ps, False))
-                # drain everything due this tick, stalest first, so
-                # configured intervals hold however many PGs we lead
-                for _stamp, pid, ps, deep in sorted(due):
-                    if self.stopping:
-                        break
-                    await self.scrub_pg(pid, ps, deep=deep)
-            except asyncio.CancelledError:
-                raise
-            except Exception:
-                log.exception("osd.%d: scheduled scrub failed", self.id)
-
-    async def _handle_push(self, msg: MOSDPGPush) -> None:
-        pool = self.osdmap.get_pg_pool(msg.pg.pool)
-        for oid, payload, attrs in msg.pushes:
-            # never regress: a write may have landed here between the
-            # primary's probe and this push (the reference serializes
-            # this with per-object rw locks; we reconcile on the next
-            # recovery pass instead)
-            c = self._shard_coll(pool, msg.pg, msg.shard)
-            o = ghobject_t(oid, shard=msg.shard)
-            local_v = self._object_version(c, o)
-            pushed_v = _v_parse(attrs.get(VERSION_ATTR))
-            if local_v > pushed_v and not msg.force:
-                continue
-            if local_v > pushed_v:
-                # divergent rollback: the newer local write is being
-                # rolled back cluster-wide; strip its log entries so
-                # dup detection stops vouching for it
-                t0 = Transaction()
-                self._pg_log(c).rollback_divergent(t0, oid, pushed_v)
-                if t0.ops:
-                    if getattr(self.store, "blocking_commit", False):
-                        await asyncio.to_thread(
-                            self.store.queue_transaction, t0)
-                    else:
-                        self.store.queue_transaction(t0)
-            # a push REPLACES the object: stale local attrs the source
-            # doesn't carry (e.g. a hinfo dropped by an RMW this member
-            # missed) must go, or deep scrub sees a phantom crc chain
-            stale_attrs = []
-            if self.store.exists(c, o):
-                stale_attrs = [
-                    n for n in self.store.getattrs(c, o) if n not in attrs
-                ]
-            await self._apply_shard_write_async(
-                pool, msg.pg, msg.shard, oid, payload, attrs,
-                rmattrs=stale_attrs,
-            )
-        await msg.conn.send_message(MOSDPGPushReply(
-            pg=msg.pg, shard=msg.shard, from_osd=self.id, epoch=self.epoch,
-            tid=msg.tid,
-        ))
-
-
-ECConnErrors = (ConnectionError, asyncio.TimeoutError)

@@ -1,0 +1,929 @@
+"""Recovery + peering-lite: reservation-gated PG recovery passes,
+object reconciliation, pushes, pg_query/pg_log exchange (the
+src/osd/PeeringState.cc + RecoveryBackend seam), split out of the
+daemon per the PGBackend seam layout."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+import numpy as np
+
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+from ceph_tpu.osd import ecutil
+from ceph_tpu.osd.pglog import (
+    DELETE,
+    PGMETA_OID,
+    ZERO,
+    eversion_t,
+    pg_log_entry_t,
+)
+from ceph_tpu.osd.types import PgPool, pg_t
+from ceph_tpu.store import Transaction, ghobject_t
+
+from ceph_tpu.msg.messages import (
+    MBackfillReserve,
+    MOSDECSubOpRead,
+    MOSDECSubOpWrite,
+    MOSDPGInfo,
+    MOSDPGLog,
+    MOSDPGLogAck,
+    MOSDPGPush,
+    MOSDPGPushReply,
+    MOSDPGQuery,
+)
+from ceph_tpu.osd.pgutil import (
+    NO_SHARD,
+    SIZE_ATTR,
+    SUBOP_TIMEOUT,
+    VERSION_ATTR,
+    _v_parse,
+)
+
+log = logging.getLogger("ceph_tpu.osd")
+
+
+class RecoveryMixin:
+    """Peering + recovery + backfill reservations — mixed into
+    OSDDaemon; state lives in the daemon's __init__."""
+
+    # -- recovery ------------------------------------------------------
+
+    async def _recover_all(self) -> None:
+        """After a map change: for every PG this OSD leads, reconstruct
+        missing shards/objects on the current acting set (the
+        do_recovery -> recover_object path, §3.3).  Re-runs until a
+        full pass has seen the newest map (epochs can land mid-pass).
+
+        PGs run concurrently, but admission is reservation-gated
+        (backfill_reservation.rst): each PG takes one of OUR
+        osd_max_backfills local slots, then one remote slot on every
+        acting peer (MBackfillReserve REQUEST/GRANT); a REJECT_TOOFULL
+        releases everything and retries after
+        osd_backfill_retry_interval, so cluster-wide concurrent
+        backfill load per OSD stays bounded.
+
+        A pass that leaves PGs unclean (a peer mid-restart, a dropped
+        connection) re-runs after osd_backfill_retry_interval even if
+        no new map arrives — the reference's recovery_request_timer
+        retry role.  Without it a transient error at the wrong moment
+        parks the PG in peering forever (found by the interleaving
+        fuzzer, tests/test_interleave_fuzz.py)."""
+        while not self.stopping:
+            done_epoch = self.epoch
+            # GC remote grants whose requesting primary is gone — a
+            # primary that died after GRANT can never send RELEASE
+            for key in list(self._remote_grants):
+                if not self.osdmap.is_up(key[2]):
+                    res = self._remote_grants.pop(key)
+                    res.release()
+            try:
+                om = self.osdmap
+                work: list[tuple[PgPool, pg_t, list[int]]] = []
+                for pid, pool in list(om.pools.items()):
+                    for ps in range(pool.pg_num):
+                        pg = pg_t(pid, ps)
+                        _, _, acting, primary = om.pg_to_up_acting_osds(
+                            pg, folded=True
+                        )
+                        if primary != self.id:
+                            continue
+                        work.append((pool, pg, acting))
+                if work:
+                    # return_exceptions: one PG's crash must neither
+                    # abort the pass (siblings would keep running
+                    # DETACHED with reservations held) nor mask the
+                    # others' completion
+                    results = await asyncio.gather(*[
+                        self._recover_pg_reserved(pool, pg, acting,
+                                                  done_epoch)
+                        for pool, pg, acting in work
+                    ], return_exceptions=True)
+                    for (_p, pg, _a), r in zip(work, results):
+                        if isinstance(r, asyncio.CancelledError):
+                            raise r
+                        if isinstance(r, BaseException):
+                            log.exception(
+                                "osd.%d: recovery of %s crashed",
+                                self.id, pg, exc_info=r)
+                if self.epoch != done_epoch:
+                    continue  # a map landed mid-pass: re-run now
+                incomplete = [
+                    pg for _pool, pg, _a in work
+                    if self._clean_epoch.get((pg.pool, pg.ps), -1)
+                    < done_epoch
+                ]
+                if not incomplete:
+                    return
+                log.info(
+                    "osd.%d: %d pgs unclean after pass; retrying",
+                    self.id, len(incomplete))
+                await asyncio.sleep(
+                    max(self.conf["osd_backfill_retry_interval"], 0.05))
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("osd.%d: recovery pass failed", self.id)
+                return
+
+    async def _recover_pg_reserved(
+        self, pool: PgPool, pg: pg_t, acting: list[int], pass_epoch: int,
+    ) -> None:
+        key = (pg.pool, pg.ps)
+        peers = sorted({
+            o for o in acting
+            if o != CRUSH_ITEM_NONE and o != self.id
+        })
+        retry = self.conf["osd_backfill_retry_interval"]
+        async with self.local_reserver.request(key, priority=1):
+            self.recovery_stats["peak_local"] = max(
+                self.recovery_stats["peak_local"],
+                self.local_reserver.in_use)
+            granted: list[int] = []
+            try:
+                while not self.stopping and self.epoch == pass_epoch:
+                    if await self._reserve_remotes(pg, peers, granted):
+                        break
+                    # partial holds across the retry sleep invite
+                    # cluster-wide deadlock (two primaries each camped
+                    # on one of the other's replicas): drop everything
+                    self.recovery_stats["reservation_rejects"] += 1
+                    await self._release_remotes(pg, granted)
+                    granted.clear()
+                    await asyncio.sleep(retry)
+                else:
+                    return
+                self._recovering_pgs.add(key)
+                try:
+                    ok = await self._recover_pg(pool, pg, acting)
+                    if ok:
+                        self._clean_epoch[key] = pass_epoch
+                        self.recovery_stats["pgs_recovered"] += 1
+                finally:
+                    self._recovering_pgs.discard(key)
+            finally:
+                await self._release_remotes(pg, granted)
+
+    async def _reserve_remotes(
+        self, pg: pg_t, peers: list[int], granted: list[int],
+    ) -> bool:
+        """GRANT from every acting peer, or False on REJECT_TOOFULL.
+
+        A peer the MAP says is down is skipped — it can take no
+        recovery load and no pushes will reach it.  A peer that is up
+        but unreachable counts as a REJECT: it may come back mid-
+        recovery and start absorbing pushes, so proceeding without its
+        slot would unbound its inbound backfill load; the retry loop
+        re-asks (either it answers, or it gets marked down — a new
+        epoch — and the pass restarts without it).  Either way a
+        best-effort RELEASE covers the race where the peer GRANTed but
+        the reply missed our timeout — without it the replica's slot
+        leaks until we restart."""
+        for o in peers:
+            tid = next(self._tids)
+            try:
+                rep = await self._sub_op(o, MBackfillReserve(
+                    tid=tid, op=MBackfillReserve.REQUEST, pool=pg.pool,
+                    ps=pg.ps, from_osd=self.id, priority=1,
+                ), tid)
+            except (OSError, asyncio.TimeoutError, ConnectionError):
+                if not self.osdmap.is_up(o):
+                    continue
+                await self._release_remotes(pg, [o])
+                return False
+            if rep.op == MBackfillReserve.GRANT:
+                granted.append(o)
+            else:
+                return False
+        return True
+
+    async def _release_remotes(self, pg: pg_t, granted: list[int]) -> None:
+        for o in granted:
+            try:
+                conn = await self._osd_conn(o)
+                await conn.send_message(MBackfillReserve(
+                    tid=next(self._tids), op=MBackfillReserve.RELEASE,
+                    pool=pg.pool, ps=pg.ps, from_osd=self.id,
+                ))
+            except (OSError, asyncio.TimeoutError, ConnectionError):
+                continue
+
+    async def _handle_backfill_reserve(self, msg: MBackfillReserve) -> None:
+        if msg.op == MBackfillReserve.REQUEST:
+            key = (msg.pool, msg.ps, msg.from_osd)
+            res = self.remote_reserver.try_request(key, msg.priority)
+            if res is not None:
+                self._remote_grants[key] = res
+                self.recovery_stats["peak_remote"] = max(
+                    self.recovery_stats["peak_remote"],
+                    self.remote_reserver.in_use)
+                op = MBackfillReserve.GRANT
+            else:
+                op = MBackfillReserve.REJECT_TOOFULL
+            await msg.conn.send_message(MBackfillReserve(
+                tid=msg.tid, op=op, pool=msg.pool, ps=msg.ps,
+                from_osd=self.id,
+            ))
+        elif msg.op == MBackfillReserve.RELEASE:
+            res = self._remote_grants.pop(
+                (msg.pool, msg.ps, msg.from_osd), None)
+            if res is not None:
+                res.release()
+        else:  # GRANT / REJECT_TOOFULL reply to our REQUEST
+            fut = self._waiters.get(msg.tid)
+            if fut and not fut.done():
+                fut.set_result(msg)
+
+    def _local_objects(self, pool, pg, shard) -> list[str]:
+        c = self._shard_coll(pool, pg, shard)
+        if not self.store.collection_exists(c):
+            return []
+        return sorted(
+            {o.name for o in self.store.collection_list(c)} - {PGMETA_OID}
+        )
+
+    def _pg_members(
+        self, pool: PgPool, acting: list[int]
+    ) -> list[tuple[int, int]]:
+        """(shard, osd) pairs of the acting set; replicated members all
+        use NO_SHARD collections."""
+        if pool.is_erasure():
+            return [
+                (s, o) for s, o in enumerate(acting) if o != CRUSH_ITEM_NONE
+            ]
+        return [(NO_SHARD, o) for o in acting if o != CRUSH_ITEM_NONE]
+
+    async def _recover_pg(self, pool: PgPool, pg: pg_t, acting: list[int]) -> bool:
+        """Peering-lite + recovery for one PG this OSD leads.
+
+        1. collect pg_info from every acting member (MOSDPGQuery);
+        2. adopt log entries from any member ahead of us (we may have
+           been the one that was down);
+        3. scope the object set: exact per-peer missing sets when the
+           log covers everyone (PGLog::proc_replica_log), full
+           backfill over the union of object lists otherwise;
+        4. reconcile each object to its newest version (reconstruct +
+           MOSDPGPush / replayed delete);
+        5. bring lagging members' logs current (MOSDPGLog).
+        """
+        pairs = self._pg_members(pool, acting)
+        if self.id not in [o for _, o in pairs]:
+            return True
+        # prior-set (PastIntervals role): still-up members of previous
+        # acting sets serve as extra data SOURCES — a fully-remapped PG
+        # pulls from its old home
+        prior = self._prior_pairs(pool, pg, pairs)
+        my_shard = next(s for s, o in pairs if o == self.id)
+        myc = self._shard_coll(pool, pg, my_shard)
+        lg = self._pg_log(myc)
+
+        peer_infos: dict[tuple[int, int], MOSDPGInfo] = {}
+        for s, o in pairs:
+            if o == self.id:
+                continue
+            try:
+                peer_infos[(s, o)] = await self._pg_query(
+                    pool, pg, s, o, since=lg.info.last_update
+                )
+            except (OSError, asyncio.TimeoutError, ConnectionError):
+                continue  # unreachable; next map change retries
+
+        # merge peers' witnessed interval chains into ours
+        # (PastIntervals sharing via pg info): a member that joined in
+        # a later interval learns the older homes it never saw
+        import json as _json
+
+        def _merge_chain(raw: bytes) -> bool:
+            if not raw:
+                return False
+            try:
+                chain = _json.loads(raw)
+            except ValueError:
+                return False
+            hist = self._past_acting.setdefault((pg.pool, pg.ps), [])
+            changed = False
+            for a in chain:
+                if a != acting and a not in hist:
+                    hist.append(a)
+                    del hist[:-16]
+                    changed = True
+            return changed
+
+        merged = False
+        for info in peer_infos.values():
+            merged |= _merge_chain(getattr(info, "past_acting", b""))
+        if merged:
+            self._save_past_acting()
+            prior = self._prior_pairs(pool, pg, pairs)
+
+        pre_adopt_lu = lg.info.last_update
+        ahead = [
+            i for i in peer_infos.values()
+            if i.last_update > lg.info.last_update
+        ]
+        gapped = False
+        if ahead:
+            best = max(ahead, key=lambda i: i.last_update)
+            # a peer whose log_tail moved past our state means its
+            # entries_after(our lu) delta has a hole: everything in the
+            # trimmed range must come from backfill, and our own log
+            # must admit the gap (set_tail) so covers() stays truthful
+            gapped = best.log_tail > pre_adopt_lu
+            t = Transaction()
+            self._ensure_coll(t, myc)
+            if gapped:
+                lg.set_tail(t, best.log_tail)
+            for raw in best.entries:
+                e = pg_log_entry_t.decode(raw)
+                if e.version > lg.info.last_update:
+                    lg.append(t, e)
+            lg.trim(t, self._log_keep)
+            if not t.empty():
+                self.store.queue_transaction(t)
+
+        # scope; prior intervals force the backfill enumeration — the
+        # data may live entirely on members our log knows nothing about
+        scope: set[str] | None = None if (gapped or prior) else set()
+        if scope is not None:
+            for info in peer_infos.values():
+                miss = lg.missing_from(info.last_update)
+                if miss is None:
+                    scope = None
+                    break
+                scope |= set(miss.items)
+        if ahead and scope is not None:
+            # entries adopted above may name objects my own shard lacks
+            for raw in max(ahead, key=lambda i: i.last_update).entries:
+                e = pg_log_entry_t.decode(raw)
+                scope.add(e.oid)
+        strays: set[str] = set()
+        if scope is None:
+            # backfill: reconcile the union of object lists, but the
+            # member with the newest pre-recovery state is authoritative
+            # for WHICH objects exist — an object only held by stale
+            # members is a stray (deleted while they were down), never
+            # resurrected (reference backfill removes strays the same
+            # way)
+            objs = set(self._local_objects(pool, pg, my_shard))
+            lists: dict[tuple[int, int], set[str]] = {
+                (my_shard, self.id): set(objs)
+            }
+            lus = {(my_shard, self.id): pre_adopt_lu}
+            worklist = [
+                ((s, o), None) for s, o in prior
+            ] + [(k, i) for k, i in peer_infos.items()]
+            chain_grew = False
+            queried: set[tuple[int, int]] = {(my_shard, self.id)}
+            qi = 0
+            while qi < len(worklist):
+                (s, o), info = worklist[qi]
+                qi += 1
+                if (s, o) in queried:
+                    continue
+                queried.add((s, o))
+                if o == self.id:
+                    # a past interval where WE held a different shard:
+                    # serve the listing locally (querying self raises)
+                    try:
+                        lists[(s, o)] = set(
+                            self._local_objects(pool, pg, s))
+                    except FileNotFoundError:
+                        continue
+                    lus[(s, o)] = self._pg_log(
+                        self._shard_coll(pool, pg, s)).info.last_update
+                    objs |= lists[(s, o)]
+                    continue
+                try:
+                    full = await self._pg_query(
+                        pool, pg, s, o, since=lg.info.last_update,
+                        want_objects=True,
+                    )
+                except (OSError, asyncio.TimeoutError, ConnectionError):
+                    continue
+                lists[(s, o)] = {oid for oid, _v in full.objects}
+                lus[(s, o)] = (
+                    info.last_update if info is not None
+                    else full.last_update
+                )
+                objs |= lists[(s, o)]
+                if _merge_chain(getattr(full, "past_acting", b"")):
+                    # chain-follow: the old home knew an even older one
+                    chain_grew = True
+                    prior = self._prior_pairs(pool, pg, pairs)
+                    for pair in prior:
+                        if pair not in queried:
+                            worklist.append((pair, None))
+                if info is None and full.last_update > lg.info.last_update:
+                    # adopt the prior member's log delta so ops from
+                    # the foreign interval (e.g. DELETEs) replay here
+                    # instead of the old state resurrecting
+                    t2 = Transaction()
+                    self._ensure_coll(t2, myc)
+                    if full.log_tail > lg.info.last_update:
+                        lg.set_tail(t2, full.log_tail)
+                    for raw in full.entries:
+                        e = pg_log_entry_t.decode(raw)
+                        if e.version > lg.info.last_update:
+                            lg.append(t2, e)
+                            objs.add(e.oid)
+                    lg.trim(t2, self._log_keep)
+                    if not t2.empty():
+                        self.store.queue_transaction(t2)
+            if chain_grew:
+                self._save_past_acting()  # one write after the drain
+            auth = max(lus, key=lambda k: lus[k])
+            strays = objs - lists[auth]
+        else:
+            objs = scope
+        all_ok = True
+        rsleep = self.conf["osd_recovery_sleep"]
+
+        async def _one(oid: str) -> bool:
+            # osd_recovery_max_active: in-flight reconciliations per
+            # daemon, across every concurrently-reserved PG; each one
+            # then admits through the mClock gate at recovery weight,
+            # so saturated client I/O overtakes it (admission strictly
+            # BEFORE the object lock — a lock holder must never wait
+            # on admission, or slots+locks could cycle)
+            async with self._recovery_budget:
+                async with self.op_gate.admit("recovery"):
+                    ok = await self._reconcile_object(
+                        pool, pg, pairs, oid, stray=oid in strays,
+                        prior_pairs=prior,
+                    )
+                if rsleep:
+                    await asyncio.sleep(rsleep)
+                return bool(ok)
+
+        results = await asyncio.gather(
+            *[_one(oid) for oid in sorted(objs)], return_exceptions=True,
+        )
+        for oid, r in zip(sorted(objs), results):
+            if isinstance(r, (OSError, asyncio.TimeoutError, ConnectionError)):
+                log.warning(
+                    "osd.%d: reconcile %s/%s interrupted: %r",
+                    self.id, pg, oid, r,
+                )
+                return False
+            if isinstance(r, BaseException):
+                raise r
+            all_ok &= r
+        # log sync
+        for (s, o), info in peer_infos.items():
+            if info.last_update >= lg.info.last_update:
+                continue
+            entries = [
+                e.encode() for e in lg.entries_after(info.last_update)
+            ]
+            try:
+                await self._pg_log_send(pool, pg, s, o, entries, lg.info.log_tail)
+            except (OSError, asyncio.TimeoutError, ConnectionError):
+                continue
+        # only a FULLY verified pass (every object confirmed on every
+        # target) may forget the prior intervals — a swallowed push
+        # failure must keep the old home reachable for the retry
+        if all_ok:
+            if self._past_acting.pop((pg.pool, pg.ps), None) is not None:
+                self._save_past_acting()
+        else:
+            log.warning(
+                "osd.%d: %s recovery pass incomplete; retaining past "
+                "intervals", self.id, pg)
+        return all_ok
+
+    async def _reconcile_object(
+        self, pool: PgPool, pg: pg_t, pairs: list[tuple[int, int]], oid: str,
+        stray: bool = False, have_lock: bool = False,
+        prior_pairs: list[tuple[int, int]] | None = None,
+    ) -> bool:
+        """Bring one object to its newest version on every acting
+        member: replay deletes, remove strays, reconstruct
+        stale/missing shards from the members holding the newest
+        version.
+
+        Serializes against client writes via the object lock — probing
+        mid-write would see a partial fan-out and wrongly roll it back
+        (``have_lock`` for callers inside the write path that already
+        hold it)."""
+        with self.tracer.span(
+            "recover_object", pg=str(pg), oid=oid,
+        ):
+            if not have_lock:
+                async with self._obj_lock(pool.id, oid):
+                    return await self._reconcile_object_locked(
+                        pool, pg, pairs, oid, stray, prior_pairs)
+            return await self._reconcile_object_locked(
+                pool, pg, pairs, oid, stray, prior_pairs)
+
+    async def _reconcile_object_locked(
+        self, pool: PgPool, pg: pg_t, pairs: list[tuple[int, int]], oid: str,
+        stray: bool = False,
+        prior_pairs: list[tuple[int, int]] | None = None,
+    ) -> bool:
+        """Returns True when the object verifiably reached every
+        target (False = retry on a later pass)."""
+        from ceph_tpu.common.fault_injector import FAULTS
+
+        await FAULTS.check("osd.recover_object")
+        is_ec = pool.is_erasure()
+        my_shard = next(s for s, o in pairs if o == self.id)
+        lg = self._pg_log(self._shard_coll(pool, pg, my_shard))
+        latest: pg_log_entry_t | None = None
+        for v in sorted(lg.entries, reverse=True):
+            if lg.entries[v].oid == oid:
+                latest = lg.entries[v]
+                break
+
+        state: dict[tuple[int, int], tuple[bool, eversion_t, dict]] = {}
+        for s, o in pairs:
+            try:
+                payload, attrs = await self._probe_shard(pool, pg, s, o, oid)
+            except (OSError, asyncio.TimeoutError, ConnectionError):
+                continue  # unreachable: not a source nor target now
+            if payload is None:
+                state[(s, o)] = (False, ZERO, {})
+            else:
+                state[(s, o)] = (
+                    True, _v_parse((attrs or {}).get(VERSION_ATTR)), attrs or {}
+                )
+        # prior-interval members: extra SOURCES (never targets) — data
+        # a full remap left on the old acting set
+        prior_state: dict[tuple[int, int], tuple[bool, eversion_t, dict]] = {}
+        for s, o in prior_pairs or ():
+            try:
+                payload, attrs = await self._probe_shard(pool, pg, s, o, oid)
+            except (OSError, asyncio.TimeoutError, ConnectionError):
+                continue
+            if payload is not None:
+                prior_state[(s, o)] = (
+                    True, _v_parse((attrs or {}).get(VERSION_ATTR)), attrs or {}
+                )
+
+        delete_entry = latest is not None and latest.op == DELETE
+        if delete_entry or (stray and latest is None):
+            # logged delete replay, or a backfill stray (only stale
+            # members hold it; its DELETE entry was trimmed)
+            guard = latest.version if latest else lg.info.last_update
+            for (s, o), (present, _v, _a) in state.items():
+                if present:
+                    await self._recovery_delete(pool, pg, s, o, oid, guard)
+            return True
+
+        all_state = {**prior_state, **state}
+        versions = [v for (p, v, _a) in all_state.values() if p]
+        if not versions:
+            return True  # nothing anywhere to recover from
+        vmax = max(versions)
+        sources = {
+            s: o for (s, o), (p, v, _a) in all_state.items()
+            if p and v == vmax
+        }
+        targets = [
+            (s, o) for (s, o), (p, v, _a) in state.items()
+            if not p or v < vmax
+        ]
+        if not targets:
+            return True
+        log.info(
+            "osd.%d: recovering %s/%s to %s on %s", self.id, pg, oid,
+            vmax, targets,
+        )
+        self.perf.inc("recovery_ops")
+        src_attrs = next(
+            a for (s, o), (p, v, a) in all_state.items() if p and v == vmax
+        )
+        if not is_ec:
+            s0, o0 = next(iter(sources.items()))
+            payload, _a, _e = await self._read_shard_quiet(
+                pool, pg, s0, o0, oid
+            )
+            if payload is None:
+                return False
+            results = await asyncio.gather(*(
+                self._push(pool, pg, s, o, oid, payload, src_attrs)
+                for s, o in targets
+            ), return_exceptions=True)  # a dead target must not abort
+            return not any(              # the rest of the recovery pass
+                isinstance(r, BaseException) for r in results)
+        ec = self._ec_for(pool)
+        sinfo = self._sinfo(ec)
+        k = ec.get_data_chunk_count()
+        force_push = False
+        if len(sources) < k:
+            # vmax is not reconstructible (a client write died mid
+            # fan-out): ROLL BACK to the newest version at least k
+            # shards agree on, overwriting the partial newer shards —
+            # the reference's divergent-entry rollback (PGLog merge_log)
+            # expressed at shard granularity.  The rolled-back write's
+            # log entries are stripped so a client retry re-applies it.
+            # rollback candidates come from the CURRENT interval only:
+            # prior-interval members hold old versions by definition,
+            # and letting them vote would roll back writes whose newer
+            # copies merely sit on temporarily-down current members
+            by_v: dict = {}
+            for (s, o), (p, v, _a) in state.items():
+                if p:
+                    by_v.setdefault(v, []).append((s, o))
+            candidates = [v for v, lst in by_v.items() if len(lst) >= k]
+            if not candidates:
+                log.error(
+                    "osd.%d: %s/%s unrecoverable: %d/%d consistent shards",
+                    self.id, pg, oid, len(sources), k,
+                )
+                return False
+            v_star = max(candidates)
+            log.warning(
+                "osd.%d: %s/%s rolling back %s -> %s (partial write)",
+                self.id, pg, oid, vmax, v_star,
+            )
+            vmax = v_star
+            sources = dict(by_v[v_star])
+            targets = [
+                (s, o) for (s, o), (p, v, _a) in state.items()
+                if not p or v != v_star
+            ]
+            src_attrs = next(
+                a for (s, o), (p, v, a) in state.items()
+                if p and v == v_star
+            )
+            force_push = True
+            t = Transaction()
+            self._ensure_coll(t, self._shard_coll(pool, pg, my_shard))
+            lg.rollback_divergent(t, oid, v_star)
+            if getattr(self.store, "blocking_commit", False):
+                await asyncio.to_thread(self.store.queue_transaction, t)
+            else:
+                self.store.queue_transaction(t)
+        need = {s for s, _ in targets}
+        # single-shard repair of a regenerating code: thread
+        # minimum_to_decode's (sub-chunk offset, count) runs down to
+        # ranged shard reads so only sub_chunk_no/q of each helper
+        # crosses the wire (reference ECCommon.cc:262-299 +
+        # ErasureCodeClay::repair_one_lost_chunk) — CLAY's whole point
+        repair_extents: dict[int, list[tuple[int, int]]] | None = None
+        if (
+            len(need) == 1 and ec.get_sub_chunk_count() > 1
+            and not getattr(self, "disable_subchunk_repair", False)
+        ):
+            try:
+                if ec.is_repair(need, set(sources)):
+                    minimum = ec.minimum_to_decode(need, set(sources))
+                    cs = sinfo.chunk_size
+                    sub = cs // ec.get_sub_chunk_count()
+                    size = int(src_attrs.get(SIZE_ATTR, b"0"))
+                    ns = max(
+                        1, sinfo.logical_to_next_chunk_offset(size) // cs
+                    )
+                    repair_extents = {
+                        s: [
+                            (stripe * cs + o * sub, c * sub)
+                            for stripe in range(ns)
+                            for o, c in runs
+                        ]
+                        for s, runs in minimum.items()
+                    }
+            except Exception:
+                repair_extents = None  # fall back to full-chunk reads
+        # helper-shard reads and shard pushes both fan out concurrently
+        # (the reference's ECSubRead/MOSDPGPush are fire-and-gather)
+        chunks: dict[int, np.ndarray] = {}
+        used_packed = False
+        if repair_extents is not None and set(repair_extents) <= set(sources):
+            src_items = [(s, sources[s]) for s in sorted(repair_extents)]
+            payloads = await asyncio.gather(*(
+                self._read_shard_quiet(
+                    pool, pg, s, o, oid, extents=repair_extents[s]
+                )
+                for s, o in src_items
+            ))
+            for (s, o), (payload, _a, _e) in zip(src_items, payloads):
+                if payload is not None:
+                    chunks[s] = np.frombuffer(payload, np.uint8)
+            if len(chunks) < len(repair_extents):
+                chunks = {}  # a helper vanished: retry with full reads
+            else:
+                used_packed = True
+        if not chunks:
+            src_items = list(sources.items())
+            payloads = await asyncio.gather(*(
+                self._read_shard_quiet(pool, pg, s, o, oid)
+                for s, o in src_items
+            ))
+            for (s, o), (payload, _a, _e) in zip(src_items, payloads):
+                if payload is not None:
+                    chunks[s] = np.frombuffer(payload, np.uint8)
+            if len(chunks) < k:
+                log.error(
+                    "osd.%d: %s/%s recovery aborted: %d/%d source reads "
+                    "succeeded", self.id, pg, oid, len(chunks), k,
+                )
+                return False
+        # the timed decode stage (BASELINE.md #5; reference
+        # ECBackend.cc:365-431 handle_recovery_read_complete): measured
+        # IN the running daemon, not inferred from microbenches
+        _t0 = time.perf_counter()
+        rebuilt = await ecutil.decode_shards_async(
+            sinfo, ec, chunks, need, packed_repair=used_packed,
+            service=self.encode_service,
+        )
+        self.perf.inc("recovery_decode_seconds",
+                      time.perf_counter() - _t0)
+        self.perf.inc("recovery_decode_bytes",
+                      sum(v.nbytes for v in rebuilt.values()))
+        results = await asyncio.gather(*(
+            self._push(pool, pg, s, o, oid, rebuilt[s].tobytes(), src_attrs,
+                       force=force_push)
+            for s, o in targets
+        ), return_exceptions=True)  # dead targets retry on the next pass
+        return not any(isinstance(r, BaseException) for r in results)
+
+    async def _recovery_delete(
+        self, pool, pg, shard, osd, oid, guard: eversion_t
+    ) -> None:
+        """Replay of a logged delete on a stale member (unlogged: the
+        log itself syncs separately).  ``guard`` protects a concurrent
+        re-create: members whose object is newer than the delete keep
+        it."""
+        if osd == self.id:
+            c = self._shard_coll(pool, pg, shard)
+            if self._object_version(c, ghobject_t(oid, shard=shard)) > guard:
+                return
+            await self._apply_shard_write_async(
+                pool, pg, shard, oid, b"", {}, delete=True
+            )
+            return
+        tid = next(self._tids)
+        await self._sub_op(osd, MOSDECSubOpWrite(
+            tid=tid, pg=pg, shard=shard, from_osd=self.id, oid=oid,
+            off=0, data=b"", attrs={}, epoch=self.epoch, delete=True,
+            guard=guard,
+        ), tid)
+
+    async def _pg_query(
+        self, pool, pg, shard, osd, since, want_objects: bool = False
+    ) -> MOSDPGInfo:
+        if osd == self.id:
+            raise ValueError("query self")
+        tid = next(self._tids)
+        return await self._sub_op(osd, MOSDPGQuery(
+            tid=tid, pg=pg, shard=shard, from_osd=self.id, since=since,
+            want_objects=want_objects, epoch=self.epoch,
+        ), tid)
+
+    async def _pg_log_send(self, pool, pg, shard, osd, entries, tail) -> None:
+        tid = next(self._tids)
+        await self._sub_op(osd, MOSDPGLog(
+            tid=tid, pg=pg, shard=shard, from_osd=self.id,
+            entries=entries, epoch=self.epoch, tail=tail,
+        ), tid)
+
+    def _spawn_peering(self, coro) -> None:
+        """Run a peering handler as its own task, strongly referenced
+        (the loop holds tasks weakly)."""
+        task = asyncio.ensure_future(coro)
+        tasks = getattr(self, "_peering_tasks", None)
+        if tasks is None:
+            tasks = self._peering_tasks = set()
+        tasks.add(task)
+        task.add_done_callback(tasks.discard)
+
+    async def _wait_for_epoch(self, epoch: int, timeout: float = 10.0) -> None:
+        """Peering messages are meaningful only at (or after) the
+        sender's epoch — the reference queues them behind map catch-up
+        (OSD::wait_for_new_map).  Without this, a primary splitting a
+        PG can query a peer that hasn't refiled yet, read an empty
+        child collection, and wrongly conclude the PG is clean."""
+        if self.epoch >= epoch:
+            return
+        try:
+            await self._request_map_fill()
+        except (ConnectionError, OSError):
+            pass
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while (self.epoch < epoch and loop.time() < deadline
+               and not self.stopping):
+            await asyncio.sleep(0.05)
+
+    async def _handle_pg_query(self, msg: MOSDPGQuery) -> None:
+        await self._wait_for_epoch(msg.epoch)
+        pool = self.osdmap.get_pg_pool(msg.pg.pool)
+        c = self._shard_coll(pool, msg.pg, msg.shard)
+        lg = self._pg_log(c)
+        entries = [e.encode() for e in lg.entries_after(msg.since)]
+        objects: list[tuple[str, bytes]] = []
+        if msg.want_objects and self.store.collection_exists(c):
+            for name in self._local_objects(pool, msg.pg, msg.shard):
+                o = ghobject_t(name, shard=msg.shard)
+                try:
+                    v = self.store.getattr(c, o, VERSION_ATTR)
+                except (FileNotFoundError, KeyError):
+                    v = b""
+                objects.append((name, v))
+        import json as _json
+
+        if not self._past_acting_loaded:
+            self._load_past_acting()
+        chain = self._past_acting.get((msg.pg.pool, msg.pg.ps), [])
+        await msg.conn.send_message(MOSDPGInfo(
+            tid=msg.tid, pg=msg.pg, shard=msg.shard, from_osd=self.id,
+            last_update=lg.info.last_update, log_tail=lg.info.log_tail,
+            entries=entries, objects=objects, epoch=self.epoch,
+            past_acting=_json.dumps(chain).encode() if chain else b"",
+        ))
+
+    async def _handle_pg_log(self, msg: MOSDPGLog) -> None:
+        await self._wait_for_epoch(msg.epoch)
+        pool = self.osdmap.get_pg_pool(msg.pg.pool)
+        c = self._shard_coll(pool, msg.pg, msg.shard)
+        lg = self._pg_log(c)
+        t = Transaction()
+        self._ensure_coll(t, c)
+        lg.set_tail(t, msg.tail)
+        for raw in msg.entries:
+            e = pg_log_entry_t.decode(raw)
+            if e.version > lg.info.last_update:
+                lg.append(t, e)
+        lg.trim(t, self._log_keep)
+        if not t.empty():
+            self.store.queue_transaction(t)
+        await msg.conn.send_message(MOSDPGLogAck(
+            tid=msg.tid, pg=msg.pg, shard=msg.shard, from_osd=self.id,
+            result=0, epoch=self.epoch,
+        ))
+
+    async def _probe_shard(self, pool, pg, shard, osd, oid):
+        """Presence probe: zero-length read with attrs."""
+        if osd == self.id:
+            c = self._shard_coll(pool, pg, shard)
+            o = ghobject_t(oid, shard=shard)
+            if not self.store.exists(c, o):
+                return None, None
+            return b"", self.store.getattrs(c, o)
+        tid = next(self._tids)
+        rep = await self._sub_op(osd, MOSDECSubOpRead(
+            tid=tid, pg=pg, shard=shard, from_osd=self.id, oid=oid,
+            off=0, length=1, want_attrs=True, epoch=self.epoch,
+        ), tid)
+        if rep.result != 0:
+            return None, None
+        return rep.data, rep.attrs
+
+    async def _push(self, pool, pg, shard, osd, oid, payload, attrs,
+                    force: bool = False) -> None:
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        tid = next(self._tids)
+        self._push_waiters[tid] = fut
+        try:
+            conn = await self._osd_conn(osd)
+            await conn.send_message(MOSDPGPush(
+                pg=pg, shard=shard, from_osd=self.id,
+                pushes=[(oid, payload, attrs)], epoch=self.epoch,
+                force=force, tid=tid,
+            ))
+            await asyncio.wait_for(fut, SUBOP_TIMEOUT)
+        finally:
+            self._push_waiters.pop(tid, None)
+    async def _handle_push(self, msg: MOSDPGPush) -> None:
+        pool = self.osdmap.get_pg_pool(msg.pg.pool)
+        for oid, payload, attrs in msg.pushes:
+            # never regress: a write may have landed here between the
+            # primary's probe and this push (the reference serializes
+            # this with per-object rw locks; we reconcile on the next
+            # recovery pass instead)
+            c = self._shard_coll(pool, msg.pg, msg.shard)
+            o = ghobject_t(oid, shard=msg.shard)
+            local_v = self._object_version(c, o)
+            pushed_v = _v_parse(attrs.get(VERSION_ATTR))
+            if local_v > pushed_v and not msg.force:
+                continue
+            if local_v > pushed_v:
+                # divergent rollback: the newer local write is being
+                # rolled back cluster-wide; strip its log entries so
+                # dup detection stops vouching for it
+                t0 = Transaction()
+                self._pg_log(c).rollback_divergent(t0, oid, pushed_v)
+                if t0.ops:
+                    if getattr(self.store, "blocking_commit", False):
+                        await asyncio.to_thread(
+                            self.store.queue_transaction, t0)
+                    else:
+                        self.store.queue_transaction(t0)
+            # a push REPLACES the object: stale local attrs the source
+            # doesn't carry (e.g. a hinfo dropped by an RMW this member
+            # missed) must go, or deep scrub sees a phantom crc chain
+            stale_attrs = []
+            if self.store.exists(c, o):
+                stale_attrs = [
+                    n for n in self.store.getattrs(c, o) if n not in attrs
+                ]
+            await self._apply_shard_write_async(
+                pool, msg.pg, msg.shard, oid, payload, attrs,
+                rmattrs=stale_attrs,
+            )
+        await msg.conn.send_message(MOSDPGPushReply(
+            pg=msg.pg, shard=msg.shard, from_osd=self.id, epoch=self.epoch,
+            tid=msg.tid,
+        ))
