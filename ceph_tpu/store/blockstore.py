@@ -116,23 +116,110 @@ class _Allocator:
         return sum(n for _o, n in self._free)
 
 
+class _BitmapAllocator:
+    """Bit-per-unit allocator (the BitmapAllocator role,
+    src/os/bluestore/BitmapAllocator.cc): same interface as the
+    first-fit extent list, different structure — O(1) free, scan
+    alloc with a rolling cursor so sequential workloads don't rescan
+    the device head every time."""
+
+    def __init__(self):
+        self._bits = bytearray()  # 1 = used
+        self.end_units = 0
+        self._cursor = 0
+
+    def _used(self, u: int) -> bool:
+        return bool(self._bits[u >> 3] & (1 << (u & 7)))
+
+    def _set(self, u: int, used: bool) -> None:
+        if used:
+            self._bits[u >> 3] |= 1 << (u & 7)
+        else:
+            self._bits[u >> 3] &= ~(1 << (u & 7))
+
+    def init_from_used(self, used: set[int], end_units: int) -> None:
+        self.end_units = end_units
+        self._bits = bytearray((end_units + 7) // 8)
+        for u in used:
+            self._set(u, True)
+        self._cursor = 0
+
+    def _grow(self, end: int) -> None:
+        if len(self._bits) * 8 < end:
+            self._bits.extend(b"\0" * ((end + 7) // 8 - len(self._bits)))
+        self.end_units = max(self.end_units, end)
+
+    def alloc(self, units: int) -> int:
+        for base in (self._cursor, 0):
+            run = 0
+            for u in range(base, self.end_units):
+                if self._used(u):
+                    run = 0
+                    continue
+                run += 1
+                if run == units:
+                    start = u - units + 1
+                    self._grow(u + 1)
+                    for v in range(start, u + 1):
+                        self._set(v, True)
+                    self._cursor = u + 1
+                    return start
+            if base == 0:
+                break
+        start = self.end_units
+        self._grow(start + units)
+        for v in range(start, start + units):
+            self._set(v, True)
+        self._cursor = start + units
+        return start
+
+    def free(self, off: int, units: int) -> None:
+        for u in range(off, off + units):
+            self._set(u, False)
+        self._cursor = min(self._cursor, off)
+
+    def free_units(self) -> int:
+        return sum(
+            1 for u in range(self.end_units) if not self._used(u))
+
+
 class BlockStore(ObjectStore):
     """ObjectStore over raw block space + a KeyValueDB (BlueStore role).
 
     kv column families: C collections, O object meta (size + extent
     map), X xattrs, M omap, R blob refcounts.  Object meta value is
     json: ``{"size": N, "extents": [[logical_off, blob_id, length], ...],
-    "inline": {"off": hex-bytes, ...}}``; blob id "unit:units:crc".
+    "inline": {"off": hex-bytes, ...}}``; blob id "unit:units:crc" or,
+    compressed at rest, "unit:units:crc:alg:stored_len" (crc over the
+    STORED bytes — verify before decompress, like BlueStore's
+    csum-then-decompress order).
+
+    ``compression``: a compressor plugin name ("zlib", ...) enables
+    transparent at-rest compression of non-inline blobs; a blob is
+    stored compressed only when it shrinks below
+    ``compression_required_ratio`` of the raw size (BlueStore's
+    bluestore_compression_required_ratio gate).  ``allocator`` selects
+    "first-fit" (extent list, Avl role) or "bitmap".
     """
 
-    def __init__(self, path: str, db=None):
+    def __init__(self, path: str, db=None, compression: str = "none",
+                 compression_required_ratio: float = 0.875,
+                 allocator: str = "first-fit"):
         self.path = path
         os.makedirs(path, exist_ok=True)
         self.db = db if db is not None else FileDB(os.path.join(path, "kv"))
         self._block_path = os.path.join(path, "block")
         self._fd: int | None = None
-        self._alloc = _Allocator()
+        self._alloc = (
+            _BitmapAllocator() if allocator == "bitmap" else _Allocator())
         self._txn_lock = threading.Lock()
+        self._compressor = None
+        if compression and compression != "none":
+            from ceph_tpu import compressor as _comp
+
+            self._compressor = _comp.create(compression)
+            self._comp_alg = compression
+        self._comp_ratio = compression_required_ratio
 
     blocking_commit = True
 
@@ -152,7 +239,7 @@ class BlockStore(ObjectStore):
         while it.valid():
             meta = json.loads(it.value())
             for _lo, blob, _ln in meta.get("extents", []):
-                unit, units, _crc = _parse_blob(blob)
+                unit, units = _parse_blob(blob)[:2]
                 used.update(range(unit, unit + units))
                 end = max(end, unit + units)
             it.next()
@@ -173,9 +260,9 @@ class BlockStore(ObjectStore):
         while it.valid():
             meta = json.loads(it.value())
             for lo, blob, ln in meta.get("extents", []):
-                unit, units, crc = _parse_blob(blob)
-                data = os.pread(self._fd, ln, unit * MIN_ALLOC)
-                if crc32c(data) != crc:
+                try:
+                    self._read_blob(blob, ln)
+                except BlobError:
                     bad.append({"okey": it.key(), "logical_off": lo,
                                 "blob": blob})
             it.next()
@@ -225,9 +312,9 @@ class BlockStore(ObjectStore):
             s, e = max(off, lo), min(end, hi)
             if s >= e:
                 continue
-            unit, units, crc = _parse_blob(blob)
-            data = os.pread(self._fd, ln, unit * MIN_ALLOC)
-            if crc32c(data) != crc:
+            try:
+                data = self._read_blob(blob, ln)
+            except BlobError:
                 # checksum-at-rest violation (or a benign stale-meta
                 # race the caller's retry loop disambiguates)
                 raise BlobError(5, f"checksum mismatch in {c}/{o} @ {lo}")
@@ -329,10 +416,34 @@ class BlockStore(ObjectStore):
     # blob helpers ------------------------------------------------------
 
     def _write_blob(self, data: bytes) -> str:
-        units = max(1, -(-len(data) // MIN_ALLOC))
+        stored = data
+        tag = ""
+        if self._compressor is not None and len(data) > INLINE_MAX:
+            comp = self._compressor.compress(data)
+            if len(comp) <= len(data) * self._comp_ratio:
+                stored = comp
+                tag = f":{self._comp_alg}:{len(comp)}"
+        units = max(1, -(-len(stored) // MIN_ALLOC))
         unit = self._alloc.alloc(units)
-        os.pwrite(self._fd, data, unit * MIN_ALLOC)
-        return f"{unit}:{units}:{crc32c(data)}"
+        os.pwrite(self._fd, stored, unit * MIN_ALLOC)
+        return f"{unit}:{units}:{crc32c(stored)}{tag}"
+
+    def _read_blob(self, blob: str, ln: int) -> bytes:
+        """pread + crc-verify (+ decompress) one blob; ``ln`` is the
+        logical (uncompressed) length the extent map records."""
+        unit, _units, crc, alg, stored_len = _parse_blob(blob)
+        data = os.pread(self._fd, stored_len if alg else ln,
+                        unit * MIN_ALLOC)
+        if crc32c(data) != crc:
+            raise BlobError(5, f"checksum mismatch in blob {blob}")
+        if alg:
+            if self._compressor is not None and alg == self._comp_alg:
+                data = self._compressor.decompress(data)
+            else:  # legacy blob from a differently-configured mount
+                from ceph_tpu import compressor as _comp
+
+                data = _comp.create(alg).decompress(data)
+        return data
 
     def _bump_blob(self, view: _TxnView, blob: str, by: int = 1) -> None:
         raw = view.get("R", blob)
@@ -350,7 +461,7 @@ class BlockStore(ObjectStore):
             view.set("R", blob, struct.pack("<I", refs - 1))
 
     def _deref_blob(self, blob: str) -> None:
-        unit, units, _crc = _parse_blob(blob)
+        unit, units = _parse_blob(blob)[:2]
         self._alloc.free(unit, units)
 
     # translation -------------------------------------------------------
@@ -477,10 +588,7 @@ class BlockStore(ObjectStore):
                 if s < e
             ]
             if edges:
-                unit, units, crc = _parse_blob(blob)
-                data = os.pread(self._fd, ln, unit * MIN_ALLOC)
-                if crc32c(data) != crc:
-                    raise BlobError(5, "checksum mismatch during overwrite")
+                data = self._read_blob(blob, ln)
                 for s, e in edges:
                     part = data[s - elo : e - elo]
                     if len(part) <= INLINE_MAX:
@@ -523,10 +631,7 @@ class BlockStore(ObjectStore):
             return False
         buf = bytearray(size)
         for lo, blob, ln in meta.get("extents", []):
-            unit, units, crc = _parse_blob(blob)
-            data = os.pread(self._fd, ln, unit * MIN_ALLOC)
-            if crc32c(data) != crc:
-                raise BlobError(5, "checksum mismatch during compaction")
+            data = self._read_blob(blob, ln)
             buf[lo : lo + ln] = data
             self._deref_blob_in_view(view, blob, freed)
         for hoff, hexdata in meta.get("inline", {}).items():
@@ -584,9 +689,15 @@ def _new_meta() -> dict:
     return {"size": 0, "extents": [], "inline": {}}
 
 
-def _parse_blob(blob: str) -> tuple[int, int, int]:
-    unit, units, crc = blob.split(":")
-    return int(unit), int(units), int(crc)
+def _parse_blob(blob: str) -> tuple[int, int, int, str, int]:
+    """(unit, units, crc, alg, stored_len); alg == "" for raw blobs
+    (3-field legacy ids stay readable — stored_len falls back to the
+    extent's logical length at the read site)."""
+    parts = blob.split(":")
+    unit, units, crc = int(parts[0]), int(parts[1]), int(parts[2])
+    if len(parts) == 5:
+        return unit, units, crc, parts[3], int(parts[4])
+    return unit, units, crc, "", 0
 
 
 # the structural validation rules are identical to KStore's
