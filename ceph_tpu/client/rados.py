@@ -219,9 +219,22 @@ class RadosClient:
             if remaining <= 0:
                 return
             try:
-                await asyncio.wait_for(ev.wait(), remaining)
+                # wake at least once a second to RENEW the subscription
+                # (MonClient's sub renewal): a subscribe that landed on
+                # a mon mid-election can be forgotten, and without the
+                # renewal no map would ever arrive
+                await asyncio.wait_for(ev.wait(), min(remaining, 1.0))
             except asyncio.TimeoutError:
-                return
+                if deadline - loop.time() <= 0:
+                    return
+                try:
+                    if self._mon_conn is not None:
+                        await self._mon_conn.send_message(MMonSubscribe(
+                            start_epoch=(
+                                self.osdmap.epoch if self.osdmap else 0)
+                        ))
+                except (ConnectionError, OSError):
+                    pass  # the hunt task is re-homing us
 
     # -- admin commands ------------------------------------------------
 

@@ -1,0 +1,6 @@
+"""Manager-module services (the src/pybind/mgr/ role).
+
+The always-on mgr functions — PG-stat aggregation, health, balancer,
+pg_autoscaler, prometheus text — live in the monitor process
+(ceph_tpu/mon/monitor.py, ceph_tpu/common/metrics.py); this package
+holds the optional module services: the dashboard (dashboard.py)."""

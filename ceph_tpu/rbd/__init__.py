@@ -676,12 +676,12 @@ class Image:
             later = [
                 s for s in self.snaps.values() if s["id"] > info["id"]
             ]
-            maps = [
-                await ObjectMap(
+            maps = list(await asyncio.gather(*(
+                ObjectMap(
                     self.rbd.meta, self.name,
                     self._n_objs(s["size"]), s["id"]).load()
                 for s in sorted(later, key=lambda s: s["id"])
-            ] + [self.objmap]
+            ))) + [self.objmap]
             changed = set()
             for m in maps:
                 changed.update(m.diff(since))

@@ -103,6 +103,11 @@ class MirrorDaemon:
             await img.demote()
             img.primary = True  # temporarily, for the initial copy
             try:
+                if img.size() != src_img.size():
+                    # the source grew/shrank since a crashed attempt
+                    # created dst — without this every resumed copy
+                    # past the stale size fails forever
+                    await img.resize(src_img.size())
                 step = img.obj_size
                 for off in range(0, src_img.size(), step):
                     n = min(step, src_img.size() - off)

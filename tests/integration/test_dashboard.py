@@ -1,0 +1,67 @@
+"""Dashboard mgr module: read-only web UI + REST over the mon
+(src/pybind/mgr/dashboard role)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ceph_tpu.mgr.dashboard import Dashboard
+
+from .test_mini_cluster import Cluster, run
+
+
+async def _get(addr, path: str) -> tuple[int, bytes]:
+    reader, writer = await asyncio.open_connection(*addr)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status = int(head.split(b" ")[1])
+    return status, body
+
+
+class TestDashboard:
+    def test_endpoints(self):
+        async def go():
+            async with Cluster(n_osds=3) as c:
+                await c.client.pool_create("viz", pg_num=4, size=2)
+                io = c.client.ioctx("viz")
+                await io.write_full("o", b"x" * 100)
+                await c.client.wait_clean(timeout=30)
+                dash = Dashboard(c.mon)
+                addr = await dash.start()
+                try:
+                    code, body = await _get(addr, "/")
+                    assert code == 200
+                    assert b"cluster dashboard" in body
+                    assert b"viz" in body
+
+                    code, body = await _get(addr, "/api/health")
+                    assert code == 200
+                    assert json.loads(body)["status"].startswith("HEALTH")
+
+                    code, body = await _get(addr, "/api/pools")
+                    pools = json.loads(body)
+                    assert any(p["name"] == "viz" and p["pg_num"] == 4
+                               for p in pools)
+
+                    code, body = await _get(addr, "/api/osds")
+                    osds = json.loads(body)
+                    assert len(osds) == 3
+                    assert all(o["up"] and o["in"] for o in osds)
+                    assert all(o["host"].startswith("host") for o in osds)
+
+                    code, body = await _get(addr, "/api/pg")
+                    assert code == 200
+
+                    code, body = await _get(addr, "/metrics")
+                    assert code == 200
+
+                    code, _ = await _get(addr, "/nope")
+                    assert code == 404
+                finally:
+                    await dash.stop()
+
+        run(go())

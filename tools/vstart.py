@@ -78,12 +78,21 @@ async def amain(args) -> int:
     spec = ",".join(f"{h}:{p}" for h, p in monmap)
     print(f"vstart: cluster up — mons at {spec}", flush=True)
     print(f"vstart: try  python tools/ceph.py -m {spec} status", flush=True)
+    dash = None
+    if args.dashboard:
+        from ceph_tpu.mgr.dashboard import Dashboard
+
+        dash = Dashboard(mons[0])
+        dh, dp = await dash.start(port=args.dashboard_port)
+        print(f"vstart: dashboard at http://{dh}:{dp}/", flush=True)
     try:
         while True:
             await asyncio.sleep(3600)
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
+        if dash is not None:
+            await dash.stop()
         for o in osds:
             await o.stop()
         for m in mons:
@@ -110,6 +119,13 @@ def main(argv=None) -> int:
              "block = BlockStore (extents + checksums-at-rest, the "
              "BlueStore-grade engine)",
     )
+    ap.add_argument(
+        "--dashboard", action="store_true",
+        help="serve the read-only web dashboard from the rank-0 mon "
+             "(ceph_tpu/mgr/dashboard.py)",
+    )
+    ap.add_argument("--dashboard-port", type=int, default=0,
+                    help="dashboard port (default: ephemeral)")
     args = ap.parse_args(argv)
     try:
         return asyncio.run(amain(args))
