@@ -176,6 +176,7 @@ class Dashboard:
                 if line in (b"\r\n", b"\n", b""):
                     break
             path = req.split(b" ")[1].decode() if b" " in req else "/"
+            path = path.split("?", 1)[0]  # tolerate query strings
             try:
                 body, ctype = await self._api(path)
                 status = b"200 OK"

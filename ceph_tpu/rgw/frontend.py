@@ -8,7 +8,9 @@ the REST op dispatch in rgw_op.cc / rgw_rest_s3.cc, for path-style S3:
     DELETE /bucket                 DeleteBucket
     GET    /bucket?list-type=2     ListObjectsV2
     GET    /bucket?uploads         ListMultipartUploads (stub: empty)
+    POST   /bucket?delete          DeleteObjects (batch)
     PUT    /bucket/key             PutObject | UploadPart (partNumber&uploadId)
+                                   | CopyObject (x-amz-copy-source)
     GET    /bucket/key             GetObject (Range) | ListParts (uploadId)
     HEAD   /bucket/key             HeadObject
     DELETE /bucket/key             DeleteObject | AbortMultipart (uploadId)
@@ -16,7 +18,9 @@ the REST op dispatch in rgw_op.cc / rgw_rest_s3.cc, for path-style S3:
     POST   /bucket/key?uploadId=X  CompleteMultipartUpload
 
 Every request is SigV4-authenticated against the user records in the
-store (rgw_auth_s3.cc); errors render as S3 XML error bodies.
+store (rgw_auth_s3.cc) — header auth or presigned query auth — and
+x-amz-meta-* user metadata round-trips through put/copy/get/head;
+errors render as S3 XML error bodies.
 """
 
 from __future__ import annotations
@@ -167,15 +171,30 @@ class S3Frontend:
 
     async def _authenticate(self, req: _HTTPRequest) -> None:
         auth_hdr = req.headers.get("authorization", "")
-        if not auth_hdr:
-            raise RGWError("AccessDenied", 403, "anonymous access denied")
         try:
-            parsed = sigv4.parse_authorization(auth_hdr)
-            user = await self.store.get_user_by_access_key(parsed.access_key)
-            if user is None:
-                raise RGWError("InvalidAccessKeyId", 403, parsed.access_key)
-            sigv4.verify(req.method, req.path, req.query, req.headers,
-                         req.body, user["secret_key"])
+            if not auth_hdr and "X-Amz-Signature" in req.params:
+                # presigned URL: auth rides the query string
+                parsed = sigv4.parse_presigned_query(req.query)
+                user = await self.store.get_user_by_access_key(
+                    parsed.access_key)
+                if user is None:
+                    raise RGWError(
+                        "InvalidAccessKeyId", 403, parsed.access_key)
+                sigv4.verify_presigned(
+                    req.method, req.path, req.query, req.headers,
+                    user["secret_key"])
+            elif auth_hdr:
+                parsed = sigv4.parse_authorization(auth_hdr)
+                user = await self.store.get_user_by_access_key(
+                    parsed.access_key)
+                if user is None:
+                    raise RGWError(
+                        "InvalidAccessKeyId", 403, parsed.access_key)
+                sigv4.verify(req.method, req.path, req.query, req.headers,
+                             req.body, user["secret_key"])
+            else:
+                raise RGWError("AccessDenied", 403,
+                               "anonymous access denied")
         except sigv4.SigV4Error as e:
             raise RGWError(e.code, 403, str(e))
         req.uid = user["uid"]
@@ -235,7 +254,42 @@ class S3Frontend:
                             _xml("Bucket", text=name))
                 return 200, {"content-type": "application/xml"}, _render(root)
             return await self._list_objects_v2(req, bucket)
+        if req.method == "POST" and "delete" in req.params:
+            return await self._batch_delete(req, name)
         raise RGWError("MethodNotAllowed", 405, req.method)
+
+    async def _batch_delete(self, req, name: str) -> tuple[int, dict, bytes]:
+        """POST /bucket?delete — DeleteObjects (RGWDeleteMultiObj,
+        rgw_op.cc): up to 1000 keys per request, per-key outcome."""
+        bucket = await self.store.get_bucket(name)
+        try:
+            root = ET.fromstring(req.body)
+        except ET.ParseError:
+            raise RGWError("MalformedXML", 400, "bad Delete body")
+        quiet = any(
+            c.tag.endswith("Quiet") and (c.text or "").lower() == "true"
+            for c in root)
+        keys = []
+        for obj in root:
+            if not obj.tag.endswith("Object"):
+                continue
+            for child in obj:
+                if child.tag.endswith("Key") and child.text:
+                    keys.append(child.text)
+        if len(keys) > 1000:
+            raise RGWError("MalformedXML", 400, "over 1000 keys")
+        out = _xml("DeleteResult")
+        for key in keys:
+            try:
+                await self.store.delete_object(bucket, key)
+                if not quiet:
+                    out.append(_xml("Deleted", _xml("Key", text=key)))
+            except RGWError as e:
+                out.append(_xml(
+                    "Error", _xml("Key", text=key),
+                    _xml("Code", text=e.code),
+                ))
+        return 200, {"content-type": "application/xml"}, _render(out)
 
     async def _list_objects_v2(self, req, bucket) -> tuple[int, dict, bytes]:
         prefix = req.params.get("prefix", "")
@@ -278,8 +332,12 @@ class S3Frontend:
         if req.method == "PUT":
             if "partnumber" in {k.lower() for k in req.params}:
                 return await self._upload_part(req, bucket, key)
+            if "x-amz-copy-source" in req.headers:
+                return await self._copy_object(req, bucket, key)
             ct = req.headers.get("content-type", "binary/octet-stream")
-            meta = await self.store.put_object(bucket, key, req.body, ct)
+            meta = await self.store.put_object(
+                bucket, key, req.body, ct,
+                user_meta=_user_meta_headers(req.headers))
             return 200, {"etag": f"\"{meta['etag']}\""}, b""
         if req.method == "POST":
             if "uploads" in req.params:
@@ -345,7 +403,43 @@ class S3Frontend:
             "content-type": meta.get("content_type", "binary/octet-stream"),
             "accept-ranges": "bytes",
         })
+        for k, v in meta.get("user_meta", {}).items():
+            resp_headers[f"x-amz-meta-{k}"] = v
         return status, resp_headers, body
+
+    async def _copy_object(self, req, bucket, key):
+        """PUT with x-amz-copy-source (RGWCopyObj, rgw_op.cc): server-
+        side copy, metadata COPY by default or REPLACE per the
+        x-amz-metadata-directive header."""
+        src = urllib.parse.unquote(req.headers["x-amz-copy-source"])
+        src = src.lstrip("/")
+        if "/" not in src:
+            raise RGWError("InvalidArgument", 400, "bad copy source")
+        src_bucket_name, src_key = src.split("/", 1)
+        src_bucket = await self.store.get_bucket(src_bucket_name)
+        try:
+            src_meta, data = await self.store.get_object(
+                src_bucket, src_key)
+        except RGWError as e:
+            if e.code == "NoSuchKey":
+                raise RGWError("NoSuchKey", 404, src)
+            raise
+        directive = req.headers.get(
+            "x-amz-metadata-directive", "COPY").upper()
+        if directive == "REPLACE":
+            ct = req.headers.get("content-type", "binary/octet-stream")
+            um = _user_meta_headers(req.headers)
+        else:
+            ct = src_meta.get("content_type", "binary/octet-stream")
+            um = src_meta.get("user_meta", {})
+        meta = await self.store.put_object(
+            bucket, key, data, ct, user_meta=um)
+        out = _xml(
+            "CopyObjectResult",
+            _xml("ETag", text=f"\"{meta['etag']}\""),
+            _xml("LastModified", text=meta["mtime"]),
+        )
+        return 200, {"content-type": "application/xml"}, _render(out)
 
     async def _upload_part(self, req, bucket, key):
         params = {k.lower(): v for k, v in req.params.items()}
@@ -353,6 +447,31 @@ class S3Frontend:
         if not upload_id:
             raise RGWError("InvalidArgument", 400, "uploadId required")
         part_num = _int_param(params.get("partnumber", "0"), "partNumber")
+        if "x-amz-copy-source" in req.headers:
+            # UploadPartCopy (RGWCopyObj in multipart mode): the part
+            # body comes from an existing object, optionally ranged
+            src = urllib.parse.unquote(
+                req.headers["x-amz-copy-source"]).lstrip("/")
+            if "/" not in src:
+                raise RGWError("InvalidArgument", 400, "bad copy source")
+            src_bucket_name, src_key = src.split("/", 1)
+            src_bucket = await self.store.get_bucket(src_bucket_name)
+            src_meta = await self.store.head_object(src_bucket, src_key)
+            off, length = 0, None
+            crange = req.headers.get("x-amz-copy-source-range", "")
+            if crange:
+                off, end_incl = _parse_range(crange, src_meta["size"])
+                length = end_incl - off + 1
+            _m, data = await self.store.get_object(
+                src_bucket, src_key, off, length)
+            etag = await self.store.upload_part(
+                bucket, key, upload_id, part_num, data)
+            out = _xml(
+                "CopyPartResult",
+                _xml("ETag", text=f"\"{etag}\""),
+                _xml("LastModified", text=src_meta["mtime"]),
+            )
+            return 200, {"content-type": "application/xml"}, _render(out)
         etag = await self.store.upload_part(
             bucket, key, upload_id, part_num, req.body)
         return 200, {"etag": f"\"{etag}\""}, b""
@@ -387,6 +506,15 @@ class S3Frontend:
             _xml("ETag", text=f"\"{meta['etag']}\""),
         )
         return 200, {"content-type": "application/xml"}, _render(out)
+
+
+def _user_meta_headers(headers: dict[str, str]) -> dict[str, str]:
+    """x-amz-meta-* request headers -> the user-metadata dict stored
+    alongside the object (RGW_ATTR_META_PREFIX role)."""
+    return {
+        k[len("x-amz-meta-"):]: v
+        for k, v in headers.items() if k.startswith("x-amz-meta-")
+    }
 
 
 def _int_param(value: str, name: str) -> int:

@@ -5,8 +5,10 @@ The reference implements SigV4 in src/rgw/rgw_auth_s3.cc
 get_v4_signature); this is the same algorithm over the header-auth
 path: canonical request -> string-to-sign -> HMAC signing-key chain.
 Supported: path-style requests, ``x-amz-content-sha256`` payload hash
-(including UNSIGNED-PAYLOAD).  Not supported (rejected cleanly):
-presigned query auth, chunked (STREAMING-*) payloads.
+(including UNSIGNED-PAYLOAD), and presigned query auth
+(X-Amz-Signature in the query string, rgw_auth_s3.cc's
+AWSv4ComplSingle presigned branch).  Not supported (rejected
+cleanly): chunked (STREAMING-*) payloads.
 
 Both sides live here: :func:`sign_request` for clients/tests and
 :func:`verify` for the gateway, so the test exercises a real
@@ -164,6 +166,93 @@ def verify(
     expect = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
     if not hmac.compare_digest(expect, auth.signature):
         raise SigV4Error("SignatureDoesNotMatch", "signature mismatch")
+
+
+def parse_presigned_query(query: str) -> ParsedAuth:
+    """Extract the SigV4 fields from a presigned URL's query string."""
+    params = dict(urllib.parse.parse_qsl(query, keep_blank_values=True))
+    if params.get("X-Amz-Algorithm") != ALGORITHM:
+        raise SigV4Error("InvalidArgument", "unsupported query algorithm")
+    try:
+        cred = params["X-Amz-Credential"].split("/")
+        access_key, date, region, service, term = cred
+        if term != "aws4_request":
+            raise ValueError
+        return ParsedAuth(
+            access_key=access_key, date=date, region=region,
+            service=service,
+            signed_headers=params["X-Amz-SignedHeaders"].split(";"),
+            signature=params["X-Amz-Signature"],
+        )
+    except (KeyError, ValueError):
+        raise SigV4Error("InvalidArgument", "malformed presigned query")
+
+
+def verify_presigned(
+    method: str, path: str, query: str, headers: dict[str, str],
+    secret: str, *, now: float | None = None,
+) -> None:
+    """Presigned-URL verification: the signature covers the query
+    minus X-Amz-Signature, the payload is UNSIGNED, and freshness is
+    X-Amz-Date + X-Amz-Expires (not MAX_SKEW)."""
+    import calendar
+    import time as _time
+
+    auth = parse_presigned_query(query)
+    params = dict(urllib.parse.parse_qsl(query, keep_blank_values=True))
+    amz_date = params.get("X-Amz-Date", "")
+    if not amz_date.startswith(auth.date):
+        raise SigV4Error("SignatureDoesNotMatch", "date/scope mismatch")
+    try:
+        req_time = calendar.timegm(
+            _time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+        expires = int(params.get("X-Amz-Expires", "0"))
+    except ValueError:
+        raise SigV4Error("InvalidArgument", "bad presigned date/expiry")
+    if not 0 < expires <= 7 * 86400:  # AWS caps presign at one week
+        raise SigV4Error("InvalidArgument", f"bad X-Amz-Expires {expires}")
+    t = _time.time() if now is None else now
+    if t < req_time - MAX_SKEW or t > req_time + expires:
+        raise SigV4Error("AccessDenied", "presigned URL expired")
+    unsigned_query = urllib.parse.urlencode(sorted(
+        (k, v) for k, v in params.items() if k != "X-Amz-Signature"
+    ), quote_via=urllib.parse.quote)
+    sts = _string_to_sign(
+        method, path, unsigned_query, headers, auth.signed_headers,
+        UNSIGNED, amz_date, auth.scope,
+    )
+    key = _signing_key(secret, auth.date, auth.region, auth.service)
+    expect = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+    if not hmac.compare_digest(expect, auth.signature):
+        raise SigV4Error("SignatureDoesNotMatch", "signature mismatch")
+
+
+def presign_url(
+    method: str, path: str, host: str, access_key: str, secret: str,
+    *, amz_date: str, expires: int = 3600, region: str = "us-east-1",
+    extra_params: dict[str, str] | None = None,
+) -> str:
+    """Client side: a path + query string granting time-limited access
+    (the `aws s3 presign` role)."""
+    date = amz_date[:8]
+    scope = f"{date}/{region}/s3/aws4_request"
+    params = {
+        "X-Amz-Algorithm": ALGORITHM,
+        "X-Amz-Credential": f"{access_key}/{scope}",
+        "X-Amz-Date": amz_date,
+        "X-Amz-Expires": str(expires),
+        "X-Amz-SignedHeaders": "host",
+        **(extra_params or {}),
+    }
+    query = urllib.parse.urlencode(
+        sorted(params.items()), quote_via=urllib.parse.quote)
+    sts = _string_to_sign(
+        method, path, query, {"host": host}, ["host"], UNSIGNED,
+        amz_date, scope,
+    )
+    key = _signing_key(secret, date, region, "s3")
+    sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+    return f"{path}?{query}&X-Amz-Signature={sig}"
 
 
 def sign_request(

@@ -263,6 +263,7 @@ class RGWStore:
     async def put_object(
         self, bucket: dict, key: str, data: bytes,
         content_type: str = "binary/octet-stream",
+        user_meta: dict[str, str] | None = None,
     ) -> dict:
         io = self._data_io(bucket)
         head_oid = self._head_oid(bucket, key)
@@ -287,6 +288,8 @@ class RGWStore:
                 "head_size": min(len(data), self.chunk_size),
                 "manifest": manifest,
             }
+            if user_meta:
+                meta["user_meta"] = user_meta
             await io.operate(head_oid, ObjectOperation()
                              .write_full(data[:self.chunk_size])
                              .setxattr("rgw.meta", json.dumps(meta).encode()))

@@ -90,6 +90,13 @@ class TestCephadmLifecycle:
                 await cl.wait_clean(timeout=90)
                 for i in range(6):
                     assert await io.read(f"o{i}") == bytes([i]) * 2048
+                # the added osd got a CRUSH location (add-osd runs the
+                # create-or-move step) — it is genuinely placeable,
+                # not just 'up'
+                crush = cl.osdmap.crush
+                h3 = crush.bucket_names.get("host3")
+                assert h3 is not None
+                assert 3 in crush.buckets[h3].items
                 await cl.shutdown()
 
             asyncio.new_event_loop().run_until_complete(verify_phase())

@@ -233,7 +233,10 @@ class TestSnapsUnderThrash:
                         snaps.append((snapid, dict(state)))
 
                 await asyncio.gather(work(), churn())
-                await asyncio.sleep(1.5)
+                # settle deterministically: a fixed sleep was load-
+                # sensitive (revived members may still be recovering
+                # on a contended core when the reads start)
+                await c.client.wait_clean(timeout=90)
                 # every snapshot still reads exactly what it captured
                 for snapid, expect in snaps:
                     io.snap_set_read(snapid)

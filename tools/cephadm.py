@@ -260,8 +260,49 @@ def cmd_add_osd(args) -> int:
     spec["osds"].append(new_id)
     _save_spec(args.data, spec)
     _spawn_osd(args.data, spec, new_id)
-    print(f"added osd.{new_id}")
+    # CRUSH placement (ceph-volume's create-or-move step): the
+    # hierarchy was built at bootstrap for the initial osds only — a
+    # daemon that boots without a CRUSH location is up but can never
+    # be selected for data.  The daemon must register in the map
+    # first ('osd crush add' validates the id exists).
+    asyncio.run(_crush_place(spec, new_id))
+    print(f"added osd.{new_id} (crush host host{new_id})")
     return 0
+
+
+async def _crush_place(spec: dict, osd_id: int) -> None:
+    from ceph_tpu.client import RadosClient
+
+    cl = RadosClient(client_id=990000 + osd_id)
+    await cl.connect_multi([("127.0.0.1", p) for p in spec["mon_ports"]])
+    try:
+        deadline = time.time() + 60
+        while True:
+            om = cl.osdmap
+            if om is not None and om.exists(osd_id):
+                break
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"osd.{osd_id} never registered in the map")
+            await cl._wait_new_map(om.epoch if om else 0, timeout=2)
+        host = f"host{osd_id}"
+        code, rs, _ = await cl.command({
+            "prefix": "osd crush add-bucket", "name": host,
+            "type": "host"})
+        if code != 0:
+            raise RuntimeError(f"crush add-bucket: {rs}")
+        code, rs, _ = await cl.command({
+            "prefix": "osd crush move", "name": host,
+            "loc": "root=default"})
+        if code != 0:
+            raise RuntimeError(f"crush move: {rs}")
+        code, rs, _ = await cl.command({
+            "prefix": "osd crush add", "name": f"osd.{osd_id}",
+            "weight": "1.0", "loc": f"host={host}"})
+        if code != 0:
+            raise RuntimeError(f"crush add: {rs}")
+    finally:
+        await cl.shutdown()
 
 
 def cmd_restart(args) -> int:
