@@ -73,11 +73,15 @@ class RadosError(OSError):
 class RadosClient:
     """The cluster handle (librados::Rados)."""
 
-    def __init__(self, client_id: int | None = None, auth=None):
+    def __init__(self, client_id: int | None = None, auth=None,
+                 handshake_timeout: float | None = None):
         self.id = client_id if client_id is not None else (os.getpid() << 8) | 1
+        _mkw = {}
+        if handshake_timeout is not None:
+            _mkw["handshake_timeout"] = handshake_timeout
         self.messenger = Messenger(
             ("client", self.id), self._dispatch, on_reset=self._on_reset,
-            auth=auth,
+            auth=auth, **_mkw,
         )
         self.osdmap: OSDMap | None = None
         self._mon_conn: Connection | None = None

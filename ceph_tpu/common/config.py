@@ -100,6 +100,12 @@ declare(
            see_also=("osd_max_pg_log_entries",)),
     Option("osd_recovery_max_active", int, 4, LEVEL_ADVANCED,
            "concurrent recovery reconciliations per osd", min=1),
+    Option("ms_connection_ready_timeout", float, 10.0, LEVEL_ADVANCED,
+           "seconds allowed for the banner/HELLO/auth handshake per "
+           "connection (reference ms_connection_ready_timeout); raise "
+           "on deployments whose event loops stall for seconds (many "
+           "daemons + XLA compiles on few cores) or false handshake "
+           "timeouts cascade into false failure reports", min=0.1),
     Option("osd_max_backfills", int, 1, LEVEL_ADVANCED,
            "concurrent PG backfills this osd will participate in, as "
            "primary (local reservation) or replica (remote "

@@ -105,6 +105,7 @@ class Monitor:
             compress_mode=conf0["ms_compress_mode"],
             compress_algorithm=conf0["ms_compress_algorithm"],
             compress_min_size=conf0["ms_compress_min_size"],
+            handshake_timeout=conf0["ms_connection_ready_timeout"],
         )
         self.store = MonStore(store) if store is not None else None
         self.paxos = Paxos(
@@ -157,6 +158,7 @@ class Monitor:
         self._tids = itertools.count(1)
         self._scrub_waiters: dict[int, asyncio.Future] = {}
         self._tick_task: asyncio.Task | None = None
+        self._probe_task = None
         self._admin = None
         self.addr: tuple[str, int] | None = None
         self._snapshot()
@@ -297,10 +299,27 @@ class Monitor:
 
     async def open_quorum(self, monmap: list[tuple[str, int]]) -> None:
         """Join the quorum: learn everyone's address, run an election
-        (call on every member after all have start()ed)."""
+        (call on every member after all have start()ed — or, with the
+        probe below, merely *around* the same time)."""
         assert len(monmap) == self.n_mons
         self.monmap = list(monmap)
         await self.paxos.start_election()
+        if self.n_mons > 1 and self._probe_task is None:
+            self._probe_task = asyncio.ensure_future(self._quorum_probe())
+
+    async def _quorum_probe(self) -> None:
+        """A member outside a stable quorum re-runs the election until
+        it joins (the reference's probe/join phase): a mon whose first
+        election raced its peers' boot — multi-process deployments bind
+        at slightly different times — missed VICTORY and would
+        otherwise wait forever."""
+        while True:
+            await asyncio.sleep(2.0)
+            if not self.paxos.stable.is_set():
+                try:
+                    await self.paxos.start_election()
+                except (ConnectionError, OSError):
+                    continue
 
     async def wait_stable(self, timeout: float = 10.0) -> None:
         await asyncio.wait_for(self.paxos.stable.wait(), timeout)
@@ -310,6 +329,8 @@ class Monitor:
             await self._admin.stop()
         if self._tick_task:
             self._tick_task.cancel()
+        if self._probe_task:
+            self._probe_task.cancel()
         if getattr(self, "_autoscale_task", None):
             self._autoscale_task.cancel()
         await self.messenger.shutdown()

@@ -238,8 +238,14 @@ class Messenger:
         compress_mode: str = "none",
         compress_algorithm: str = "zlib",
         compress_min_size: int = 1024,
+        handshake_timeout: float = HANDSHAKE_TIMEOUT,
     ):
         self.entity = entity
+        # ms_connection_ready_timeout role: raise on deployments whose
+        # event loops stall for seconds (e.g. many daemons + XLA
+        # compiles contending for few cores) or false timeouts cascade
+        # into false failure reports
+        self.handshake_timeout = handshake_timeout
         self.dispatcher = dispatcher
         self.on_reset = on_reset
         # AuthContext (ceph_tpu.msg.auth) => cephx handshake + SECURE
@@ -317,7 +323,7 @@ class Messenger:
             # a dialer that accepted TCP but never completes the
             # banner/HELLO must not pin this task forever (the
             # reference's ms_connection_ready_timeout role)
-            await asyncio.wait_for(_handshake(), HANDSHAKE_TIMEOUT)
+            await asyncio.wait_for(_handshake(), self.handshake_timeout)
         except (ConnectionError, asyncio.IncompleteReadError, OSError,
                 PermissionError, asyncio.TimeoutError):
             writer.close()
@@ -383,7 +389,7 @@ class Messenger:
         try:
             return await asyncio.wait_for(
                 self._handshake_out(reader, writer, host, port),
-                HANDSHAKE_TIMEOUT)
+                self.handshake_timeout)
         except asyncio.TimeoutError:
             writer.close()
             raise ConnectionError(

@@ -183,6 +183,7 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             compress_mode=self.conf["ms_compress_mode"],
             compress_algorithm=self.conf["ms_compress_algorithm"],
             compress_min_size=self.conf["ms_compress_min_size"],
+            handshake_timeout=self.conf["ms_connection_ready_timeout"],
         )
         self.messenger.inject_socket_failures = self.conf[
             "ms_inject_socket_failures"

@@ -66,11 +66,19 @@ class RecoveryMixin:
         backfill load per OSD stays bounded.
 
         A pass that leaves PGs unclean (a peer mid-restart, a dropped
-        connection) re-runs after osd_backfill_retry_interval even if
-        no new map arrives — the reference's recovery_request_timer
-        retry role.  Without it a transient error at the wrong moment
-        parks the PG in peering forever (found by the interleaving
-        fuzzer, tests/test_interleave_fuzz.py)."""
+        connection) re-runs even if no new map arrives — the
+        reference's recovery_request_timer retry role.  Without it a
+        transient error at the wrong moment parks the PG in peering
+        forever (found by the interleaving fuzzer,
+        tests/test_interleave_fuzz.py).  Retries back off
+        EXPONENTIALLY (interval, 2x, 4x ... capped at 32x) and only
+        re-run the still-unclean PGs: a fixed-cadence full re-pass
+        saturated contended deployments — every OSD burning a
+        pass-worth of CPU each second starved client I/O outright
+        (bench config 5, 64 OSDs on few cores)."""
+        retry_pgs: set[tuple[int, int]] | None = None  # None = all
+        backoff = max(self.conf["osd_backfill_retry_interval"], 0.05)
+        max_backoff = backoff * 32
         while not self.stopping:
             done_epoch = self.epoch
             # GC remote grants whose requesting primary is gone — a
@@ -89,6 +97,9 @@ class RecoveryMixin:
                             pg, folded=True
                         )
                         if primary != self.id:
+                            continue
+                        if retry_pgs is not None and \
+                                (pid, ps) not in retry_pgs:
                             continue
                         work.append((pool, pg, acting))
                 if work:
@@ -109,7 +120,11 @@ class RecoveryMixin:
                                 "osd.%d: recovery of %s crashed",
                                 self.id, pg, exc_info=r)
                 if self.epoch != done_epoch:
-                    continue  # a map landed mid-pass: re-run now
+                    # a map landed mid-pass: full re-pass, fresh pacing
+                    retry_pgs = None
+                    backoff = max(
+                        self.conf["osd_backfill_retry_interval"], 0.05)
+                    continue
                 incomplete = [
                     pg for _pool, pg, _a in work
                     if self._clean_epoch.get((pg.pool, pg.ps), -1)
@@ -118,10 +133,11 @@ class RecoveryMixin:
                 if not incomplete:
                     return
                 log.info(
-                    "osd.%d: %d pgs unclean after pass; retrying",
-                    self.id, len(incomplete))
-                await asyncio.sleep(
-                    max(self.conf["osd_backfill_retry_interval"], 0.05))
+                    "osd.%d: %d pgs unclean after pass; retrying in "
+                    "%.2fs", self.id, len(incomplete), backoff)
+                await asyncio.sleep(backoff)
+                retry_pgs = {(pg.pool, pg.ps) for pg in incomplete}
+                backoff = min(backoff * 2, max_backoff)
             except asyncio.CancelledError:
                 raise
             except Exception:

@@ -356,13 +356,28 @@ def _osd_group_main(argv: list[str]) -> int:
         from ceph_tpu.common import ConfigProxy
         from ceph_tpu.osd.daemon import OSDDaemon
 
-        # plugin preload (the reference's osd_erasure_code_plugins
-        # daemon-start preload): without it each worker pays the jax
-        # import on its FIRST primary encode, tens of seconds inside
-        # a client op on a contended core
+        # kernel WARMUP, not just plugin preload (the reference's
+        # osd_erasure_code_plugins daemon-start preload, taken one
+        # step further): run a real encode + 1-erasure decode at the
+        # bench's chunk scale so every XLA compile this worker will
+        # need happens NOW, sequentially, before any client op exists.
+        # Compiling lazily inside the I/O path stalls the event loop
+        # for tens of seconds on a contended core — handshakes time
+        # out, peers file false failure reports, the mon churns maps,
+        # and the cluster never settles.
+        import numpy as _np
+
         from ceph_tpu.ec import registry as _ecreg
 
-        _ecreg.factory("jax", {"k": "8", "m": "3"})
+        _ec = _ecreg.factory("jax", {"k": "8", "m": "3"})
+        try:
+            _probe = _np.zeros(512 * 1024, dtype=_np.uint8)
+            _enc = _ec.encode(set(range(11)), _probe)
+            _cs = len(_enc[0])
+            _dec_in = {i: _enc[i] for i in range(11) if i != 2}
+            _ec.decode({2}, _dec_in, _cs)
+        except Exception:
+            pass  # host-only environments still run (numpy path)
 
         conf = {
             "admin_socket": os.path.join(admin_dir, "osd.$id.asok"),
@@ -371,6 +386,18 @@ def _osd_group_main(argv: list[str]) -> int:
             # the failure explicitly (osd down/out), so detection is
             # out of scope — beacons stay on for the pg-stats plane
             "osd_heartbeat_interval": 0.0,
+            # residual compile/dispatch stalls still freeze the loop
+            # for seconds at a time; a 10s handshake budget would turn
+            # those into false failure cascades
+            "ms_connection_ready_timeout": 120.0,
+            # the farm coalesces concurrent requests into variable-
+            # width groups -> each new power-of-two bucket is a fresh
+            # XLA compile (~30s on the tunneled chip) INSIDE the I/O
+            # path, per worker process.  Config 5 measures the
+            # in-daemon recovery DECODE stage, not microbatching: the
+            # per-op plugin path (whose exact shapes the warmup above
+            # just compiled) keeps write/recovery latency sane
+            "osd_ec_encode_farm": "off",
         }
         osds = []
         for i in osd_ids:
@@ -439,9 +466,12 @@ async def _recovery_scenario(profile_extra: dict) -> tuple[float, int, float, fl
     # process so the failure is a real process kill
     workers = max(1, min(8, os.cpu_count() or 1))
     group = max(1, -(-(n_osds - 1) // workers))
+    from ceph_tpu.common import ConfigProxy as _CP
+
     crush = CrushMap()
     B.build_hierarchy(crush, osds_per_host=1, n_hosts=n_osds)
-    mon = Monitor(crush=crush)
+    mon = Monitor(crush=crush, conf=_CP(
+        {"ms_connection_ready_timeout": 120.0}))
     await mon.start()
     admin_dir = tempfile.mkdtemp(prefix="bench5-asok-")
     victim = n_osds - 1
@@ -458,7 +488,7 @@ async def _recovery_scenario(profile_extra: dict) -> tuple[float, int, float, fl
             env=dict(os.environ),
         ))
     victim_proc = procs[-1]
-    cl = RadosClient(client_id=55)
+    cl = RadosClient(client_id=55, handshake_timeout=120.0)
     # workers need a beat to boot + connect
     deadline = time.perf_counter() + 120
     while True:
