@@ -76,6 +76,11 @@ class AsyncReserver:
         self.max_allowed = max(1, int(max_allowed))
         self.min_priority = min_priority
         self._granted: dict[object, Reservation] = {}
+        # issued-but-not-yet-awaited handles: request() must hand the
+        # SAME handle back for an item even before wait() queues or
+        # grants it, or two pre-wait request(item) calls yield two
+        # reservations and one item holds two slots
+        self._issued: dict[object, Reservation] = {}
         self._queue: list[_Waiter] = []
         self._seq = itertools.count()
         # high-water mark of simultaneous grants, for tests/metrics
@@ -95,7 +100,12 @@ class AsyncReserver:
         for w in self._queue:
             if w.item == item:
                 return w.res
-        return Reservation(self, item, priority)
+        pending = self._issued.get(item)
+        if pending is not None and not pending._released:
+            return pending
+        res = Reservation(self, item, priority)
+        self._issued[item] = res
+        return res
 
     def try_request(self, item, priority: int = 0) -> Reservation | None:
         """Non-blocking acquire: a slot now or None (the remote-
@@ -106,13 +116,19 @@ class AsyncReserver:
             return existing
         if len(self._granted) >= self.max_allowed or self._queue:
             return None
-        res = Reservation(self, item, priority)
+        pending = self._issued.get(item)
+        if pending is not None and not pending._released:
+            res = pending
+        else:
+            res = Reservation(self, item, priority)
+            self._issued[item] = res
         self._grant(res)
         return res
 
     def cancel(self, item) -> None:
         """Drop a queued or granted reservation for ``item``
         (AsyncReserver::cancel_reservation)."""
+        self._issued.pop(item, None)
         res = self._granted.pop(item, None)
         if res is not None:
             res._released = True
@@ -192,6 +208,8 @@ class AsyncReserver:
         cur = self._granted.get(res.item)
         if cur is res:
             del self._granted[res.item]
+        if self._issued.get(res.item) is res:
+            del self._issued[res.item]
         self._kick()
 
     def _kick(self) -> None:

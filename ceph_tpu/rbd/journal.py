@@ -51,6 +51,7 @@ class Journal:
         # tail hides a durably appended event from replay)
         self._tail_lock = asyncio.Lock()
         self._tail_persisted = -1
+        self._commit_lock = asyncio.Lock()
 
     def _data_oid(self, seq: int) -> str:
         return f"journal_data.{self.image_name}.{seq:016x}"
@@ -98,15 +99,20 @@ class Journal:
         out-of-order completion (concurrent writes) parks here until
         its predecessors land."""
         self._applied.add(seq)
-        cur = await self.commit_pos()
-        new = cur
-        while new + 1 in self._applied:
-            new += 1
-        if new > cur:
-            for s in range(cur + 1, new + 1):
-                self._applied.discard(s)
-            await self._io.omap_set(
-                self.header_oid, {"commit_pos": str(new).encode()})
+        # the read-advance-write below must be atomic: two concurrent
+        # commits both reading a stale cur can transiently regress
+        # commit_pos (parking trim below an applied seq) — same race
+        # _tail_lock closes for tail_seq
+        async with self._commit_lock:
+            cur = await self.commit_pos()
+            new = cur
+            while new + 1 in self._applied:
+                new += 1
+            if new > cur:
+                for s in range(cur + 1, new + 1):
+                    self._applied.discard(s)
+                await self._io.omap_set(
+                    self.header_oid, {"commit_pos": str(new).encode()})
 
     # -- consumers ---------------------------------------------------------
 

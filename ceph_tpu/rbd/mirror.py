@@ -78,6 +78,7 @@ class MirrorDaemon:
         if cached is not None:
             return cached
         hdr_oid = f"rbd_header.{name}"
+        fresh = False
         try:
             img = await self.dst.open(name, replay=False)
             hdr = await self.dst.meta.omap_get(hdr_oid)
@@ -94,6 +95,7 @@ class MirrorDaemon:
             )
             img = await self.dst.open(name)
             complete = False
+            fresh = True
         if not complete:
             # (re)run the full object copy: a crash mid-bootstrap left
             # a half-synced image that MUST NOT pass as replicated —
@@ -113,6 +115,16 @@ class MirrorDaemon:
                     n = min(step, src_img.size() - off)
                     data = await src_img.read(off, n)
                     if data.strip(b"\0"):
+                        await img.write(off, data)
+                    elif not fresh:
+                        # resumed bootstrap: a crashed earlier attempt
+                        # may have copied a block the source has since
+                        # zeroed (and the journal event may already be
+                        # trimmed — this peer wasn't registered yet).
+                        # Skipping would leave the stale block behind a
+                        # bootstrapped=1 flag: a silently divergent
+                        # replica.  Sparse-skip is only safe on a
+                        # just-created destination.
                         await img.write(off, data)
             finally:
                 img.primary = False
