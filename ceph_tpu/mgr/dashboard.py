@@ -1,7 +1,15 @@
 """Dashboard: a read-only web UI + REST API over the monitor's state
-(the src/pybind/mgr/dashboard role, radically simplified: no auth
-sessions, no mutation endpoints — observe-only, the part operators
-actually keep open).
+(the src/pybind/mgr/dashboard role, radically simplified: no mutation
+endpoints — observe-only, the part operators actually keep open).
+
+Access control (the reference dashboard's auth/session layer, lite):
+when the monitor runs with auth enabled, every request must carry
+``Authorization: Bearer <hex-key>`` where the key belongs to an entity
+in the cluster keyring whose caps grant mon read (``capable(caps,
+"mon", "r")``) — a token minted by ``ceph auth get-or-create`` works
+directly.  Unauthenticated or unauthorized requests get 401.  With
+auth off (cephx=none analogue) everything is open, matching the rest
+of the command plane.
 
 Endpoints:
 
@@ -21,9 +29,11 @@ same `_command` plane the CLI uses — no extra wire hops.
 from __future__ import annotations
 
 import asyncio
+import hmac
 import html
 import json
 
+from ceph_tpu.common.caps import capable
 from ceph_tpu.common.metrics import prometheus_text
 
 _PAGE = """<!doctype html>
@@ -168,15 +178,47 @@ class Dashboard:
 
     # -- http --------------------------------------------------------------
 
+    def _authorized(self, token: str | None) -> bool:
+        auth = self.mon.messenger.auth
+        if auth is None:
+            return True  # auth off: open, like the command plane
+        if not token:
+            return False
+        try:
+            key = bytes.fromhex(token)
+        except ValueError:
+            return False
+        for entity, ekey in auth.keyring.items():
+            if hmac.compare_digest(key, ekey):
+                return capable(auth.caps_of(entity), "mon", "r")
+        return False
+
     async def _handle(self, reader, writer) -> None:
         try:
             req = await asyncio.wait_for(reader.readline(), 5)
-            while True:  # drain headers
+            token = None
+            while True:  # drain headers, capturing Authorization
                 line = await asyncio.wait_for(reader.readline(), 5)
                 if line in (b"\r\n", b"\n", b""):
                     break
+                if line.lower().startswith(b"authorization:"):
+                    val = line.split(b":", 1)[1].strip()
+                    if val.lower().startswith(b"bearer "):
+                        token = val[7:].strip().decode("ascii", "replace")
             path = req.split(b" ")[1].decode() if b" " in req else "/"
             path = path.split("?", 1)[0]  # tolerate query strings
+            if not self._authorized(token):
+                body = b"unauthorized\n"
+                ctype = b"text/plain"
+                writer.write(
+                    b"HTTP/1.1 401 Unauthorized\r\n"
+                    b'WWW-Authenticate: Bearer realm="ceph_tpu"\r\n'
+                    b"Content-Type: " + ctype + b"\r\n"
+                    b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                    b"Connection: close\r\n\r\n" + body
+                )
+                await writer.drain()
+                return
             try:
                 body, ctype = await self._api(path)
                 status = b"200 OK"

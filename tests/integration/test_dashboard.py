@@ -11,9 +11,12 @@ from ceph_tpu.mgr.dashboard import Dashboard
 from .test_mini_cluster import Cluster, run
 
 
-async def _get(addr, path: str) -> tuple[int, bytes]:
+async def _get(addr, path: str, token: str | None = None) -> tuple[int, bytes]:
     reader, writer = await asyncio.open_connection(*addr)
-    writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    hdrs = f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+    if token is not None:
+        hdrs += f"Authorization: Bearer {token}\r\n"
+    writer.write((hdrs + "\r\n").encode())
     await writer.drain()
     raw = await reader.read()
     writer.close()
@@ -61,6 +64,57 @@ class TestDashboard:
 
                     code, _ = await _get(addr, "/nope")
                     assert code == 404
+                finally:
+                    await dash.stop()
+
+        run(go())
+
+    def test_auth_gate(self):
+        """With mon auth enabled the dashboard requires a Bearer token
+        minted by `auth get-or-create` whose caps grant mon read
+        (reference: src/pybind/mgr/dashboard auth/session layer)."""
+        import json as _json
+
+        from .test_auth import SecureCluster
+
+        async def go():
+            async with SecureCluster(n_osds=3) as c:
+                dash = Dashboard(c.mon)
+                addr = await dash.start()
+                try:
+                    # no token / garbage token -> 401
+                    code, _ = await _get(addr, "/api/health")
+                    assert code == 401
+                    code, _ = await _get(addr, "/api/health", token="zz")
+                    assert code == 401
+                    code, _ = await _get(
+                        addr, "/api/health", token="00" * 16)
+                    assert code == 401
+
+                    # mint a viewer with mon read caps via the command
+                    # plane; its key IS the dashboard token
+                    code, _rs, data = await c.client.command({
+                        "prefix": "auth get-or-create",
+                        "entity": "client.viewer",
+                        "caps": _json.dumps({"mon": "allow r"}),
+                    })
+                    assert code == 0
+                    token = _json.loads(data)["key"]
+                    code, body = await _get(
+                        addr, "/api/health", token=token)
+                    assert code == 200
+                    assert _json.loads(body)["status"].startswith("HEALTH")
+
+                    # an entity without mon caps is rejected
+                    code, _rs, data = await c.client.command({
+                        "prefix": "auth get-or-create",
+                        "entity": "client.osd-only",
+                        "caps": _json.dumps({"osd": "allow r"}),
+                    })
+                    assert code == 0
+                    bad = _json.loads(data)["key"]
+                    code, _ = await _get(addr, "/api/health", token=bad)
+                    assert code == 401
                 finally:
                     await dash.stop()
 
