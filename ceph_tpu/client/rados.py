@@ -281,11 +281,19 @@ class RadosClient:
             return -errno.ETIMEDOUT, "command retries exhausted", b""
         return ack.code, ack.rs, ack.data
 
-    async def wait_clean(self, timeout: float = 30.0) -> dict:
+    async def wait_clean(
+        self, timeout: float = 30.0, min_epoch: int = 0,
+    ) -> dict:
         """Poll the mon until every PG reports active+clean (the
         qa-helper wait_for_clean contract, reference
         qa/standalone/ceph-helpers.sh) — via the mon's aggregated pg
-        stats, not by probing OSDs.  Returns the final status blob."""
+        stats, not by probing OSDs.  Returns the final status blob.
+
+        ``min_epoch``: additionally require every counted PG report to
+        have been computed at that osdmap epoch or later.  A caller
+        that just forced a map change (kill + osd out) passes the
+        post-change epoch so leftover pre-change active+clean reports
+        cannot satisfy the wait (they made recovery look instant)."""
         import json as _json
         import time as _time
 
@@ -301,6 +309,7 @@ class RadosClient:
                     pgs.get("num_pgs", 0) > 0
                     and pgs.get("num_reported", 0) >= pgs["num_pgs"]
                     and set(by_state) == {"active+clean"}
+                    and pgs.get("min_reported_epoch", 0) >= min_epoch
                 ):
                     return last
             await asyncio.sleep(0.2)

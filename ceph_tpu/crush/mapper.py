@@ -99,14 +99,44 @@ def _choose_arg_ids(bucket: Bucket, arg: ChooseArg | None) -> list[int]:
     return arg.ids
 
 
+_STRAW2_NATIVE = None
+_STRAW2_PROBED = False
+
+
+def _straw2_native():
+    """The native straw2 choose (ceph_tpu/native/crush_hash.cc) or
+    None; probed once.  Moves the per-item hash+ln+div+argmax loop to
+    one C call per bucket level — the Python loop costs ~25us/item,
+    which stalls daemon event loops on per-PG mapping (bench cfg 5)."""
+    global _STRAW2_NATIVE, _STRAW2_PROBED
+    if not _STRAW2_PROBED:
+        _STRAW2_PROBED = True
+        try:
+            from ceph_tpu import native
+
+            _STRAW2_NATIVE = native.straw2_lib()
+        except Exception:
+            _STRAW2_NATIVE = None
+    return _STRAW2_NATIVE
+
+
 def bucket_straw2_choose(
     bucket: Bucket, x: int, r: int, arg: ChooseArg | None, position: int
 ) -> int:
     weights = _choose_arg_weights(bucket, arg, position)
     ids = _choose_arg_ids(bucket, arg)
+    n = bucket.size
+    lib = _straw2_native()
+    if lib is not None and n:
+        ids_a = np.asarray(ids[:n], dtype=np.int32)
+        w_a = np.asarray(weights[:n], dtype=np.uint32)
+        i = lib.ceph_tpu_straw2_choose(
+            x & 0xFFFFFFFF, r & 0xFFFFFFFF,
+            ids_a.ctypes.data, w_a.ctypes.data, n)
+        return bucket.items[i]
     high = 0
     high_draw = 0
-    for i in range(bucket.size):
+    for i in range(n):
         if weights[i]:
             draw = straw2_draw(bucket.hash, x, ids[i], r, weights[i])
         else:

@@ -841,6 +841,7 @@ class Monitor:
         expected = sum(p.pg_num for p in om.pools.values())
         by_state: dict[str, int] = {}
         objects = 0
+        min_epoch = om.epoch
         primaries = self._pg_primaries(om)
         for pgid, st in book.items():
             pid_s, ps_s = pgid.split(".")
@@ -858,12 +859,20 @@ class Monitor:
                 state = "stale"
             by_state[state] = by_state.get(state, 0) + 1
             objects += int(st.get("objects", 0))
+            min_epoch = min(min_epoch, int(st.get("epoch", 0)))
         reported = sum(by_state.values())
         return {
             "num_pgs": expected,
             "num_reported": reported,
             "by_state": by_state,
             "num_objects": objects,
+            # the oldest osdmap epoch any counted report was computed
+            # at: a waiter that just forced a map change can require
+            # min_reported_epoch >= that epoch so pre-change
+            # active+clean reports can't satisfy it (the qa-helper
+            # wait_for_clean checks last_epoch_clean the same way)
+            "min_reported_epoch": (
+                min_epoch if reported else 0),
         }
 
     def _pg_primaries(self, om) -> dict[tuple[int, int], int]:

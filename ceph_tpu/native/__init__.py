@@ -24,7 +24,7 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_HERE, "_libceph_tpu_native.so")
-_SRCS = ["crc32c.cc"]
+_SRCS = ["crc32c.cc", "crush_hash.cc"]
 
 _lib = None
 _lock = threading.Lock()
@@ -63,6 +63,29 @@ def _load():
         lib.ceph_tpu_xor_region.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
         ]
+        u32 = ctypes.c_uint32
+        for name, nargs in [("ceph_tpu_hash32", 1), ("ceph_tpu_hash32_2", 2),
+                            ("ceph_tpu_hash32_3", 3), ("ceph_tpu_hash32_4", 4),
+                            ("ceph_tpu_hash32_5", 5)]:
+            fn = getattr(lib, name)
+            fn.restype = u32
+            fn.argtypes = [u32] * nargs
+        lib.ceph_tpu_straw2_choose.restype = ctypes.c_int32
+        lib.ceph_tpu_straw2_choose.argtypes = [
+            u32, u32, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+        ]
+        lib.ceph_tpu_set_ln_tables.restype = None
+        lib.ceph_tpu_set_ln_tables.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        # inject the crush_ln LUTs (single table of truth lives in the
+        # generated Python module)
+        from ceph_tpu.crush._ln_tables import LL_TBL, RH_LH_TBL
+
+        rh = np.ascontiguousarray(RH_LH_TBL, dtype=np.int64)
+        ll = np.ascontiguousarray(LL_TBL, dtype=np.int64)
+        assert rh.size == 258 and ll.size == 256
+        lib.ceph_tpu_set_ln_tables(rh.ctypes.data, ll.ctypes.data)
         _lib = lib
     return _lib
 
@@ -127,6 +150,16 @@ def crc32c_zeros(length: int, seed: int = 0xFFFFFFFF) -> int:
             break
         crc = int(t[crc & 0xFF]) ^ (crc >> 8)
     return crc
+
+
+def straw2_lib():
+    """The raw ctypes lib if the native straw2 choose is usable (LUTs
+    injected), else None.  mapper.py binds the per-bucket call itself
+    to keep the hot path free of Python-level indirection."""
+    lib = _load()
+    if lib is not None and lib.ceph_tpu_ln_tables_ready():
+        return lib
+    return None
 
 
 def xor_region(dst: np.ndarray, src: np.ndarray) -> None:

@@ -1,0 +1,54 @@
+"""Persistent XLA compilation cache for the control-plane programs.
+
+The batched remap (ceph_tpu/osd/remap.py) compiles one XLA program per
+(CRUSH topology, rule, size); on the real chip that first compile costs
+minutes (193 s measured for the 10k-PG config-4 map), which the
+in-process program cache only amortizes until the process exits — a
+monitor restart paid it again.  The reference's analogue never has this
+problem (ParallelPGMapper is plain C++, src/osd/OSDMapMapping.h:18), so
+ours must not either: we turn on JAX's persistent compilation cache so
+lowered+compiled executables are serialized to disk keyed by HLO hash
+and a fresh process warm-starts in seconds.
+
+Opt-out via CEPH_TPU_COMPILE_CACHE=off; cache location override via
+CEPH_TPU_COMPILE_CACHE_DIR (default ~/.cache/ceph_tpu/xla).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_lock = threading.Lock()
+_done = False
+
+
+def ensure_persistent_cache() -> bool:
+    """Idempotently enable the on-disk compile cache.  Returns True if
+    it is (now) active.  Called lazily right before the first heavy
+    compile so importing ceph_tpu never touches the filesystem."""
+    global _done
+    if _done:
+        return True
+    with _lock:
+        if _done:
+            return True
+        if os.environ.get("CEPH_TPU_COMPILE_CACHE", "on") == "off":
+            return False
+        path = os.environ.get("CEPH_TPU_COMPILE_CACHE_DIR") or os.path.join(
+            os.path.expanduser("~"), ".cache", "ceph_tpu", "xla")
+        try:
+            os.makedirs(path, exist_ok=True)
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", path)
+            # cache everything: the programs here are few and large,
+            # and the default min-compile-time floor would skip the
+            # small per-rule launchers that still cost seconds through
+            # a tunneled backend
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception:
+            return False
+        _done = True
+        return True

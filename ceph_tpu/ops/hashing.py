@@ -21,6 +21,26 @@ import numpy as np
 HASH_SEED = np.uint32(1315423911)
 _X = 231232
 _Y = 1232
+_M32 = 0xFFFFFFFF
+_SEED_INT = 1315423911
+
+
+def _mix_int(a: int, b: int, c: int) -> tuple[int, int, int]:
+    """One Jenkins mix round on plain Python ints (scalar fast path:
+    the numpy scalar version pays ~µs of ufunc dispatch per op — 135
+    per hash — which made per-PG scalar CRUSH mapping stall OSD event
+    loops for seconds; see tools/bench_all.py config 5).  Values are
+    kept masked to 32 bits so >> is a logical shift."""
+    a = (a - b - c) & _M32; a ^= c >> 13
+    b = (b - c - a) & _M32; b ^= (a << 8) & _M32
+    c = (c - a - b) & _M32; c ^= b >> 13
+    a = (a - b - c) & _M32; a ^= c >> 12
+    b = (b - c - a) & _M32; b ^= (a << 16) & _M32
+    c = (c - a - b) & _M32; c ^= b >> 5
+    a = (a - b - c) & _M32; a ^= c >> 3
+    b = (b - c - a) & _M32; b ^= (a << 10) & _M32
+    c = (c - a - b) & _M32; c ^= b >> 15
+    return a, b, c
 
 
 def _mix_np(a, b, c):
@@ -42,11 +62,16 @@ import functools
 
 def _wrapping(fn):
     """uint32 wraparound is the point; silence numpy overflow warnings
-    inside the hash only."""
+    inside the hash only.  The scalar (all-plain-int) fast path skips
+    the errstate context entirely — entering it costs more than the
+    whole int hash."""
     @functools.wraps(fn)
     def inner(*a):
-        with np.errstate(over="ignore"):
-            return fn(*a)
+        for v in a:
+            if type(v) is not int:
+                with np.errstate(over="ignore"):
+                    return fn(*a)
+        return fn(*a)
     return inner
 
 
@@ -56,6 +81,13 @@ def _u32(x):
 
 @_wrapping
 def crush_hash32(a):
+    if type(a) is int:
+        a &= _M32
+        h = (_SEED_INT ^ a) & _M32
+        b, x, y = a, _X, _Y
+        b, x, h = _mix_int(b, x, h)
+        y, a, h = _mix_int(y, a, h)
+        return h
     a = _u32(a)
     h = HASH_SEED ^ a
     b = a
@@ -68,6 +100,14 @@ def crush_hash32(a):
 
 @_wrapping
 def crush_hash32_2(a, b):
+    if type(a) is int and type(b) is int:
+        a &= _M32; b &= _M32
+        h = (_SEED_INT ^ a ^ b) & _M32
+        x, y = _X, _Y
+        a, b, h = _mix_int(a, b, h)
+        x, a, h = _mix_int(x, a, h)
+        b, y, h = _mix_int(b, y, h)
+        return h
     a, b = _u32(a), _u32(b)
     h = HASH_SEED ^ a ^ b
     x = np.uint32(_X)
@@ -80,6 +120,16 @@ def crush_hash32_2(a, b):
 
 @_wrapping
 def crush_hash32_3(a, b, c):
+    if type(a) is int and type(b) is int and type(c) is int:
+        a &= _M32; b &= _M32; c &= _M32
+        h = (_SEED_INT ^ a ^ b ^ c) & _M32
+        x, y = _X, _Y
+        a, b, h = _mix_int(a, b, h)
+        c, x, h = _mix_int(c, x, h)
+        y, a, h = _mix_int(y, a, h)
+        b, x, h = _mix_int(b, x, h)
+        y, c, h = _mix_int(y, c, h)
+        return h
     a, b, c = _u32(a), _u32(b), _u32(c)
     h = HASH_SEED ^ a ^ b ^ c
     x = np.uint32(_X)
@@ -94,6 +144,18 @@ def crush_hash32_3(a, b, c):
 
 @_wrapping
 def crush_hash32_4(a, b, c, d):
+    if (type(a) is int and type(b) is int and type(c) is int
+            and type(d) is int):
+        a &= _M32; b &= _M32; c &= _M32; d &= _M32
+        h = (_SEED_INT ^ a ^ b ^ c ^ d) & _M32
+        x, y = _X, _Y
+        a, b, h = _mix_int(a, b, h)
+        c, d, h = _mix_int(c, d, h)
+        a, x, h = _mix_int(a, x, h)
+        y, b, h = _mix_int(y, b, h)
+        c, x, h = _mix_int(c, x, h)
+        y, d, h = _mix_int(y, d, h)
+        return h
     a, b, c, d = _u32(a), _u32(b), _u32(c), _u32(d)
     h = HASH_SEED ^ a ^ b ^ c ^ d
     x = np.uint32(_X)
@@ -109,6 +171,20 @@ def crush_hash32_4(a, b, c, d):
 
 @_wrapping
 def crush_hash32_5(a, b, c, d, e):
+    if (type(a) is int and type(b) is int and type(c) is int
+            and type(d) is int and type(e) is int):
+        a &= _M32; b &= _M32; c &= _M32; d &= _M32; e &= _M32
+        h = (_SEED_INT ^ a ^ b ^ c ^ d ^ e) & _M32
+        x, y = _X, _Y
+        a, b, h = _mix_int(a, b, h)
+        c, d, h = _mix_int(c, d, h)
+        e, x, h = _mix_int(e, x, h)
+        y, a, h = _mix_int(y, a, h)
+        b, x, h = _mix_int(b, x, h)
+        y, c, h = _mix_int(y, c, h)
+        d, x, h = _mix_int(d, x, h)
+        y, e, h = _mix_int(y, e, h)
+        return h
     a, b, c, d, e = _u32(a), _u32(b), _u32(c), _u32(d), _u32(e)
     h = HASH_SEED ^ a ^ b ^ c ^ d ^ e
     x = np.uint32(_X)
@@ -184,45 +260,45 @@ def crush_hash32_2_jax(a, b):
     return h
 
 
-@_wrapping
 def ceph_str_hash_rjenkins(data: bytes | str) -> int:
     """Object-name hash (reference src/common/ceph_hash.cc
     ceph_str_hash_rjenkins): Jenkins lookup2 over 12-byte blocks with
     the length folded into c — the hash that places objects into PGs
-    (object_locator_to_pg, src/osd/osd_types.cc)."""
+    (object_locator_to_pg, src/osd/osd_types.cc).  Pure-int (scalar
+    hot path: runs once per client op)."""
     if isinstance(data, str):
         data = data.encode("utf-8")
     k = data
     length = len(k)
-    a = np.uint32(0x9E3779B9)
-    b = np.uint32(0x9E3779B9)
-    c = np.uint32(0)
+    a = 0x9E3779B9
+    b = 0x9E3779B9
+    c = 0
     off = 0
     ln = length
     while ln >= 12:
-        a = a + np.uint32(int.from_bytes(k[off : off + 4], "little"))
-        b = b + np.uint32(int.from_bytes(k[off + 4 : off + 8], "little"))
-        c = c + np.uint32(int.from_bytes(k[off + 8 : off + 12], "little"))
-        a, b, c = _mix_np(a, b, c)
+        a = (a + int.from_bytes(k[off : off + 4], "little")) & _M32
+        b = (b + int.from_bytes(k[off + 4 : off + 8], "little")) & _M32
+        c = (c + int.from_bytes(k[off + 8 : off + 12], "little")) & _M32
+        a, b, c = _mix_int(a, b, c)
         off += 12
         ln -= 12
-    c = c + np.uint32(length)
+    c = (c + length) & _M32
     tail = k[off:]
     t = tail + b"\0" * (11 - len(tail))
     if ln >= 9:
         # the first byte of c is reserved for the length
-        c = c + np.uint32(
+        c = (c + (
             (t[8] << 8) | (t[9] << 16 if ln >= 10 else 0) | (t[10] << 24 if ln >= 11 else 0)
-        )
+        )) & _M32
     if ln >= 5:
-        b = b + np.uint32(
+        b = (b + (
             t[4] | (t[5] << 8 if ln >= 6 else 0) | (t[6] << 16 if ln >= 7 else 0)
             | (t[7] << 24 if ln >= 8 else 0)
-        )
+        )) & _M32
     if ln >= 1:
-        a = a + np.uint32(
+        a = (a + (
             t[0] | (t[1] << 8 if ln >= 2 else 0) | (t[2] << 16 if ln >= 3 else 0)
             | (t[3] << 24 if ln >= 4 else 0)
-        )
-    a, b, c = _mix_np(a, b, c)
-    return int(c)
+        )) & _M32
+    a, b, c = _mix_int(a, b, c)
+    return c

@@ -31,6 +31,103 @@ CEPH_OSD_EXISTS = 1
 CEPH_OSD_UP = 2
 
 
+class _InvalidatingDict(dict):
+    """An exception-table dict (pg_temp/upmap/...) that drops its
+    OSDMap's mapping memo on every mutation — callers write these
+    tables directly (mon _apply_op, balancer, tests), so method-level
+    invalidation alone would miss them."""
+
+    __slots__ = ("_owner",)
+
+    def __init__(self, owner: "OSDMap", *a, **kw):
+        super().__init__(*a, **kw)
+        self._owner = owner
+
+    def _inv(self) -> None:
+        self._owner._mapping_cache = None
+
+    def __setitem__(self, k, v):
+        self._inv()
+        super().__setitem__(k, v)
+
+    def __delitem__(self, k):
+        self._inv()
+        super().__delitem__(k)
+
+    def pop(self, *a):
+        self._inv()
+        return super().pop(*a)
+
+    def popitem(self):
+        self._inv()
+        return super().popitem()
+
+    def clear(self):
+        self._inv()
+        super().clear()
+
+    def update(self, *a, **kw):
+        self._inv()
+        super().update(*a, **kw)
+
+    def setdefault(self, k, d=None):
+        if k not in self:
+            self._inv()
+        return super().setdefault(k, d)
+
+
+class _InvalidatingList(list):
+    """osd_state/osd_weight/affinity twin of :class:`_InvalidatingDict`
+    — index writes like ``om.osd_state[o] = 0`` must drop the memo."""
+
+    _owner: "OSDMap"
+
+    def _inv(self) -> None:
+        self._owner._mapping_cache = None
+
+    def __setitem__(self, i, v):
+        self._inv()
+        super().__setitem__(i, v)
+
+    def __delitem__(self, i):
+        self._inv()
+        super().__delitem__(i)
+
+    def __iadd__(self, other):
+        self._inv()
+        return super().__iadd__(other)
+
+    def append(self, v):
+        self._inv()
+        super().append(v)
+
+    def extend(self, it):
+        self._inv()
+        super().extend(it)
+
+    def insert(self, i, v):
+        self._inv()
+        super().insert(i, v)
+
+    def pop(self, i=-1):
+        self._inv()
+        return super().pop(i)
+
+    def remove(self, v):
+        self._inv()
+        super().remove(v)
+
+    def clear(self):
+        self._inv()
+        super().clear()
+
+
+def _wrap_list(owner: "OSDMap", cur: list) -> "_InvalidatingList":
+    out = _InvalidatingList(cur)
+    out._owner = owner
+    return out
+
+
 @dataclass
 class OSDMap:
     """Mutable cluster map (an epoch's worth of state).
@@ -59,6 +156,32 @@ class OSDMap:
     osd_addrs: dict[int, tuple[str, int]] = field(default_factory=dict)
     # pool id -> name (reference OSDMap pool_name map)
     pool_names: dict[int, str] = field(default_factory=dict)
+    # per-epoch memo of pg_to_up_acting_osds (see its docstring);
+    # (epoch, {(pg, folded): (up, upp, acting, actp)}) — never encoded
+    _mapping_cache: tuple | None = field(
+        default=None, repr=False, compare=False)
+
+    def __post_init__(self) -> None:
+        # exception tables invalidate the mapping memo on direct writes
+        for name in ("pg_upmap", "pg_upmap_items", "pg_upmap_primaries",
+                     "pg_temp", "primary_temp"):
+            cur = getattr(self, name)
+            if not isinstance(cur, _InvalidatingDict):
+                setattr(self, name, _InvalidatingDict(self, cur))
+        for name in ("osd_state", "osd_weight", "osd_primary_affinity"):
+            cur = getattr(self, name)
+            if isinstance(cur, list) and not isinstance(
+                    cur, _InvalidatingList):
+                setattr(self, name, _wrap_list(self, cur))
+
+    def invalidate_mapping_cache(self) -> None:
+        """Drop the per-epoch mapping memo.  Mutator methods and the
+        exception-table dicts call this; remaining direct-field writes
+        (osd_weight[i] in mon _apply_op / apply_incremental, CRUSH
+        structural edits via builder) are covered by the epoch bump
+        that lands with every committed mutation — call this by hand
+        when mutating those outside a map commit."""
+        self._mapping_cache = None
 
     def lookup_pg_pool_name(self, name: str) -> int:
         for pid, n in self.pool_names.items():
@@ -80,6 +203,7 @@ class OSDMap:
         del self.osd_weight[n:]
 
     def new_osd(self, osd: int, weight: int = 0x10000, up: bool = True) -> None:
+        self.invalidate_mapping_cache()
         if osd >= self.max_osd:
             self.set_max_osd(osd + 1)
         self.osd_state[osd] = CEPH_OSD_EXISTS | (CEPH_OSD_UP if up else 0)
@@ -101,19 +225,23 @@ class OSDMap:
         return not self.exists(osd) or self.osd_weight[osd] == 0
 
     def mark_down(self, osd: int) -> None:
+        self.invalidate_mapping_cache()
         self.osd_state[osd] &= ~CEPH_OSD_UP
 
     def mark_up(self, osd: int) -> None:
+        self.invalidate_mapping_cache()
         self.osd_state[osd] |= CEPH_OSD_UP | CEPH_OSD_EXISTS
 
     def mark_out(self, osd: int) -> None:
+        self.invalidate_mapping_cache()
         self.osd_weight[osd] = 0
 
     def set_primary_affinity(self, osd: int, aff: int) -> None:
+        self.invalidate_mapping_cache()
         if self.osd_primary_affinity is None:
-            self.osd_primary_affinity = [
+            self.osd_primary_affinity = _wrap_list(self, [
                 CEPH_OSD_DEFAULT_PRIMARY_AFFINITY
-            ] * self.max_osd
+            ] * self.max_osd)
         self.osd_primary_affinity[osd] = aff
 
     def get_pg_pool(self, poolid: int) -> PgPool | None:
@@ -294,7 +422,23 @@ class OSDMap:
         OSDMap.cc:2923-2971.  ``pg`` is a raw pg by default (the
         pipeline folds it, raw_pg_to_pg=true branch); with
         ``folded=True`` the ps must already be in [0, pg_num) and
-        out-of-range returns empty."""
+        out-of-range returns empty.
+
+        Results are memoized per epoch (the OSDMapMapping /
+        ParallelPGMapper role, src/osd/OSDMapMapping.h:18): every
+        daemon subsystem — peering, recovery, scrub, op admission —
+        asks for the same mappings many times per epoch, and the
+        scalar pipeline is pure given one epoch's state.  Mutators
+        bump ``epoch`` (mon commit path) which naturally invalidates;
+        in-place mutators below also drop the cache explicitly."""
+        cache = self._mapping_cache
+        if cache is None or cache[0] != self.epoch:
+            cache = (self.epoch, {})
+            self._mapping_cache = cache
+        hit = cache[1].get((pg, folded))
+        if hit is not None:
+            up, up_primary, acting, acting_primary = hit
+            return list(up), up_primary, list(acting), acting_primary
         pool = self.get_pg_pool(pg.pool)
         if pool is None or (folded and pg.ps >= pool.pg_num):
             return [], -1, [], -1
@@ -308,6 +452,8 @@ class OSDMap:
             acting = list(up)
             if acting_primary == -1:
                 acting_primary = up_primary
+        cache[1][(pg, folded)] = (
+            tuple(up), up_primary, tuple(acting), acting_primary)
         return up, up_primary, acting, acting_primary
 
     def pg_is_ec(self, pg: pg_t) -> bool:

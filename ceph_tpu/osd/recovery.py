@@ -90,9 +90,17 @@ class RecoveryMixin:
             try:
                 om = self.osdmap
                 work: list[tuple[PgPool, pg_t, list[int]]] = []
+                scanned = 0
                 for pid, pool in list(om.pools.items()):
                     for ps in range(pool.pg_num):
                         pg = pg_t(pid, ps)
+                        scanned += 1
+                        if scanned % 8 == 0:
+                            # the scalar mapping sweep must not hold
+                            # the event loop: handshakes/heartbeats
+                            # starve and peers file false failures
+                            # (bench config 5 post-mortem)
+                            await asyncio.sleep(0)
                         _, _, acting, primary = om.pg_to_up_acting_osds(
                             pg, folded=True
                         )

@@ -344,6 +344,18 @@ def bench_remap() -> None:
 # scenario twice with the EC profile's device-min-bytes flipping the
 # plugin between chip and host GF paths.
 
+def _bench_ec_profile() -> tuple[int, int]:
+    """EC(k, m) for config 5, scaled to the cluster: the headline is
+    EC(8,3) on 64 OSDs (BASELINE.md), but a small debug cluster
+    (BENCH_RECOVERY_OSDS=8) cannot host 11 distinct shards across
+    single-OSD failure domains — placement would hole out and the
+    cluster could never go clean."""
+    n_osds = int(os.environ.get("BENCH_RECOVERY_OSDS", "64"))
+    if n_osds >= 12:
+        return 8, 3
+    return 4, 2
+
+
 def _osd_group_main(argv: list[str]) -> int:
     """Worker process: host a group of OSDs until SIGTERM."""
     import asyncio
@@ -369,12 +381,13 @@ def _osd_group_main(argv: list[str]) -> int:
 
         from ceph_tpu.ec import registry as _ecreg
 
-        _ec = _ecreg.factory("jax", {"k": "8", "m": "3"})
+        _k, _m = _bench_ec_profile()
+        _ec = _ecreg.factory("jax", {"k": str(_k), "m": str(_m)})
         try:
             _probe = _np.zeros(512 * 1024, dtype=_np.uint8)
-            _enc = _ec.encode(set(range(11)), _probe)
+            _enc = _ec.encode(set(range(_k + _m)), _probe)
             _cs = len(_enc[0])
-            _dec_in = {i: _enc[i] for i in range(11) if i != 2}
+            _dec_in = {i: _enc[i] for i in range(_k + _m) if i != 2}
             _ec.decode({2}, _dec_in, _cs)
         except Exception:
             pass  # host-only environments still run (numpy path)
@@ -410,14 +423,21 @@ def _osd_group_main(argv: list[str]) -> int:
 
         async def lag_probe():
             import faulthandler
+            debug = os.environ.get("BENCH_DEBUG_LAG")
             while True:
                 t0 = loop.time()
+                if debug:
+                    # armed BEFORE the sleep: if the loop stalls >2s the
+                    # timer fires DURING the stall and dumps the stack
+                    # actually holding the loop
+                    faulthandler.dump_traceback_later(2.0, file=sys.stderr)
                 await asyncio.sleep(0.1)
+                if debug:
+                    faulthandler.cancel_dump_traceback_later()
                 drift = loop.time() - t0 - 0.1
-                if drift > 0.5 and os.environ.get("BENCH_DEBUG_LAG"):
+                if drift > 0.5 and debug:
                     print(f"[osd-group {ids}] loop stalled {drift:.2f}s",
                           file=sys.stderr, flush=True)
-                    faulthandler.dump_traceback(file=sys.stderr)
 
         probe = asyncio.ensure_future(lag_probe())
         await stop.wait()
@@ -537,7 +557,8 @@ async def _recovery_run(cl, mon, procs, victim, victim_proc, admin_dir,
     import random
     import signal
 
-    profile = {"plugin": "jax", "k": "8", "m": "3"}
+    k, m = _bench_ec_profile()
+    profile = {"plugin": "jax", "k": str(k), "m": str(m)}
     profile.update(profile_extra)
     print("bench5: cluster up, writing", file=sys.stderr, flush=True)
     await cl.ec_profile_set("p", profile)
@@ -560,7 +581,13 @@ async def _recovery_run(cl, mon, procs, victim, victim_proc, admin_dir,
     t0 = time.perf_counter()
     await cl.command({"prefix": "osd down", "id": str(victim)})
     await cl.command({"prefix": "osd out", "id": str(victim)})
-    await cl.wait_clean(timeout=900)
+    # every pg report must post-date the out-epoch: stale pre-kill
+    # active+clean reports otherwise satisfy the wait instantly
+    import json as _json
+
+    code, _rs, data = await cl.command({"prefix": "status"})
+    kill_epoch = _json.loads(data)["epoch"] if code == 0 else 0
+    await cl.wait_clean(timeout=900, min_epoch=kill_epoch)
     print("bench5: recovered", file=sys.stderr, flush=True)
     dt = time.perf_counter() - t0
     dsec, dbytes = await _sum_decode_counters(
@@ -583,9 +610,10 @@ def bench_recovery() -> None:
         _recovery_scenario({"device-min-bytes": str(1 << 40)}))
     host_mbs = (dbytes_h / dsec_h / 1e6) if dsec_h > 0 else 0.0
     ratio = dev_mbs / host_mbs if host_mbs > 0 else 0.0
+    k, m = _bench_ec_profile()
     _emit(
         f"e2e 1-OSD-down recovery, {os.environ.get('BENCH_RECOVERY_OSDS', '64')} "
-        f"OSDs in separate processes, EC(8,3), "
+        f"OSDs in separate processes, EC({k},{m}), "
         f"{total // 2**20} MiB user data: to-clean "
         f"(in-daemon decode stage {dev_mbs:.0f} MB/s device vs "
         f"{host_mbs:.0f} MB/s host = {ratio:.1f}x; host-run e2e "
