@@ -90,6 +90,12 @@ def compile_map(
     reference snapshots choose_args per crush_do_rule call
     (mapper.c:290-307).
     """
+    # first-compile latency on a cold process is the remap path's whole
+    # startup cost (193 s measured on the chip for the 10k-PG map):
+    # persist XLA executables across processes
+    from ceph_tpu.ops.compile_cache import ensure_persistent_cache
+
+    ensure_persistent_cache()
     ids = sorted(cmap.buckets.keys(), reverse=True)  # -1, -2, ...
     for bid in ids:
         b = cmap.buckets[bid]

@@ -342,6 +342,11 @@ class BitmatrixCodec:
     """
 
     def __init__(self, coding_matrix: np.ndarray):
+        # pallas kernels recompile per (shape, tile) on a cold process;
+        # persist executables so daemons/benches warm-start
+        from ceph_tpu.ops.compile_cache import ensure_persistent_cache
+
+        ensure_persistent_cache()
         self.C = np.asarray(coding_matrix, dtype=np.uint8)
         self.m, self.k = self.C.shape
         self.encode_bits = jnp.asarray(gf_matrix_to_bitmatrix(self.C))

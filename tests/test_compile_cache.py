@@ -1,0 +1,73 @@
+"""Persistent XLA compile cache (ceph_tpu/ops/compile_cache.py): a
+cold process must reuse executables compiled by an earlier one — the
+ParallelPGMapper never pays a startup compile (reference
+src/osd/OSDMapMapping.h:18), so the batched remap must not either
+(r4 weak #2: 193 s first-epoch compile on every mon restart)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PROBE = """
+import sys, time, os
+sys.path.insert(0, {repo!r})
+from ceph_tpu.crush import builder as B
+from ceph_tpu.crush.types import CrushMap
+from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.osd.remap import BatchedClusterMapper
+from ceph_tpu.osd.types import PgPool, PoolType
+crush = CrushMap()
+B.build_hierarchy(crush, osds_per_host=4, n_hosts=8)
+om = OSDMap(crush=crush)
+for o in range(32):
+    om.new_osd(o, weight=0x10000, up=True)
+root = om.crush.bucket_names["default"]
+fd = om.crush.type_id("host")
+rule = B.add_simple_rule(om.crush, root, fd, mode="firstn")
+om.pools[1] = PgPool(id=1, type=PoolType.REPLICATED, size=3, min_size=2,
+                     crush_rule=rule, pg_num=64, pgp_num=64)
+t0 = time.perf_counter()
+BatchedClusterMapper(om).map_cluster()
+print("ELAPSED", time.perf_counter() - t0)
+"""
+
+
+def test_cache_populates_and_speeds_cold_start(tmp_path):
+    env = dict(os.environ)
+    env["CEPH_TPU_COMPILE_CACHE_DIR"] = str(tmp_path)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTEST_CURRENT_TEST", None)
+
+    def run() -> float:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE.format(repo=REPO)],
+            capture_output=True, text=True, env=env, check=True,
+        )
+        for line in r.stdout.splitlines():
+            if line.startswith("ELAPSED"):
+                return float(line.split()[1])
+        raise AssertionError(r.stdout + r.stderr)
+
+    t_cold = run()
+    entries = os.listdir(tmp_path)
+    assert entries, "persistent cache dir stayed empty"
+    t_warm = run()
+    # the XLA compile is served from disk in process 2; tracing still
+    # runs, so assert a solid improvement rather than a magic ratio
+    assert t_warm < t_cold, (t_cold, t_warm)
+
+
+def test_opt_out(tmp_path):
+    env = dict(os.environ)
+    env["CEPH_TPU_COMPILE_CACHE_DIR"] = str(tmp_path)
+    env["CEPH_TPU_COMPILE_CACHE"] = "off"
+    env["JAX_PLATFORMS"] = "cpu"
+    subprocess.run(
+        [sys.executable, "-c", _PROBE.format(repo=REPO)],
+        capture_output=True, text=True, env=env, check=True,
+    )
+    assert not os.listdir(tmp_path)
