@@ -106,6 +106,23 @@ declare(
            "on deployments whose event loops stall for seconds (many "
            "daemons + XLA compiles on few cores) or false handshake "
            "timeouts cascade into false failure reports", min=0.1),
+    Option("mon_osd_nearfull_ratio", float, 0.85, LEVEL_ADVANCED,
+           "store usage ratio at which an osd is flagged nearfull "
+           "(health warning only; reference "
+           "src/mon/OSDMonitor.cc:669-671)", min=0.0, max=1.0,
+           see_also=("mon_osd_backfillfull_ratio", "mon_osd_full_ratio")),
+    Option("mon_osd_backfillfull_ratio", float, 0.90, LEVEL_ADVANCED,
+           "store usage ratio at which an osd refuses new backfill "
+           "reservations (REJECT_TOOFULL)", min=0.0, max=1.0),
+    Option("mon_osd_full_ratio", float, 0.95, LEVEL_ADVANCED,
+           "store usage ratio at which client writes to PGs touching "
+           "the osd bounce with ENOSPC (reference "
+           "src/osd/OSD.cc:773 recalc_full_state / :890 _check_full)",
+           min=0.0, max=1.0),
+    Option("osd_failsafe_full_ratio", float, 0.97, LEVEL_ADVANCED,
+           "local hard stop: the osd itself rejects writes past this "
+           "usage even before the mon reacts (reference "
+           "osd_failsafe_full_ratio)", min=0.0, max=1.0),
     Option("osd_max_backfills", int, 1, LEVEL_ADVANCED,
            "concurrent PG backfills this osd will participate in, as "
            "primary (local reservation) or replica (remote "

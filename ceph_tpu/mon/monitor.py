@@ -491,6 +491,8 @@ class Monitor:
                 self._last_beacon[msg.osd] = time.monotonic()
                 if msg.pg_stats:
                     self._ingest_pg_stats(msg.osd, msg.epoch, msg.pg_stats)
+                if msg.statfs:
+                    await self._ingest_statfs(msg.osd, msg.statfs)
             else:
                 await self._forward_to_leader(msg)
         elif isinstance(msg, MOSDFailure):
@@ -609,6 +611,18 @@ class Monitor:
             if not (0 <= op["osd"] < om.max_osd) or om.is_out(op["osd"]):
                 return
             om.mark_out(op["osd"])
+        elif kind == "full_state":
+            from ceph_tpu.osd.osdmap import CEPH_OSD_FULL_MASK
+
+            osd = op["osd"]
+            if not om.exists(osd):
+                return
+            cur = om.osd_state[osd]
+            new = (cur & ~CEPH_OSD_FULL_MASK) | (
+                op["bits"] & CEPH_OSD_FULL_MASK)
+            if new == cur:
+                return  # replay: no epoch
+            om.osd_state[osd] = new
         elif kind == "profile":
             om.erasure_code_profiles[op["name"]] = dict(op["profile"])
         elif kind == "pool_create":
@@ -834,6 +848,50 @@ class Monitor:
                 st["primary"] = osd
                 book[pgid] = st
 
+    async def _ingest_statfs(self, osd: int, raw: bytes) -> None:
+        """Fold one OSD's store usage into the fullness plane
+        (reference OSDMonitor full-state tracking,
+        src/mon/OSDMonitor.cc:669-671 ratios + OSD.cc:773
+        recalc_full_state): keep the latest statfs for `df`, derive
+        the osd's fullness bits from the configured ratios, and commit
+        a map change whenever the bits flip so every daemon and client
+        gates on the same epoch's truth."""
+        import json
+
+        try:
+            sf = json.loads(raw)
+            total = int(sf["total"])
+            used = int(sf["used"])
+        except (ValueError, KeyError, TypeError):
+            return
+        book = getattr(self, "_osd_statfs", None)
+        if book is None:
+            book = self._osd_statfs = {}
+        book[osd] = sf
+        ratio = (used / total) if total > 0 else 0.0
+        from ceph_tpu.osd.osdmap import (
+            CEPH_OSD_BACKFILLFULL,
+            CEPH_OSD_FULL,
+            CEPH_OSD_FULL_MASK,
+            CEPH_OSD_NEARFULL,
+        )
+
+        bits = 0
+        if ratio >= self.conf["mon_osd_full_ratio"]:
+            bits = CEPH_OSD_FULL
+        elif ratio >= self.conf["mon_osd_backfillfull_ratio"]:
+            bits = CEPH_OSD_BACKFILLFULL
+        elif ratio >= self.conf["mon_osd_nearfull_ratio"]:
+            bits = CEPH_OSD_NEARFULL
+        om = self.osdmap
+        if not om.exists(osd):
+            return
+        cur = om.osd_state[osd] & CEPH_OSD_FULL_MASK
+        if cur != bits:
+            await self._propose({
+                "op": "full_state", "osd": osd, "bits": bits,
+            })
+
     def _pg_summary(self) -> dict:
         """Aggregate pg states (the `ceph -s` pgs block)."""
         book = getattr(self, "_pg_stats", {}) or {}
@@ -949,7 +1007,41 @@ class Monitor:
                 ),
                 "detail": [],
             }
-        status = "HEALTH_OK" if not checks else "HEALTH_WARN"
+        # fullness (reference OSD_FULL/OSD_BACKFILLFULL/OSD_NEARFULL
+        # health checks): FULL is an error — writes are bouncing
+        full = [o for o in range(om.max_osd) if om.is_full(o)]
+        bfull = [
+            o for o in range(om.max_osd)
+            if om.is_backfillfull(o) and o not in full
+        ]
+        near = [
+            o for o in range(om.max_osd)
+            if om.is_nearfull(o) and o not in full and o not in bfull
+        ]
+        if full:
+            checks["OSD_FULL"] = {
+                "severity": "HEALTH_ERR",
+                "summary": f"{len(full)} full osd(s); writes blocked",
+                "detail": [f"osd.{o} is full" for o in full],
+            }
+        if bfull:
+            checks["OSD_BACKFILLFULL"] = {
+                "severity": "HEALTH_WARN",
+                "summary": (
+                    f"{len(bfull)} backfillfull osd(s); backfill paused"
+                ),
+                "detail": [f"osd.{o} is backfillfull" for o in bfull],
+            }
+        if near:
+            checks["OSD_NEARFULL"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(near)} nearfull osd(s)",
+                "detail": [f"osd.{o} is nearfull" for o in near],
+            }
+        if any(c["severity"] == "HEALTH_ERR" for c in checks.values()):
+            status = "HEALTH_ERR"
+        else:
+            status = "HEALTH_OK" if not checks else "HEALTH_WARN"
         return {"status": status, "checks": checks}
 
     def _config_sections_for(self, who: tuple[str, int]) -> dict:
@@ -1353,7 +1445,7 @@ class Monitor:
             # not mutations, but only the leader ingests pg stats and
             # knows the live quorum: redirect so peons don't serve an
             # empty status plane
-            "status", "health", "pg stat",
+            "status", "health", "pg stat", "df", "osd df",
         )
         if mutating and not self.is_leader:
             leader = self.paxos.leader if self.paxos.leader is not None else -1
@@ -1481,6 +1573,63 @@ class Monitor:
                 return await self._scrub(
                     cmd, deep=prefix != "pg scrub",
                     repair=prefix == "pg repair")
+            if prefix == "df":
+                # `ceph df` (reference MgrStatMonitor/`df` detail):
+                # cluster raw totals from beacon statfs + per-pool
+                # logical usage aggregated from pg stats
+                om = self.osdmap
+                book = getattr(self, "_osd_statfs", {}) or {}
+                live = {o: s for o, s in book.items() if om.exists(o)}
+                pools: dict[str, dict] = {}
+                for pgid, st in (getattr(self, "_pg_stats", {}) or {}).items():
+                    pid = int(pgid.split(".")[0])
+                    if pid not in om.pools:
+                        continue
+                    name = om.pool_names.get(pid, str(pid))
+                    d = pools.setdefault(
+                        name, {"id": pid, "objects": 0, "bytes_used": 0})
+                    d["objects"] += int(st.get("objects", 0))
+                    d["bytes_used"] += int(st.get("bytes", 0))
+                data = json.dumps({
+                    "stats": {
+                        "total_bytes": sum(
+                            int(s.get("total", 0)) for s in live.values()),
+                        "total_used_bytes": sum(
+                            int(s.get("used", 0)) for s in live.values()),
+                        "total_avail_bytes": sum(
+                            int(s.get("available", 0))
+                            for s in live.values()),
+                    },
+                    "pools": pools,
+                }).encode()
+                return 0, "", data
+            if prefix == "osd df":
+                # `ceph osd df`: per-osd usage + fullness state
+                om = self.osdmap
+                book = getattr(self, "_osd_statfs", {}) or {}
+                nodes = []
+                for o in range(om.max_osd):
+                    if not om.exists(o):
+                        continue
+                    sf = book.get(o, {})
+                    t = int(sf.get("total", 0))
+                    u = int(sf.get("used", 0))
+                    state = []
+                    if om.is_full(o):
+                        state.append("full")
+                    elif om.is_backfillfull(o):
+                        state.append("backfillfull")
+                    elif om.is_nearfull(o):
+                        state.append("nearfull")
+                    nodes.append({
+                        "id": o,
+                        "total": t,
+                        "used": u,
+                        "available": int(sf.get("available", 0)),
+                        "utilization": (u / t) if t else 0.0,
+                        "state": state,
+                    })
+                return 0, "", json.dumps({"nodes": nodes}).encode()
             if prefix == "status":
                 om = self.osdmap
                 pgsum = self._pg_summary()

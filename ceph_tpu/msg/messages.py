@@ -79,18 +79,23 @@ class MOSDBeacon(Message):
 
     TYPE = 97
 
-    def __init__(self, osd: int = 0, epoch: int = 0, pg_stats: bytes = b""):
+    def __init__(self, osd: int = 0, epoch: int = 0, pg_stats: bytes = b"",
+                 statfs: bytes = b""):
         self.osd, self.epoch = osd, epoch
         self.pg_stats = pg_stats  # json: {"pool.ps": {state, objects}}
+        # json {"total", "used", "available"} from ObjectStore.statfs —
+        # the osd_stat_t usage block of the reference's MPGStats
+        self.statfs = statfs
 
     def encode_payload(self, enc):
         enc.i32(self.osd)
         enc.u32(self.epoch)
         enc.bytes_(self.pg_stats)
+        enc.bytes_(self.statfs)
 
     @classmethod
     def decode_payload(cls, dec):
-        return cls(dec.i32(), dec.u32(), dec.bytes_())
+        return cls(dec.i32(), dec.u32(), dec.bytes_(), dec.bytes_())
 
 
 class MOSDFailure(Message):

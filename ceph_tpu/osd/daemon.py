@@ -100,6 +100,11 @@ from ceph_tpu.msg.messages import (
     OP_ZERO,
 )
 from ceph_tpu.msg.messenger import Connection, Message, Messenger
+
+# space-freeing write ops stay admissible when FULL — they are how an
+# operator digs a cluster out (reference: deletes pass _check_full)
+_DELETE_OPS = frozenset(
+    {OP_DELETE, OP_OMAP_RMKEYS, OP_OMAP_CLEAR, OP_RMXATTR})
 from ceph_tpu.ops.hashing import ceph_str_hash_rjenkins
 from ceph_tpu.osd import ecutil
 from ceph_tpu.osd.mapenc import apply_map_message
@@ -423,7 +428,26 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                 t.cancel()
         await self.messenger.shutdown()
 
+    def _statfs(self) -> dict:
+        """This OSD's store usage; cached per beacon tick.  Also drives
+        the local failsafe write gate (_check_full role)."""
+        try:
+            sf = self.store.statfs()
+        except (NotImplementedError, OSError):
+            sf = {"total": 1 << 40, "used": 0, "available": 1 << 40}
+        self._last_statfs = sf
+        return sf
+
+    def _full_ratio(self) -> float:
+        sf = getattr(self, "_last_statfs", None)
+        if sf is None:
+            sf = self._statfs()
+        total = sf.get("total", 0)
+        return (sf.get("used", 0) / total) if total else 0.0
+
     async def _beacon(self) -> None:
+        import json as _json
+
         while not self.stopping:
             await asyncio.sleep(self.beacon_interval)
             try:
@@ -434,7 +458,8 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                     log.exception("osd.%d: pg-stat collection failed", self.id)
                 await self._mon_conn.send_message(
                     MOSDBeacon(osd=self.id, epoch=self.epoch,
-                               pg_stats=stats)
+                               pg_stats=stats,
+                               statfs=_json.dumps(self._statfs()).encode())
                 )
             except ConnectionError:
                 continue  # mon died; the rehome task is hunting
@@ -477,10 +502,25 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                     None,
                 )
                 n_obj = 0
+                n_bytes = 0
                 if my_shard is not None:
                     shard = my_shard if pool.is_erasure() else NO_SHARD
-                    n_obj = len(self._local_objects(pool, pg, shard))
-                out[f"{pid}.{ps}"] = {"state": state, "objects": n_obj}
+                    names = self._local_objects(pool, pg, shard)
+                    n_obj = len(names)
+                    c = self._shard_coll(pool, pg, shard)
+                    for nm in names:
+                        try:
+                            n_bytes += self.store.stat(c, ghobject_t(nm))
+                        except FileNotFoundError:
+                            continue
+                    if pool.is_erasure():
+                        # shard bytes -> logical bytes (k data shards)
+                        k = int(self.osdmap.erasure_code_profiles.get(
+                            pool.erasure_code_profile, {}).get("k", 1)
+                            or 1)
+                        n_bytes *= k
+                out[f"{pid}.{ps}"] = {
+                    "state": state, "objects": n_obj, "bytes": n_bytes}
         return _json.dumps(out).encode()
 
     @property
@@ -1277,6 +1317,27 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         # versions mint under the epoch primacy was verified at, even
         # if the map advances mid-op (see _next_version)
         admit_epoch = self.epoch
+        if msg.is_write():
+            # fullness gate (reference OSD::_check_full, OSD.cc:890):
+            # a write to a PG any of whose acting members the map marks
+            # FULL — or whose primary's own store is past the local
+            # failsafe — bounces with ENOSPC rather than corrupting a
+            # store that has nowhere to put it.  Deletes must pass: they
+            # are how an operator recovers from FULL.
+            only_deletes = all(
+                (not o.is_write()) or o.op in _DELETE_OPS
+                for o in msg.ops)
+            if not only_deletes:
+                om = self.osdmap
+                if (
+                    self._full_ratio()
+                    >= self.conf["osd_failsafe_full_ratio"]
+                    or any(o != CRUSH_ITEM_NONE and om.is_full(o)
+                           for o in acting)
+                ):
+                    return MOSDOpReply(
+                        tid=msg.tid, result=-errno.ENOSPC,
+                        epoch=self.epoch)
         if any(o.op in (OP_WATCH, OP_UNWATCH, OP_NOTIFY) for o in msg.ops):
             return await self._watch_notify_vector(pool, pg, msg)
         tiered = (

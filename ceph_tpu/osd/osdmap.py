@@ -29,6 +29,15 @@ from ceph_tpu.osd.types import (
 
 CEPH_OSD_EXISTS = 1
 CEPH_OSD_UP = 2
+# fullness states, mon-committed from beacon statfs (the reference
+# keeps these per-osd in the map too: CEPH_OSD_NEARFULL/.../FULL,
+# src/mon/OSDMonitor.cc:669-671); they ride the existing per-osd u8
+# state byte on the wire
+CEPH_OSD_NEARFULL = 4
+CEPH_OSD_BACKFILLFULL = 8
+CEPH_OSD_FULL = 16
+CEPH_OSD_FULL_MASK = (
+    CEPH_OSD_NEARFULL | CEPH_OSD_BACKFILLFULL | CEPH_OSD_FULL)
 
 
 class _InvalidatingDict(dict):
@@ -223,6 +232,19 @@ class OSDMap:
 
     def is_out(self, osd: int) -> bool:
         return not self.exists(osd) or self.osd_weight[osd] == 0
+
+    def is_full(self, osd: int) -> bool:
+        return self.exists(osd) and bool(
+            self.osd_state[osd] & CEPH_OSD_FULL)
+
+    def is_backfillfull(self, osd: int) -> bool:
+        # FULL implies backfillfull (ratios are ordered)
+        return self.exists(osd) and bool(
+            self.osd_state[osd] & (CEPH_OSD_BACKFILLFULL | CEPH_OSD_FULL))
+
+    def is_nearfull(self, osd: int) -> bool:
+        return self.exists(osd) and bool(
+            self.osd_state[osd] & CEPH_OSD_FULL_MASK)
 
     def mark_down(self, osd: int) -> None:
         self.invalidate_mapping_cache()

@@ -237,6 +237,18 @@ class RecoveryMixin:
     async def _handle_backfill_reserve(self, msg: MBackfillReserve) -> None:
         if msg.op == MBackfillReserve.REQUEST:
             key = (msg.pool, msg.ps, msg.from_osd)
+            if (self._full_ratio()
+                    >= self.conf["mon_osd_backfillfull_ratio"]):
+                # backfillfull: absorbing a backfill would push this
+                # store toward FULL (reference REJECT_TOOFULL path,
+                # doc/dev/osd_internals/backfill_reservation.rst) —
+                # the primary backs off and retries; log-based
+                # recovery of existing objects is unaffected
+                await msg.conn.send_message(MBackfillReserve(
+                    tid=msg.tid, op=MBackfillReserve.REJECT_TOOFULL,
+                    pool=msg.pool, ps=msg.ps, from_osd=self.id,
+                ))
+                return
             res = self.remote_reserver.try_request(key, msg.priority)
             if res is not None:
                 self._remote_grants[key] = res

@@ -204,8 +204,12 @@ class BlockStore(ObjectStore):
 
     def __init__(self, path: str, db=None, compression: str = "none",
                  compression_required_ratio: float = 0.875,
-                 allocator: str = "first-fit"):
+                 allocator: str = "first-fit",
+                 capacity_bytes: int = 1 << 40):
         self.path = path
+        # advertised device size for statfs (the block file itself
+        # grows on demand up to this)
+        self.capacity_bytes = capacity_bytes
         os.makedirs(path, exist_ok=True)
         self.db = db if db is not None else FileDB(os.path.join(path, "kv"))
         self._block_path = os.path.join(path, "block")
@@ -224,6 +228,15 @@ class BlockStore(ObjectStore):
     blocking_commit = True
 
     # -- lifecycle -----------------------------------------------------
+
+    def statfs(self) -> dict:
+        used_units = self._alloc.end_units - self._alloc.free_units()
+        used = used_units * MIN_ALLOC
+        return {
+            "total": self.capacity_bytes,
+            "used": used,
+            "available": max(0, self.capacity_bytes - used),
+        }
 
     def mount(self) -> None:
         if hasattr(self.db, "mount"):

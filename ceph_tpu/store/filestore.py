@@ -171,6 +171,18 @@ class FileStore(ObjectStore):
 
     # -- mount/replay --------------------------------------------------
 
+    def statfs(self) -> dict:
+        """Host-filesystem truth (the FileStore reported its backing
+        fs the same way)."""
+        st = os.statvfs(self.path)
+        total = st.f_frsize * st.f_blocks
+        avail = st.f_frsize * st.f_bavail
+        return {
+            "total": total,
+            "used": max(0, total - avail),
+            "available": avail,
+        }
+
     def mount(self) -> None:
         os.makedirs(self.path, exist_ok=True)
         cp = os.path.join(self.path, "checkpoint")

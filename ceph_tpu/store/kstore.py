@@ -140,6 +140,20 @@ class KStore(ObjectStore):
         commits off the event loop (FileDB fsyncs per batch)."""
         return bool(getattr(self.db, "blocking_commit", False))
 
+    def statfs(self) -> dict:
+        """Backing-fs truth when the kv store lives on disk (FileDB
+        with a path), else a large virtual device."""
+        import os as _os
+
+        path = getattr(self.db, "path", None)
+        if path and _os.path.isdir(_os.path.dirname(path) or path):
+            st = _os.statvfs(_os.path.dirname(path) or path)
+            total = st.f_frsize * st.f_blocks
+            avail = st.f_frsize * st.f_bavail
+            return {"total": total, "used": max(0, total - avail),
+                    "available": avail}
+        return {"total": 1 << 40, "used": 0, "available": 1 << 40}
+
     def mount(self) -> None:
         if hasattr(self.db, "mount"):
             self.db.mount()

@@ -42,9 +42,25 @@ class _Obj:
 
 
 class MemStore(ObjectStore):
-    def __init__(self) -> None:
+    def __init__(self, quota_bytes: int = 1 << 40) -> None:
         self._colls: dict[coll_t, dict[ghobject_t, _Obj]] = {}
         self._lock = threading.RLock()
+        # virtual device size for the statfs/fullness plane (tests set
+        # it small to drive FULL states; reference MemStore reports
+        # memstore_device_bytes the same way)
+        self.quota_bytes = quota_bytes
+
+    def statfs(self) -> dict:
+        with self._lock:
+            used = sum(
+                len(o.data)
+                for objs in self._colls.values() for o in objs.values()
+            )
+        return {
+            "total": self.quota_bytes,
+            "used": used,
+            "available": max(0, self.quota_bytes - used),
+        }
 
     # -- transactions --------------------------------------------------
 

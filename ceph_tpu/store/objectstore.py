@@ -160,6 +160,14 @@ class ObjectStore:
     def mount(self) -> None: ...
     def umount(self) -> None: ...
 
+    def statfs(self) -> dict:
+        """{"total": bytes, "used": bytes, "available": bytes} — the
+        ObjectStore::statfs surface the fullness plane consumes
+        (reference src/os/ObjectStore.h; consumed by
+        OSD.cc:773 recalc_full_state and `ceph osd df`).  Stores
+        report; admission control enforces."""
+        raise NotImplementedError
+
     def queue_transaction(self, txn: Transaction) -> None:
         raise NotImplementedError
 
