@@ -705,13 +705,25 @@ class Monitor:
             var, val = op["var"], op["val"]
             if var == "pg_num":
                 n = int(val)
-                if n <= pool.pg_num:
+                if n == pool.pg_num or n < 1:
                     return  # replay / stale
-                # pgp_num follows pg_num in one step: children place
-                # independently at once, and recovery pulls them from
-                # the parent's prior interval (ancestor-aware)
+                # pgp_num follows pg_num in one step: on growth,
+                # children place independently at once and recovery
+                # pulls from the parent's prior interval
+                # (ancestor-aware); on shrink, OSDs fold dissolving
+                # children into their targets (PG::merge_from) and
+                # targets pull from the children's prior homes
                 pool.pg_num = n
                 pool.pgp_num = n
+                om.invalidate_mapping_cache()
+                # reports for dissolved children are meaningless now
+                book = getattr(self, "_pg_stats", {}) or {}
+                for pgid in [
+                    k for k in book
+                    if int(k.split(".")[0]) == op["pool"]
+                    and int(k.split(".")[1]) >= n
+                ]:
+                    del book[pgid]
             elif var == "size":
                 pool.size = int(val)
             elif var == "min_size":
@@ -906,6 +918,8 @@ class Monitor:
             pid = int(pid_s)
             if pid not in om.pools:
                 continue
+            if int(ps_s) >= om.pools[pid].pg_num:
+                continue  # dissolved merge child (late beacon)
             state = st.get("state", "unknown")
             # a report from a primary that is now down — or that is no
             # longer THE primary after a remap — is STALE until the
@@ -1095,7 +1109,10 @@ class Monitor:
         """The acting half of the pg_autoscaler: pools that opted in
         (pg_autoscale_mode=on) get their advised pg_num APPLIED through
         paxos — reference src/pybind/mgr/pg_autoscaler/module.py
-        _maybe_adjust.  Grow-only (pg merge unsupported)."""
+        _maybe_adjust.  Shrinks as well as grows (pg merge); like the
+        reference's threshold, a shrink only fires when the advised
+        count is under half the current one, so the scaler can't
+        oscillate around a boundary."""
         interval = self.conf["mon_pg_autoscale_interval"]
         while True:
             await asyncio.sleep(interval)
@@ -1104,13 +1121,15 @@ class Monitor:
             try:
                 for row in self._autoscale_rows():
                     pool = self.osdmap.pools.get(row["pool_id"])
-                    if (
-                        pool is None
-                        or pool.extra.get("pg_autoscale_mode") != "on"
-                        or row["new_pg_num"] <= pool.pg_num
+                    if pool is None or pool.extra.get(
+                            "pg_autoscale_mode") != "on":
+                        continue
+                    new = row["new_pg_num"]
+                    if new == pool.pg_num or (
+                        new < pool.pg_num and new * 2 > pool.pg_num
                     ):
                         continue
-                    log.info("mon.%d: autoscaler growing pool %d "
+                    log.info("mon.%d: autoscaler resizing pool %d "
                              "pg_num %d -> %d", self.rank,
                              row["pool_id"], pool.pg_num,
                              row["new_pg_num"])
@@ -1133,19 +1152,37 @@ class Monitor:
     async def _pool_set(self, cmd: dict[str, str]) -> tuple[int, str, bytes]:
         """osd pool set <pool> <var> <val> (OSDMonitor::prepare_command
         pool ops, src/mon/OSDMonitor.cc:7339+).  pg_num increases split
-        PGs on the OSDs; merges are not supported (EPERM)."""
+        PGs on the OSDs; decreases merge them (PG::merge_from,
+        src/osd/PG.cc:563)."""
         import errno
 
         pid, pool = self._pool_by_name(cmd["pool"])
         var, val = cmd["var"], cmd["val"]
         if var == "pg_num":
             n = int(val)
-            if n < pool.pg_num:
-                return -errno.EPERM, "pg merge not supported", b""
             if n == pool.pg_num:
                 return 0, "no change", b""
+            if n < 1:
+                return -errno.EINVAL, "pg_num must be >= 1", b""
             if n > 65536:
                 return -errno.ERANGE, "pg_num too large", b""
+            if n < pool.pg_num:
+                # merge only commits on a CLEAN pool (the reference's
+                # ready_to_merge gate, OSDMonitor pg_num_pending
+                # machinery): the dissolving children's logs fold into
+                # targets with incomparable version sequences, which
+                # is only safe when nothing is degraded or pending
+                book = getattr(self, "_pg_stats", {}) or {}
+                for ps in range(pool.pg_num):
+                    st = book.get(f"{pid}.{ps}")
+                    if (
+                        st is None
+                        or st.get("state") != "active+clean"
+                        or not self.osdmap.is_up(st.get("primary", -1))
+                    ):
+                        return (-errno.EBUSY,
+                                "pool not clean; merge requires every "
+                                "pg active+clean", b"")
         elif var in ("size", "min_size"):
             n = int(val)
             if not 1 <= n <= 16:

@@ -825,13 +825,16 @@ class MOSDPGQuery(Message):
     def __init__(
         self, tid: int = 0, pg: pg_t = pg_t(0, 0), shard: int = -1,
         from_osd: int = 0, since=None, want_objects: bool = False,
-        epoch: int = 0,
+        epoch: int = 0, clear_merge: bool = False,
     ):
         from ceph_tpu.osd.pglog import ZERO
 
         self.tid, self.pg, self.shard, self.from_osd = tid, pg, shard, from_osd
         self.since = since if since is not None else ZERO
         self.want_objects, self.epoch = want_objects, epoch
+        # primary finished the post-merge reconcile: drop your
+        # merge_pending marker (see RecoveryMixin._merge_pending)
+        self.clear_merge = clear_merge
 
     def encode_payload(self, enc):
         enc.u64(self.tid)
@@ -840,13 +843,15 @@ class MOSDPGQuery(Message):
         _enc_ev(enc, self.since)
         enc.bool_(self.want_objects)
         enc.u32(self.epoch)
+        enc.bool_(self.clear_merge)
 
     @classmethod
     def decode_payload(cls, dec):
         tid = dec.u64()
         pg, shard = _dec_pg(dec)
         return cls(
-            tid, pg, shard, dec.i32(), _dec_ev(dec), dec.bool_(), dec.u32()
+            tid, pg, shard, dec.i32(), _dec_ev(dec), dec.bool_(),
+            dec.u32(), dec.bool_(),
         )
 
 
@@ -860,7 +865,7 @@ class MOSDPGInfo(Message):
         from_osd: int = 0, last_update=None, log_tail=None,
         entries: list[bytes] | None = None,
         objects: list[tuple[str, bytes]] | None = None, epoch: int = 0,
-        past_acting: bytes = b"",
+        past_acting: bytes = b"", merge_pending: bool = False,
     ):
         from ceph_tpu.osd.pglog import ZERO
 
@@ -873,6 +878,10 @@ class MOSDPGInfo(Message):
         # json chain of previous acting sets this member witnessed
         # (PastIntervals sharing via pg info, newest last)
         self.past_acting = past_acting
+        # this member's shard coll carries a not-yet-reconciled pg
+        # merge (its listing may include objects other members' logs
+        # cannot order) — the primary must not stray-reap this pass
+        self.merge_pending = merge_pending
 
     def encode_payload(self, enc):
         enc.u64(self.tid)
@@ -889,6 +898,7 @@ class MOSDPGInfo(Message):
             enc.bytes_(v)
         enc.u32(self.epoch)
         enc.bytes_(self.past_acting)
+        enc.bool_(self.merge_pending)
 
     @classmethod
     def decode_payload(cls, dec):
@@ -900,7 +910,7 @@ class MOSDPGInfo(Message):
         entries = [dec.bytes_() for _ in range(dec.u32())]
         objects = [(dec.str_(), dec.bytes_()) for _ in range(dec.u32())]
         return cls(tid, pg, shard, from_osd, lu, lt, entries, objects,
-                   dec.u32(), dec.bytes_())
+                   dec.u32(), dec.bytes_(), dec.bool_())
 
 
 class MOSDPGLog(Message):

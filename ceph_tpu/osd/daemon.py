@@ -939,6 +939,29 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                 else:
                     _u2, _up2, acting_old, _p2 = (
                         old_map.pg_to_up_acting_osds(pg, folded=True))
+                if old_pool.pg_num > pool.pg_num:
+                    # merge: the dissolving children's members hold
+                    # refiled target objects — their old homes are
+                    # prior intervals of the TARGET (inverse of the
+                    # split-ancestor rule above)
+                    for cps in range(pool.pg_num, old_pool.pg_num):
+                        if pool.raw_pg_to_pg(pg_t(pid, cps)).ps != ps:
+                            continue
+                        _u3, _up3, acting_child, _p3 = (
+                            old_map.pg_to_up_acting_osds(
+                                pg_t(pid, cps), folded=True))
+                        if (
+                            acting_child
+                            and acting_child != acting
+                            and (self.id in acting
+                                 or self.id in acting_child)
+                        ):
+                            hist = self._past_acting.setdefault(
+                                (pid, ps), [])
+                            if acting_child not in hist:
+                                hist.append(list(acting_child))
+                                del hist[:-16]
+                                changed = True
                 if acting_old == acting:
                     continue
                 if self.id not in acting and self.id not in acting_old:
@@ -1016,39 +1039,92 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         return out
 
     def _maybe_split_pgs(self, old_map, new_map) -> None:
-        """PG splitting, local half (the reference's PG::split_colls /
-        OSD::split_pgs, src/osd/OSD.cc + PG.cc): when a pool's pg_num
-        grows, every local object whose name now folds to a child ps
-        moves into the child's collection via collection_move_rename —
-        the same primitive the reference's split uses.  The cluster
-        half (children placing onto new OSDs) is ordinary recovery:
-        _track_intervals records the parent's old acting set as the
-        child's prior interval, so the child's primary pulls from the
+        """PG splitting AND merging, local half (the reference's
+        PG::split_colls / OSD::split_pgs and PG::merge_from,
+        src/osd/OSD.cc + PG.cc:563): when a pool's pg_num grows, every
+        local object whose name now folds to a child ps moves into the
+        child's collection via collection_move_rename; when it
+        shrinks, dissolving children fold their objects AND pg log
+        into the merge target.  The cluster half (children/targets
+        placing onto new OSDs) is ordinary recovery: _track_intervals
+        records the prior homes (the parent's for split children, the
+        children's for merge targets), so the primary pulls from the
         members holding the refiled data.
 
         Runs on EVERY first map after boot too (old_map None): a crash
-        mid-split leaves misfolded objects behind, and the reconcile
-        pass refiles them from persistent stores."""
+        mid-split/merge leaves misfolded objects behind, and the
+        reconcile pass refiles them from persistent stores."""
         pools = new_map.pools.items()
         if old_map is not None:
             pools = [
                 (pid, p) for pid, p in pools
                 if pid in old_map.pools
-                and p.pg_num > old_map.pools[pid].pg_num
+                and p.pg_num != old_map.pools[pid].pg_num
             ]
         for _pid, pool in pools:
             try:
+                merged = self._refile_merge_collections(pool)
                 moved = self._refile_split_collections(pool)
             except Exception:
-                log.exception("osd.%d: pg split refile failed", self.id)
+                log.exception("osd.%d: pg resize refile failed", self.id)
                 continue
-            if moved:
-                log.info("osd.%d: pg split pool %d: refiled %d objects",
-                         self.id, pool.id, moved)
-                # split invalidates the parent PGs' clean verdicts
+            if moved or merged:
+                log.info(
+                    "osd.%d: pg resize pool %d: refiled %d objects "
+                    "(split) + %d (merge)",
+                    self.id, pool.id, moved, merged)
+                # resize invalidates the pool's clean verdicts
                 for key in list(self._clean_epoch):
                     if key[0] == pool.id:
                         del self._clean_epoch[key]
+
+    def _refile_merge_collections(self, pool) -> int:
+        """Fold collections of dissolved PGs (ps >= pg_num) into their
+        merge targets: objects move, the child's log merges
+        (PGLog.merge_from), and the child collection dies — one
+        transaction per child, so a crash leaves the child whole and
+        the boot reconcile re-runs it."""
+        from ceph_tpu.store.objectstore import META_COLL
+
+        moved = 0
+        for c in list(self.store.list_collections()):
+            if c.pool != pool.id or c == META_COLL:
+                continue
+            if c.ps < pool.pg_num:
+                continue  # survivor
+            target_ps = pool.raw_pg_to_pg(pg_t(pool.id, c.ps)).ps
+            dst = coll_t(pool.id, target_ps, c.shard)
+            t = Transaction()
+            if not self.store.collection_exists(dst):
+                t.create_collection(dst)
+            try:
+                objs = list(self.store.collection_list(c))
+            except FileNotFoundError:
+                continue
+            meta_objs = []
+            for o in objs:
+                if o.name == PGMETA_OID:
+                    meta_objs.append(o)
+                    continue
+                t.collection_move_rename(c, o, dst, o)
+                moved += 1
+            child_lg = self._pg_log(c)
+            target_lg = self._pg_log(dst)
+            target_lg.merge_from(t, child_lg)
+            # per-child version sequences are incomparable: the first
+            # post-merge recovery pass must backfill-reconcile without
+            # listing-based stray reaping (the mon only merges CLEAN
+            # pools, so nothing legitimate is pending deletion) — the
+            # marker rides the merge transaction and the primary
+            # clears it after its first complete pass
+            t.omap_setkeys(dst, target_lg.meta, {"merge_pending": b"1"})
+            for o in meta_objs:
+                t.remove(c, o)
+            t.remove_collection(c)
+            self.store.queue_transaction(t)
+            self._pg_logs.pop(c, None)
+            self._clean_epoch.pop((pool.id, c.ps), None)
+        return moved
 
     def _refile_split_collections(self, pool) -> int:
         from ceph_tpu.store.objectstore import META_COLL

@@ -254,6 +254,29 @@ class PGLog:
             ])
         t.omap_setkeys(self.cid, self.meta, {INFO_KEY: self.info.encode()})
 
+    def merge_from(self, t: Transaction, child: "PGLog") -> None:
+        """PG::merge_from twin (reference src/osd/PG.cc:563, called on
+        pg_num shrink): the dissolving child pg's log folds into this
+        (target) log.  Entries move wholesale; version bounds take the
+        elementwise max so neither side's completeness claim widens —
+        a peer whose state predates the merged tail must backfill,
+        matching the reference's conservative stance on merge (it
+        forces backfill when either side's history is short).  The
+        child's on-disk meta dies with its collection in the same
+        transaction (caller removes it)."""
+        t.touch(self.cid, self.meta)
+        kv: dict[str, bytes] = {}
+        for e in child.entries.values():
+            self.entries[e.version] = e
+            self._track_reqid(e)
+            kv[LOG_KEY_PREFIX + e.version.key()] = e.encode()
+        if child.info.last_update > self.info.last_update:
+            self.info.last_update = child.info.last_update
+        if child.info.log_tail > self.info.log_tail:
+            self.info.log_tail = child.info.log_tail
+        kv[INFO_KEY] = self.info.encode()
+        t.omap_setkeys(self.cid, self.meta, kv)
+
     # -- persistence ---------------------------------------------------
 
     def load(self, store: ObjectStore) -> None:

@@ -355,6 +355,12 @@ class RecoveryMixin:
             prior = self._prior_pairs(pool, pg, pairs)
 
         pre_adopt_lu = lg.info.last_update
+        # any participant still carrying a merge_pending marker means
+        # listings are a cross-child superposition this pass must not
+        # stray-reap from (see _merge_pending)
+        merge_seen = self._merge_pending(myc, lg) or any(
+            getattr(i, "merge_pending", False) for i in peer_infos.values()
+        )
         ahead = [
             i for i in peer_infos.values()
             if i.last_update > lg.info.last_update
@@ -427,8 +433,10 @@ class RecoveryMixin:
                             self._local_objects(pool, pg, s))
                     except FileNotFoundError:
                         continue
-                    lus[(s, o)] = self._pg_log(
-                        self._shard_coll(pool, pg, s)).info.last_update
+                    sc = self._shard_coll(pool, pg, s)
+                    slg = self._pg_log(sc)
+                    lus[(s, o)] = slg.info.last_update
+                    merge_seen |= self._merge_pending(sc, slg)
                     objs |= lists[(s, o)]
                     continue
                 try:
@@ -443,6 +451,7 @@ class RecoveryMixin:
                     info.last_update if info is not None
                     else full.last_update
                 )
+                merge_seen |= getattr(full, "merge_pending", False)
                 objs |= lists[(s, o)]
                 if _merge_chain(getattr(full, "past_acting", b"")):
                     # chain-follow: the old home knew an even older one
@@ -471,6 +480,21 @@ class RecoveryMixin:
                 self._save_past_acting()  # one write after the drain
             auth = max(lus, key=lambda k: lus[k])
             strays = objs - lists[auth]
+            log.debug(
+                "osd.%d: pg %s backfill: objs=%d prior=%s lists=%s "
+                "auth=%s strays=%d", self.id, pg, len(objs), prior,
+                {k: len(v) for k, v in lists.items()}, auth, len(strays))
+            if strays and merge_seen:
+                # first pass after a pg merge: per-child version
+                # sequences are incomparable, so the listing-based
+                # stray heuristic would reap freshly-merged objects
+                # (merge only commits on CLEAN pools — see
+                # _refile_merge_collections — so no genuine
+                # deleted-while-down strays can exist here)
+                log.info(
+                    "osd.%d: pg %s merge reconcile: %d would-be strays "
+                    "kept", self.id, pg, len(strays))
+                strays = set()
         else:
             objs = scope
         all_ok = True
@@ -523,11 +547,43 @@ class RecoveryMixin:
         if all_ok:
             if self._past_acting.pop((pg.pool, pg.ps), None) is not None:
                 self._save_past_acting()
+            if merge_seen:
+                # verified: resolve every participant's merge marker so
+                # normal stray semantics resume (best-effort — a missed
+                # peer stays conservative, never destructive)
+                for s in range(pool.size if pool.is_erasure() else 1):
+                    sc = self._shard_coll(
+                        pool, pg, s if pool.is_erasure() else NO_SHARD)
+                    slg = self._pg_log(sc)
+                    if self._merge_pending(sc, slg):
+                        t3 = Transaction()
+                        t3.omap_rmkeys(sc, slg.meta, ["merge_pending"])
+                        self.store.queue_transaction(t3)
+                for s, o in set(pairs) | set(prior):
+                    if o == self.id:
+                        continue
+                    try:
+                        await self._pg_query(
+                            pool, pg, s, o, since=lg.info.last_update,
+                            clear_merge=True)
+                    except (OSError, asyncio.TimeoutError,
+                            ConnectionError):
+                        continue
         else:
             log.warning(
                 "osd.%d: %s recovery pass incomplete; retaining past "
                 "intervals", self.id, pg)
         return all_ok
+
+    def _merge_pending(self, myc, lg) -> bool:
+        """True while this PG's first post-merge reconcile has not
+        completed (marker written by _refile_merge_collections)."""
+        try:
+            vals = self.store.omap_get_values(
+                myc, lg.meta, ["merge_pending"])
+        except (FileNotFoundError, OSError):
+            return False
+        return vals.get("merge_pending") == b"1"
 
     async def _reconcile_object(
         self, pool: PgPool, pg: pg_t, pairs: list[tuple[int, int]], oid: str,
@@ -798,7 +854,8 @@ class RecoveryMixin:
         ), tid)
 
     async def _pg_query(
-        self, pool, pg, shard, osd, since, want_objects: bool = False
+        self, pool, pg, shard, osd, since, want_objects: bool = False,
+        clear_merge: bool = False,
     ) -> MOSDPGInfo:
         if osd == self.id:
             raise ValueError("query self")
@@ -806,6 +863,7 @@ class RecoveryMixin:
         return await self._sub_op(osd, MOSDPGQuery(
             tid=tid, pg=pg, shard=shard, from_osd=self.id, since=since,
             want_objects=want_objects, epoch=self.epoch,
+            clear_merge=clear_merge,
         ), tid)
 
     async def _pg_log_send(self, pool, pg, shard, osd, entries, tail) -> None:
@@ -848,6 +906,12 @@ class RecoveryMixin:
         pool = self.osdmap.get_pg_pool(msg.pg.pool)
         c = self._shard_coll(pool, msg.pg, msg.shard)
         lg = self._pg_log(c)
+        if msg.clear_merge and self._merge_pending(c, lg):
+            # primary verified the post-merge reconcile: the listing
+            # superposition is resolved, normal stray semantics resume
+            tcm = Transaction()
+            tcm.omap_rmkeys(c, lg.meta, ["merge_pending"])
+            self.store.queue_transaction(tcm)
         entries = [e.encode() for e in lg.entries_after(msg.since)]
         objects: list[tuple[str, bytes]] = []
         if msg.want_objects and self.store.collection_exists(c):
@@ -868,6 +932,7 @@ class RecoveryMixin:
             last_update=lg.info.last_update, log_tail=lg.info.log_tail,
             entries=entries, objects=objects, epoch=self.epoch,
             past_acting=_json.dumps(chain).encode() if chain else b"",
+            merge_pending=self._merge_pending(c, lg),
         ))
 
     async def _handle_pg_log(self, msg: MOSDPGLog) -> None:

@@ -74,11 +74,31 @@ class TestPGSplit:
                     if k.startswith("1.") and v.get("objects", 0) > 0)
                 assert pgs_with_objects > 4, book
 
-                # merge attempts are refused
+                # merge back: 8 -> 4 (PG::merge_from twin) — data
+                # survives, stats re-aggregate over 4 PGs, dissolved
+                # children's collections disappear
                 code, rs, _ = await c.client.command({
                     "prefix": "osd pool set", "pool": "sp",
                     "var": "pg_num", "val": "4"})
-                assert code == -errno.EPERM
+                assert code == 0, rs
+                status = await c.client.wait_clean(timeout=90)
+                assert status["pgs"]["num_pgs"] == 4
+                for oid, blob in data.items():
+                    assert await io.read(oid) == blob, oid
+                await io.write_full("post-merge", b"merged write")
+                assert await io.read("post-merge") == b"merged write"
+                # no collection for a dissolved child survives anywhere
+                for o in c.osds:
+                    assert not any(
+                        cc.pool == 1 and cc.ps >= 4
+                        for cc in o.store.list_collections()
+                        if cc.pool >= 0), o.id
+                # stats plane carries no ghost children
+                code, _, out = await c.client.command({"prefix": "pg stat"})
+                book = json.loads(out)["pg_stats"]
+                assert not any(
+                    k.startswith("1.") and int(k.split(".")[1]) >= 4
+                    for k in book), book
         run(go())
 
     def test_split_then_kill_osd_recovers(self):
@@ -111,6 +131,46 @@ class TestPGSplit:
                 code, _, _ = await c.client.command(
                     {"prefix": "osd out", "id": str(victim)})
                 assert code == 0
+                await c.client.wait_clean(timeout=120)
+                for oid, blob in data.items():
+                    assert await io.read(oid) == blob, oid
+        run(go())
+
+
+class TestPGMergeUnderFailure:
+    def test_merge_then_kill_osd_recovers(self):
+        """Merge + failure: targets must recover like any PG (their
+        past intervals include the dissolved children's old homes)."""
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                await c.client.ec_profile_set(
+                    "p", {"plugin": "jax", "k": "2", "m": "1"})
+                await c.client.pool_create(
+                    "mkl", pg_num=4, pool_type="erasure",
+                    erasure_code_profile="p")
+                io = c.client.ioctx("mkl")
+                data = _payloads(20)
+                for oid, blob in data.items():
+                    await io.write_full(oid, blob)
+                await c.client.wait_clean(timeout=60)
+                code, rs, _ = await c.client.command({
+                    "prefix": "osd pool set", "pool": "mkl",
+                    "var": "pg_num", "val": "2"})
+                assert code == 0, rs
+                # gate on post-merge epochs: the targets' pre-merge
+                # active+clean reports must not satisfy the wait
+                code, _, data_ = await c.client.command(
+                    {"prefix": "status"})
+                merge_epoch = json.loads(data_)["epoch"]
+                await c.client.wait_clean(
+                    timeout=90, min_epoch=merge_epoch)
+                victim = 1
+                await c.osds[victim].stop()
+                c.osds[victim] = None
+                for pfx in ("osd down", "osd out"):
+                    code, _, _ = await c.client.command(
+                        {"prefix": pfx, "id": str(victim)})
+                    assert code == 0
                 await c.client.wait_clean(timeout=120)
                 for oid, blob in data.items():
                     assert await io.read(oid) == blob, oid
