@@ -1158,6 +1158,59 @@ class MClientRequest(Message):
         return cls(tid, op, json.loads(dec.bytes_() or b"{}"))
 
 
+class MClientCaps(Message):
+    """CEPH_MSG_CLIENT_CAPS=0x310 analogue: the cap traffic between
+    MDS (Locker) and fs clients.  ops:
+
+    - GRANT  (mds->client): you now hold ``caps`` on ``ino``;
+    - REVOKE (mds->client): give back everything above ``caps``; flush
+      buffered dirty state first;
+    - FLUSH  (client->mds): dirty size/mtime for ``path``/``ino`` (the
+      cap-flush that makes the MDS the size authority);
+    - ACK    (client->mds): revoke done (after any FLUSH);
+    - SNAPC  (mds->client): the data pool's snap context changed
+      (a .snap was created/removed) — update write snapc NOW.
+    """
+
+    TYPE = 25
+    GRANT, REVOKE, FLUSH, ACK, SNAPC = 0, 1, 2, 3, 4
+
+    def __init__(self, tid: int = 0, op: int = 0, ino: int = 0,
+                 caps: int = 0, path: str = "", size: int = -1,
+                 mtime: float = -1.0, snap_seq: int = 0,
+                 snaps: list[int] | None = None):
+        self.tid, self.op, self.ino, self.caps = tid, op, ino, caps
+        self.path, self.size, self.mtime = path, size, mtime
+        self.snap_seq = snap_seq
+        self.snaps = snaps or []
+
+    def encode_payload(self, enc):
+        enc.u64(self.tid)
+        enc.u8(self.op)
+        enc.u64(self.ino)
+        enc.u32(self.caps)
+        enc.str_(self.path)
+        enc.i64(self.size)
+        enc.str_(repr(self.mtime))
+        enc.u64(self.snap_seq)
+        enc.u32(len(self.snaps))
+        for s in self.snaps:
+            enc.u64(s)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        tid = dec.u64()
+        op = dec.u8()
+        ino = dec.u64()
+        caps = dec.u32()
+        path = dec.str_()
+        size = dec.i64()
+        mtime = float(dec.str_())
+        seq = dec.u64()
+        snaps = [dec.u64() for _ in range(dec.u32())]
+        return cls(tid, op, ino, caps, path, size, mtime, seq, snaps)
+
+
 class MClientReply(Message):
     """CEPH_MSG_CLIENT_REPLY=26: result code + JSON payload."""
 

@@ -331,3 +331,195 @@ async def _raw(fs, op, args):
         return await _a.wait_for(fut, 10)
     finally:
         fs._waiters.pop(tid, None)
+
+
+class TestCapabilities:
+    """The Locker-lite cap protocol: EXCL buffering, recall-on-
+    conflict with flush, write-cap-gated size authority (reference
+    src/mds/Locker.cc issue/revoke)."""
+
+    def test_two_clients_coherent_via_recall(self):
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                mds, fs_a = await _fs(c)
+                fs_b = FSClient(mds.addr, c.client.ioctx("cephfs.data"),
+                                client_id=909)
+                await fs_b.mount()
+                try:
+                    # A is the sole writer: EXCL, size buffered
+                    fa = await fs_a.create("/shared.txt")
+                    await fa.write(0, b"written by A" * 100)
+                    from ceph_tpu.fs.mds import CAP_EXCL
+
+                    assert fs_a._caps[fa.ino] & CAP_EXCL
+                    assert fa.ino in fs_a._dirty  # buffered, no flush yet
+
+                    # B opens: the MDS recalls A's EXCL, A flushes its
+                    # buffered size, B sees every byte A wrote
+                    fb = await fs_b.open("/shared.txt")
+                    assert fb.size == 1200
+                    assert await fb.read(0) == b"written by A" * 100
+                    # A's cap was downgraded and its dirty state flushed
+                    assert not (fs_a._caps.get(fa.ino, 0) & CAP_EXCL)
+                    assert fa.ino not in fs_a._dirty
+
+                    # B stats through the MDS: size reflects the flush
+                    attr = await fs_b.stat("/shared.txt")
+                    assert attr["size"] == 1200
+
+                    # B opens for write: A's remaining caps recall
+                    # fully, so B is now the sole (EXCL) writer and
+                    # buffers; A's next stat recalls B's EXCL and sees
+                    # the flushed size — coherence both directions
+                    fb2 = await fs_b.open("/shared.txt", want="w")
+                    await fb2.write(1200, b"tail-from-B")
+                    assert fb2.ino in fs_b._dirty  # buffered under EXCL
+                    attr = await fs_a.stat("/shared.txt")
+                    assert attr["size"] == 1200 + len(b"tail-from-B")
+                    assert fb2.ino not in fs_b._dirty  # flushed by recall
+                finally:
+                    await fs_b.unmount()
+                    await fs_a.unmount()
+                    await mds.stop()
+
+        run(go())
+
+    def test_size_authority_requires_write_cap(self):
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                mds, fs = await _fs(c)
+                try:
+                    f = await fs.create("/gated.txt")
+                    await f.write(0, b"x" * 100)
+                    await f.fsync()
+
+                    # a second session WITHOUT any cap on the ino
+                    rogue = FSClient(
+                        mds.addr, c.client.ioctx("cephfs.data"),
+                        client_id=666)
+                    await rogue.mount()
+                    reply = await _raw(rogue, "report_size", {
+                        "path": "/gated.txt", "ino": f.ino,
+                        "size": 999999, "_reqid": "rogue:1"})
+                    assert reply.result == -errno.EPERM
+                    attr = await fs.stat("/gated.txt")
+                    assert attr["size"] == 100  # authority intact
+                    await rogue.unmount()
+                finally:
+                    await fs.unmount()
+                    await mds.stop()
+
+        run(go())
+
+    def test_excl_flush_survives_mds_restart(self):
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                mds, fs = await _fs(c, flush_every=4)
+                try:
+                    f = await fs.create("/sur.txt")
+                    await f.write(0, b"y" * 5000)
+                    await f.fsync()          # size journaled at the MDS
+                    await mds.crash()        # die without writeback
+                    mds2 = MDSDaemon(0, c.mon.addr)
+                    await mds2.start()       # journal replay
+                    fs2 = FSClient(mds2.addr, c.client.ioctx("cephfs.data"))
+                    await fs2.mount()
+                    f2 = await fs2.open("/sur.txt")
+                    assert f2.size == 5000
+                    assert await f2.read(0) == b"y" * 5000
+                    await fs2.unmount()
+                    await mds2.stop()
+                finally:
+                    await fs.unmount()
+
+        run(go())
+
+
+class TestSnapshots:
+    """SnapRealm-lite: .snap namespaces over frozen manifests with
+    data-pool COW (reference src/mds/SnapRealm.cc + snapc plumbing)."""
+
+    def test_snapshot_freezes_data_and_metadata(self):
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                mds, fs = await _fs(c)
+                try:
+                    await fs.mkdir("/proj")
+                    f = await fs.create("/proj/notes.txt")
+                    await f.write(0, b"version-one")
+                    await f.fsync()
+                    await fs.snap_create("/proj", "s1")
+
+                    # overwrite + extend + add a sibling after the snap
+                    f2 = await fs.open("/proj/notes.txt", want="w")
+                    await f2.write(0, b"VERSION-TWO-LONGER")
+                    await f2.fsync()
+                    g = await fs.create("/proj/later.txt")
+                    await g.write(0, b"after")
+                    await g.fsync()
+
+                    # live view
+                    live = await fs.open("/proj/notes.txt")
+                    assert await live.read(0) == b"VERSION-TWO-LONGER"
+
+                    # snapshot view: pre-snap data AND namespace
+                    snap = await fs.open("/proj/.snap/s1/notes.txt")
+                    assert snap.size == len(b"version-one")
+                    assert await snap.read(0) == b"version-one"
+                    names = sorted(await fs.readdir("/proj/.snap/s1"))
+                    assert names == ["notes.txt"]  # later.txt absent
+                    snaps = sorted(await fs.readdir("/proj/.snap"))
+                    assert snaps == ["s1"]
+
+                    # snapshots are read-only
+                    with pytest.raises(FSError) as ei:
+                        await fs.create("/proj/.snap/s1/new.txt")
+                    assert ei.value.errno == errno.EROFS
+                    with pytest.raises(FSError):
+                        await snap.write(0, b"nope")
+
+                    # unlink the live file: the snapshot still reads
+                    await fs.unlink("/proj/notes.txt")
+                    snap2 = await fs.open("/proj/.snap/s1/notes.txt")
+                    assert await snap2.read(0) == b"version-one"
+
+                    # remove the snapshot: namespace gone
+                    await fs.snap_remove("/proj", "s1")
+                    with pytest.raises(FSError) as ei:
+                        await fs.open("/proj/.snap/s1/notes.txt")
+                    assert ei.value.errno == errno.ENOENT
+                finally:
+                    await fs.unmount()
+                    await mds.stop()
+
+        run(go())
+
+    def test_snapshot_survives_mds_restart(self):
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                mds, fs = await _fs(c, flush_every=4)
+                try:
+                    await fs.mkdir("/d")
+                    f = await fs.create("/d/a")
+                    await f.write(0, b"frozen")
+                    await f.fsync()
+                    await fs.snap_create("/d", "keep")
+                    f2 = await fs.open("/d/a", want="w")
+                    await f2.write(0, b"THAWED")
+                    await f2.fsync()
+                    await mds.crash()
+
+                    mds2 = MDSDaemon(0, c.mon.addr)
+                    await mds2.start()
+                    fs2 = FSClient(mds2.addr, c.client.ioctx("cephfs.data"))
+                    await fs2.mount()
+                    snap = await fs2.open("/d/.snap/keep/a")
+                    assert await snap.read(0) == b"frozen"
+                    live = await fs2.open("/d/a")
+                    assert await live.read(0) == b"THAWED"
+                    await fs2.unmount()
+                    await mds2.stop()
+                finally:
+                    await fs.unmount()
+
+        run(go())
