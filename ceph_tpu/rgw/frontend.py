@@ -238,9 +238,23 @@ class S3Frontend:
 
     async def _bucket(self, req, name: str) -> tuple[int, dict, bytes]:
         if req.method == "PUT":
+            if "versioning" in req.params:
+                status = _xml_find_text(req.body, "Status")
+                if status is None:
+                    raise RGWError("MalformedXML", 400,
+                                   "Status required")
+                await self.store.set_bucket_versioning(name, status)
+                return 200, {}, b""
+            if "lifecycle" in req.params:
+                rules = _parse_lifecycle_xml(req.body)
+                await self.store.set_lifecycle(name, rules)
+                return 200, {}, b""
             placement = req.headers.get("x-rgw-placement")  # extension
             await self.store.create_bucket(name, req.uid, placement)
             return 200, {"location": f"/{name}"}, b""
+        if req.method == "DELETE" and "lifecycle" in req.params:
+            await self.store.delete_lifecycle(name)
+            return 204, {}, b""
         if req.method == "DELETE":
             await self.store.delete_bucket(name, req.uid)
             return 204, {}, b""
@@ -252,6 +266,20 @@ class S3Frontend:
             if "uploads" in req.params:
                 root = _xml("ListMultipartUploadsResult",
                             _xml("Bucket", text=name))
+                return 200, {"content-type": "application/xml"}, _render(root)
+            if "versioning" in req.params:
+                status = self.store.versioning_of(bucket)
+                kids = []
+                if status != "Off":
+                    kids.append(_xml("Status", text=status))
+                root = _xml("VersioningConfiguration", *kids)
+                return 200, {"content-type": "application/xml"}, _render(root)
+            if "versions" in req.params:
+                return await self._list_versions(req, bucket)
+            if "lifecycle" in req.params:
+                rules = await self.store.get_lifecycle(name)
+                root = _xml("LifecycleConfiguration", *[
+                    _rule_to_xml(r) for r in rules])
                 return 200, {"content-type": "application/xml"}, _render(root)
             return await self._list_objects_v2(req, bucket)
         if req.method == "POST" and "delete" in req.params:
@@ -290,6 +318,39 @@ class S3Frontend:
                     _xml("Code", text=e.code),
                 ))
         return 200, {"content-type": "application/xml"}, _render(out)
+
+    async def _list_versions(self, req, bucket) -> tuple[int, dict, bytes]:
+        prefix = req.params.get("prefix", "")
+        key_marker = req.params.get("key-marker", "")
+        max_keys = _int_param(req.params.get("max-keys", "1000"), "max-keys")
+        res = await self.store.list_object_versions(
+            bucket, prefix=prefix, key_marker=key_marker,
+            max_keys=max_keys)
+        children = [
+            _xml("Name", text=bucket["name"]),
+            _xml("Prefix", text=prefix),
+            _xml("MaxKeys", text=str(max_keys)),
+            _xml("IsTruncated",
+                 text="true" if res["truncated"] else "false"),
+        ]
+        for rec in res["entries"]:
+            tag = ("DeleteMarker" if rec.get("delete_marker")
+                   else "Version")
+            kids = [
+                _xml("Key", text=rec["key"]),
+                _xml("VersionId", text=rec["vid"]),
+                _xml("IsLatest",
+                     text="true" if rec["is_latest"] else "false"),
+                _xml("LastModified", text=rec.get("mtime", "")),
+            ]
+            if tag == "Version":
+                kids += [
+                    _xml("ETag", text=f"\"{rec.get('etag', '')}\""),
+                    _xml("Size", text=str(rec.get("size", 0))),
+                ]
+            children.append(_xml(tag, *kids))
+        root = _xml("ListVersionsResult", *children)
+        return 200, {"content-type": "application/xml"}, _render(root)
 
     async def _list_objects_v2(self, req, bucket) -> tuple[int, dict, bytes]:
         prefix = req.params.get("prefix", "")
@@ -338,7 +399,10 @@ class S3Frontend:
             meta = await self.store.put_object(
                 bucket, key, req.body, ct,
                 user_meta=_user_meta_headers(req.headers))
-            return 200, {"etag": f"\"{meta['etag']}\""}, b""
+            hdrs = {"etag": f"\"{meta['etag']}\""}
+            if "version_id" in meta:
+                hdrs["x-amz-version-id"] = meta["version_id"]
+            return 200, hdrs, b""
         if req.method == "POST":
             if "uploads" in req.params:
                 ct = req.headers.get("content-type", "binary/octet-stream")
@@ -375,17 +439,26 @@ class S3Frontend:
                 await self.store.abort_multipart(
                     bucket, key, req.params["uploadId"])
                 return 204, {}, b""
-            await self.store.delete_object(bucket, key)
-            return 204, {}, b""
+            out = await self.store.delete_object(
+                bucket, key, version_id=req.params.get("versionId"))
+            hdrs = {}
+            if out.get("version_id"):
+                hdrs["x-amz-version-id"] = out["version_id"]
+            if out.get("delete_marker"):
+                hdrs["x-amz-delete-marker"] = "true"
+            return 204, hdrs, b""
         raise RGWError("MethodNotAllowed", 405, req.method)
 
     async def _get_object(self, req, bucket, key):
         rng = req.headers.get("range", "")
-        meta = await self.store.head_object(bucket, key)
+        vid = req.params.get("versionId")
+        meta = await self.store.head_object(bucket, key, version_id=vid)
         size = meta["size"]
         status = 200
         off, length = 0, None
         resp_headers = {}
+        if "version_id" in meta:
+            resp_headers["x-amz-version-id"] = meta["version_id"]
         if rng:
             off, end_incl = _parse_range(rng, size)
             length = end_incl - off + 1
@@ -396,7 +469,8 @@ class S3Frontend:
             resp_headers["content-length"] = str(
                 length if length is not None else size)
         else:
-            _meta, body = await self.store.get_object(bucket, key, off, length)
+            _meta, body = await self.store.get_object(
+                bucket, key, off, length, version_id=vid)
         resp_headers.update({
             "etag": f"\"{meta['etag']}\"",
             "last-modified": meta.get("mtime", ""),
@@ -506,6 +580,76 @@ class S3Frontend:
             _xml("ETag", text=f"\"{meta['etag']}\""),
         )
         return 200, {"content-type": "application/xml"}, _render(out)
+
+
+def _strip_ns(tag: str) -> str:
+    return tag.rsplit("}", 1)[-1]
+
+
+def _xml_find_text(body: bytes, tag: str) -> str | None:
+    try:
+        root = ET.fromstring(body)
+    except ET.ParseError:
+        raise RGWError("MalformedXML", 400, "bad XML body")
+    for el in root.iter():
+        if _strip_ns(el.tag) == tag:
+            return (el.text or "").strip()
+    return None
+
+
+def _parse_lifecycle_xml(body: bytes) -> list[dict]:
+    """<LifecycleConfiguration><Rule>... -> [{id, prefix, status,
+    days?, noncurrent_days?}] (the slice of rgw_lc.cc's rule model the
+    lite worker executes)."""
+    try:
+        root = ET.fromstring(body)
+    except ET.ParseError:
+        raise RGWError("MalformedXML", 400, "bad lifecycle XML")
+    rules = []
+    for rel in root:
+        if _strip_ns(rel.tag) != "Rule":
+            continue
+        rule: dict = {"status": "Enabled", "prefix": ""}
+        for el in rel:
+            t = _strip_ns(el.tag)
+            if t == "ID":
+                rule["id"] = (el.text or "").strip()
+            elif t == "Status":
+                rule["status"] = (el.text or "Enabled").strip()
+            elif t == "Prefix":
+                rule["prefix"] = (el.text or "").strip()
+            elif t == "Filter":
+                for f in el.iter():
+                    if _strip_ns(f.tag) == "Prefix":
+                        rule["prefix"] = (f.text or "").strip()
+            elif t == "Expiration":
+                for d in el:
+                    if _strip_ns(d.tag) == "Days":
+                        rule["days"] = int(d.text or "0")
+            elif t == "NoncurrentVersionExpiration":
+                for d in el:
+                    if _strip_ns(d.tag) == "NoncurrentDays":
+                        rule["noncurrent_days"] = int(d.text or "0")
+        rules.append(rule)
+    if not rules:
+        raise RGWError("MalformedXML", 400, "no rules")
+    return rules
+
+
+def _rule_to_xml(rule: dict) -> ET.Element:
+    kids = [
+        _xml("ID", text=rule.get("id", "")),
+        _xml("Prefix", text=rule.get("prefix", "")),
+        _xml("Status", text=rule.get("status", "Enabled")),
+    ]
+    if "days" in rule:
+        kids.append(_xml("Expiration",
+                         _xml("Days", text=str(rule["days"]))))
+    if "noncurrent_days" in rule:
+        kids.append(_xml(
+            "NoncurrentVersionExpiration",
+            _xml("NoncurrentDays", text=str(rule["noncurrent_days"]))))
+    return _xml("Rule", *kids)
 
 
 def _user_meta_headers(headers: dict[str, str]) -> dict[str, str]:

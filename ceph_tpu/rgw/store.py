@@ -59,6 +59,16 @@ def _md5(data: bytes) -> str:
     return hashlib.md5(data).hexdigest()
 
 
+def _parse_ts(s: str) -> float:
+    import calendar
+
+    try:
+        return calendar.timegm(
+            time.strptime(s.split(".")[0], "%Y-%m-%dT%H:%M:%S"))
+    except (ValueError, AttributeError):
+        return 0.0
+
+
 class RGWStore:
     def __init__(self, meta_io: IoCtx, data_pools: dict[str, IoCtx],
                  default_placement: str | None = None,
@@ -67,6 +77,13 @@ class RGWStore:
         self.data_pools = dict(data_pools)
         self.default_placement = default_placement or next(iter(data_pools))
         self.chunk_size = chunk_size
+        # injectable clock: the lifecycle worker ages objects against
+        # it (tests time-warp; the reference uses lc debug intervals)
+        self.clock = time.time
+
+    def _nowstr(self) -> str:
+        return time.strftime(
+            "%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(self.clock()))
 
     # -- users (rgw_user.cc) -------------------------------------------
 
@@ -138,7 +155,7 @@ class RGWStore:
             raise RGWError("BucketAlreadyOwnedByYou", 409, name)
         bucket = {
             "id": os.urandom(8).hex(), "name": name, "owner": owner,
-            "created": _now(),
+            "created": self._nowstr(),
             "placement": placement or self.default_placement,
         }
         if bucket["placement"] not in self.data_pools:
@@ -157,12 +174,20 @@ class RGWStore:
         stats = await self.bucket_stats(bucket)
         if stats["count"] > 0:
             raise RGWError("BucketNotEmpty", 409, name)
+        try:
+            if await self.meta.omap_get(self._vers_oid(bucket)):
+                # noncurrent versions / delete markers still exist
+                raise RGWError("BucketNotEmpty", 409, name)
+        except RadosError as e:
+            if e.errno != errno.ENOENT:
+                raise
         await self.meta.omap_rm_keys(BUCKETS_DIR_OID, [name])
         await self.meta.omap_rm_keys(f"user.{owner}", [f"bucket.{name}"])
-        try:
-            await self.meta.remove(self._index_oid(bucket))
-        except RadosError:
-            pass
+        for oid in (self._index_oid(bucket), self._vers_oid(bucket)):
+            try:
+                await self.meta.remove(oid)
+            except RadosError:
+                pass
 
     async def list_buckets(self, owner: str) -> list[dict]:
         out = []
@@ -258,13 +283,101 @@ class RGWStore:
         except RadosError:
             pass
 
+    # -- versioning (rgw versioned buckets, rgw_rados versioned ops) ----
+
+    @staticmethod
+    def versioning_of(bucket: dict) -> str:
+        return bucket.get("versioning", "Off")
+
+    async def _save_bucket(self, bucket: dict) -> None:
+        await self.meta.omap_set(BUCKETS_DIR_OID, {
+            bucket["name"]: json.dumps(bucket).encode(),
+        })
+
+    async def set_bucket_versioning(self, name: str, status: str) -> dict:
+        if status not in ("Enabled", "Suspended"):
+            raise RGWError("MalformedXML", 400, f"bad status {status!r}")
+        bucket = await self.get_bucket(name)
+        bucket["versioning"] = status
+        await self._save_bucket(bucket)
+        return bucket
+
+    def _vers_oid(self, bucket: dict) -> str:
+        return f".vers.{bucket['id']}"
+
+    _vseq = 0
+
+    def _vkey(self, key: str, vid: str) -> str:
+        # inverted-timestamp component so a lexical scan of the omap
+        # yields newest-first per key (the reference's instance-entry
+        # ordering in the bucket index); a descending in-process
+        # counter breaks same-tick ties toward the later write
+        inv = 2**63 - int(self.clock() * 1e9)
+        RGWStore._vseq += 1
+        tie = 10**9 - (RGWStore._vseq % 10**9)
+        return f"{key}\x00{inv:020d}.{tie:09d}.{vid}"
+
+    def _vhead_oid(self, bucket: dict, key: str, vid: str) -> str:
+        return f"{bucket['id']}__ver_{vid}_{key}"
+
+    async def _versions_of(self, bucket: dict, key: str) -> list[tuple[str, dict]]:
+        """[(vkey, rec)] newest first for one key."""
+        try:
+            omap = await self.meta.omap_get(self._vers_oid(bucket))
+        except RadosError as e:
+            if e.errno != errno.ENOENT:
+                raise
+            return []
+        pfx = f"{key}\x00"
+        return [
+            (k, json.loads(v)) for k, v in sorted(omap.items())
+            if k.startswith(pfx)
+        ]
+
+    async def _drop_version(self, bucket: dict, vkey: str, rec: dict) -> None:
+        io = self._data_io(bucket)
+        if not rec.get("delete_marker"):
+            oid = self._vhead_oid(bucket, rec["key"], rec["vid"])
+            try:
+                meta = await self._read_meta(io, oid)
+                await self._remove_chain(io, oid, meta)
+            except RGWError:
+                pass
+        await self.meta.omap_rm_keys(self._vers_oid(bucket), [vkey])
+
     # -- object ops (rgw_op.cc RGWPutObj/RGWGetObj/RGWDeleteObj) --------
+
+    async def _write_chain(
+        self, bucket: dict, key: str, head_oid: str, data: bytes,
+        content_type: str, user_meta: dict[str, str] | None,
+    ) -> dict:
+        """Write one complete object chain at ``head_oid`` (tails
+        first, then head bytes + meta xattr atomically) and return its
+        meta.  Does NOT touch the bucket index."""
+        io = self._data_io(bucket)
+        manifest = await self._write_tails(
+            io, self._shadow_prefix(bucket, key), data)
+        meta = {
+            "size": len(data), "etag": _md5(data),
+            "mtime": self._nowstr(), "content_type": content_type,
+            "head_size": min(len(data), self.chunk_size),
+            "manifest": manifest,
+        }
+        if user_meta:
+            meta["user_meta"] = user_meta
+        await io.operate(head_oid, ObjectOperation()
+                         .write_full(data[:self.chunk_size])
+                         .setxattr("rgw.meta", json.dumps(meta).encode()))
+        return meta
 
     async def put_object(
         self, bucket: dict, key: str, data: bytes,
         content_type: str = "binary/octet-stream",
         user_meta: dict[str, str] | None = None,
     ) -> dict:
+        if self.versioning_of(bucket) != "Off":
+            return await self._put_versioned(
+                bucket, key, data, content_type, user_meta)
         io = self._data_io(bucket)
         head_oid = self._head_oid(bucket, key)
         tag = await self._index_prepare(bucket, key, "put")
@@ -280,19 +393,8 @@ class RGWStore:
             # xattr as ONE atomic compound op, so a crash anywhere
             # leaves either the intact old object or the complete new
             # one — never a head/meta mismatch
-            manifest = await self._write_tails(
-                io, self._shadow_prefix(bucket, key), data)
-            meta = {
-                "size": len(data), "etag": _md5(data), "mtime": _now(),
-                "content_type": content_type,
-                "head_size": min(len(data), self.chunk_size),
-                "manifest": manifest,
-            }
-            if user_meta:
-                meta["user_meta"] = user_meta
-            await io.operate(head_oid, ObjectOperation()
-                             .write_full(data[:self.chunk_size])
-                             .setxattr("rgw.meta", json.dumps(meta).encode()))
+            meta = await self._write_chain(
+                bucket, key, head_oid, data, content_type, user_meta)
         except BaseException:
             await self._index_abort(bucket, key, tag)
             raise
@@ -301,22 +403,96 @@ class RGWStore:
             "mtime": meta["mtime"], "content_type": content_type,
         })
         # old tails are garbage now (reference: deferred to rgw gc)
-        new_oids = {oid for oid, _sz in manifest}
+        new_oids = {oid for oid, _sz in meta["manifest"]}
         for oid, _sz in old_manifest:
             if oid not in new_oids:
                 await self._remove_quiet(io, oid)
         return meta
 
-    async def head_object(self, bucket: dict, key: str) -> dict:
+    async def _put_versioned(
+        self, bucket: dict, key: str, data: bytes,
+        content_type: str, user_meta: dict[str, str] | None,
+    ) -> dict:
+        """Versioned PUT: every write is a NEW immutable version
+        (Enabled) or replaces the 'null' version (Suspended); the main
+        index tracks the current view so plain listings keep working."""
+        suspended = self.versioning_of(bucket) == "Suspended"
+        vid = "null" if suspended else os.urandom(8).hex()
+        if suspended:
+            # a previous null version (incl. one from an earlier
+            # suspension) is overwritten, reference semantics
+            for vkey, rec in await self._versions_of(bucket, key):
+                if rec["vid"] == "null":
+                    await self._drop_version(bucket, vkey, rec)
+        tag = await self._index_prepare(bucket, key, "put")
+        try:
+            meta = await self._write_chain(
+                bucket, key, self._vhead_oid(bucket, key, vid), data,
+                content_type, user_meta)
+            meta["version_id"] = vid
+            await self.meta.omap_set(self._vers_oid(bucket), {
+                self._vkey(key, vid): json.dumps({
+                    "key": key, "vid": vid, "size": meta["size"],
+                    "etag": meta["etag"], "mtime": meta["mtime"],
+                    "content_type": content_type,
+                    "delete_marker": False,
+                }).encode(),
+            })
+        except BaseException:
+            await self._index_abort(bucket, key, tag)
+            raise
+        await self._index_complete(bucket, key, tag, "put", {
+            "size": meta["size"], "etag": meta["etag"],
+            "mtime": meta["mtime"], "content_type": content_type,
+            "version_id": vid,
+        })
+        return meta
+
+    async def _resolve_head(
+        self, bucket: dict, key: str, version_id: str | None,
+    ) -> tuple[str, str | None]:
+        """(head_oid, version_id) for a read.  Versioned buckets read
+        through the version table; the plain head is the implicit
+        pre-versioning object."""
+        versions = await self._versions_of(bucket, key)
+        if version_id is None:
+            if versions:
+                _vkey, rec = versions[0]
+                if rec.get("delete_marker"):
+                    raise RGWError("NoSuchKey", 404, key)
+                return (self._vhead_oid(bucket, key, rec["vid"]),
+                        rec["vid"])
+            return self._head_oid(bucket, key), None
+        for _vkey, rec in versions:
+            if rec["vid"] == version_id:
+                if rec.get("delete_marker"):
+                    raise RGWError("MethodNotAllowed", 405,
+                                   "delete marker")
+                return (self._vhead_oid(bucket, key, version_id),
+                        version_id)
+        if version_id == "null":
+            return self._head_oid(bucket, key), "null"
+        raise RGWError("NoSuchVersion", 404, version_id)
+
+    async def head_object(
+        self, bucket: dict, key: str, version_id: str | None = None,
+    ) -> dict:
         io = self._data_io(bucket)
-        return await self._read_meta(io, self._head_oid(bucket, key))
+        head_oid, vid = await self._resolve_head(bucket, key, version_id)
+        meta = await self._read_meta(io, head_oid)
+        if vid is not None:
+            meta.setdefault("version_id", vid)
+        return meta
 
     async def get_object(
         self, bucket: dict, key: str, off: int = 0, length: int | None = None,
+        version_id: str | None = None,
     ) -> tuple[dict, bytes]:
         io = self._data_io(bucket)
-        head_oid = self._head_oid(bucket, key)
+        head_oid, vid = await self._resolve_head(bucket, key, version_id)
         meta = await self._read_meta(io, head_oid)
+        if vid is not None:
+            meta.setdefault("version_id", vid)
         size = meta["size"]
         if off >= size and size > 0:
             raise RGWError("InvalidRange", 416, key)
@@ -336,7 +512,15 @@ class RGWStore:
         chunks = await asyncio.gather(*reads) if reads else []
         return meta, b"".join(chunks)
 
-    async def delete_object(self, bucket: dict, key: str) -> None:
+    async def delete_object(
+        self, bucket: dict, key: str, version_id: str | None = None,
+    ) -> dict:
+        """Returns {"version_id": ..., "delete_marker": bool} for
+        versioned outcomes, {} otherwise."""
+        if version_id is not None:
+            return await self._delete_version(bucket, key, version_id)
+        if self.versioning_of(bucket) != "Off":
+            return await self._delete_marker(bucket, key)
         io = self._data_io(bucket)
         head_oid = self._head_oid(bucket, key)
         meta = None
@@ -356,6 +540,92 @@ class RGWStore:
         # update settles the orphaned entry (the dir_suggest role);
         # S3 DELETE of a missing key succeeds either way
         await self._index_complete(bucket, key, tag, "del")
+        return {}
+
+    async def _delete_marker(self, bucket: dict, key: str) -> dict:
+        """Versioned DELETE without a version id: the object does not
+        die — a delete marker becomes the current version and the key
+        vanishes from plain listings."""
+        vid = os.urandom(8).hex()
+        tag = await self._index_prepare(bucket, key, "del")
+        try:
+            await self.meta.omap_set(self._vers_oid(bucket), {
+                self._vkey(key, vid): json.dumps({
+                    "key": key, "vid": vid, "size": 0, "etag": "",
+                    "mtime": self._nowstr(), "delete_marker": True,
+                }).encode(),
+            })
+        except BaseException:
+            await self._index_abort(bucket, key, tag)
+            raise
+        await self._index_complete(bucket, key, tag, "del")
+        return {"version_id": vid, "delete_marker": True}
+
+    async def _delete_version(
+        self, bucket: dict, key: str, version_id: str,
+    ) -> dict:
+        """DELETE with a version id: that version (or marker) is
+        physically removed; the next-newest version becomes current —
+        removing the newest marker "undeletes" the key."""
+        versions = await self._versions_of(bucket, key)
+        target = next(
+            ((vk, r) for vk, r in versions if r["vid"] == version_id),
+            None)
+        if target is None:
+            if version_id == "null":
+                # implicit pre-versioning object
+                return await self.delete_object(
+                    {**bucket, "versioning": "Off"}, key)
+            return {}  # S3: deleting a missing version succeeds
+        vkey, rec = target
+        was_current = versions[0][0] == vkey
+        await self._drop_version(bucket, vkey, rec)
+        if was_current:
+            rest = [r for vk, r in versions if vk != vkey]
+            if rest and not rest[0].get("delete_marker"):
+                cur = rest[0]
+                tag = await self._index_prepare(bucket, key, "put")
+                await self._index_complete(bucket, key, tag, "put", {
+                    "size": cur["size"], "etag": cur["etag"],
+                    "mtime": cur["mtime"],
+                    "content_type": cur.get("content_type", ""),
+                    "version_id": cur["vid"],
+                })
+            else:
+                tag = await self._index_prepare(bucket, key, "del")
+                await self._index_complete(bucket, key, tag, "del")
+        return {"version_id": version_id,
+                "delete_marker": bool(rec.get("delete_marker"))}
+
+    async def list_object_versions(
+        self, bucket: dict, prefix: str = "", key_marker: str = "",
+        max_keys: int = 1000,
+    ) -> dict:
+        """ListObjectVersions core: every version + delete marker,
+        newest first per key, IsLatest computed."""
+        try:
+            omap = await self.meta.omap_get(self._vers_oid(bucket))
+        except RadosError as e:
+            if e.errno != errno.ENOENT:
+                raise
+            omap = {}
+        entries = []
+        seen_latest: set[str] = set()
+        truncated = False
+        for vkey in sorted(omap):
+            rec = json.loads(omap[vkey])
+            key = rec["key"]
+            if prefix and not key.startswith(prefix):
+                continue
+            if key_marker and key <= key_marker:
+                continue
+            if len(entries) >= max_keys:
+                truncated = True
+                break
+            rec["is_latest"] = key not in seen_latest
+            seen_latest.add(key)
+            entries.append(rec)
+        return {"entries": entries, "truncated": truncated}
 
     async def list_objects(
         self, bucket: dict, prefix: str = "", delimiter: str = "",
@@ -412,6 +682,113 @@ class RGWStore:
             "truncated": truncated, "next_marker": next_marker,
         }
 
+    # -- lifecycle (RGWLC, rgw_lc.cc / rgw_lc.h:515) --------------------
+
+    async def set_lifecycle(self, name: str, rules: list[dict]) -> None:
+        for r in rules:
+            if not isinstance(r, dict) or (
+                "days" not in r and "noncurrent_days" not in r
+            ):
+                raise RGWError("MalformedXML", 400, "rule needs an action")
+        bucket = await self.get_bucket(name)
+        bucket["lifecycle"] = rules
+        await self._save_bucket(bucket)
+
+    async def get_lifecycle(self, name: str) -> list[dict]:
+        bucket = await self.get_bucket(name)
+        lc = bucket.get("lifecycle")
+        if not lc:
+            raise RGWError("NoSuchLifecycleConfiguration", 404, name)
+        return lc
+
+    async def delete_lifecycle(self, name: str) -> None:
+        bucket = await self.get_bucket(name)
+        bucket.pop("lifecycle", None)
+        await self._save_bucket(bucket)
+
+    async def lc_process(self) -> dict:
+        """One lifecycle pass over every bucket (the RGWLC worker's
+        bucket_lc_process): expire current objects past their rule's
+        Days (versioned buckets get a delete marker instead of
+        destruction), and destroy noncurrent versions past
+        NoncurrentDays.  Ages are judged against ``self.clock``."""
+        stats = {"expired": 0, "noncurrent_removed": 0}
+        now = self.clock()
+        for name, raw in list((await self._buckets_dir()).items()):
+            bucket = json.loads(raw)
+            rules = [
+                r for r in bucket.get("lifecycle", [])
+                if r.get("status", "Enabled") == "Enabled"
+            ]
+            if not rules:
+                continue
+            for rule in rules:
+                prefix = rule.get("prefix", "")
+                days = rule.get("days")
+                if days is not None:
+                    stats["expired"] += await self._lc_expire_current(
+                        bucket, prefix, now - days * 86400)
+                nc_days = rule.get("noncurrent_days")
+                if nc_days is not None:
+                    stats["noncurrent_removed"] += (
+                        await self._lc_expire_noncurrent(
+                            bucket, prefix, now - nc_days * 86400))
+        return stats
+
+    async def _lc_expire_current(
+        self, bucket: dict, prefix: str, cutoff: float,
+    ) -> int:
+        n = 0
+        marker = ""
+        while True:
+            page = await self.list_objects(
+                bucket, prefix=prefix, marker=marker, max_keys=1000)
+            for key, emeta in page["entries"]:
+                marker = key
+                if _parse_ts(emeta.get("mtime", "")) <= cutoff:
+                    await self.delete_object(bucket, key)
+                    n += 1
+            if not page["truncated"]:
+                return n
+
+    async def _lc_expire_noncurrent(
+        self, bucket: dict, prefix: str, cutoff: float,
+    ) -> int:
+        """A version is noncurrent from the moment a NEWER version (or
+        marker) exists; lite model: age by the version's own mtime."""
+        n = 0
+        res = await self.list_object_versions(
+            bucket, prefix=prefix, max_keys=10**9)
+        for rec in res["entries"]:
+            if rec["is_latest"]:
+                continue
+            if _parse_ts(rec.get("mtime", "")) <= cutoff:
+                await self._delete_version(
+                    bucket, rec["key"], rec["vid"])
+                n += 1
+        return n
+
+    def lc_start(self, interval: float = 60.0) -> None:
+        """Background worker (the RGWLC thread)."""
+        async def run():
+            while True:
+                await asyncio.sleep(interval)
+                try:
+                    await self.lc_process()
+                except Exception:
+                    import logging
+
+                    logging.getLogger("ceph_tpu.rgw").exception(
+                        "lifecycle pass failed")
+
+        self._lc_task = asyncio.ensure_future(run())
+
+    def lc_stop(self) -> None:
+        task = getattr(self, "_lc_task", None)
+        if task is not None:
+            task.cancel()
+            self._lc_task = None
+
     # -- multipart (rgw_multi.cc) --------------------------------------
 
     def _mp_meta_oid(self, bucket: dict, key: str, upload_id: str) -> str:
@@ -424,7 +801,7 @@ class RGWStore:
         await self.meta.create(oid, exclusive=True)
         await self.meta.omap_set(oid, {
             ".meta": json.dumps({
-                "key": key, "initiated": _now(),
+                "key": key, "initiated": self._nowstr(),
                 "content_type": content_type,
             }).encode(),
         })
@@ -513,7 +890,7 @@ class RGWStore:
             except RGWError:
                 pass
             meta = {
-                "size": total, "etag": etag, "mtime": _now(),
+                "size": total, "etag": etag, "mtime": self._nowstr(),
                 "content_type": mp_meta.get("content_type",
                                             "binary/octet-stream"),
                 "head_size": 0, "manifest": manifest,
