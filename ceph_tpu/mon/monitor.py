@@ -32,7 +32,6 @@ import logging
 import time
 
 from ceph_tpu.crush.types import CrushMap
-from ceph_tpu.ec import registry as ec_registry
 from ceph_tpu.msg.messages import (
     MConfig,
     MMonCommand,
@@ -41,24 +40,24 @@ from ceph_tpu.msg.messages import (
     MOSDBeacon,
     MOSDBoot,
     MOSDFailure,
-    MOSDMap,
-    MOSDScrub,
     MOSDScrubReply,
 )
 from ceph_tpu.msg.messenger import Connection, Message, Messenger
-from ceph_tpu.osd.mapenc import (
-    decode_osdmap,
-    diff_osdmap,
-    encode_incremental,
-    encode_osdmap,
-)
+from ceph_tpu.osd.mapenc import decode_osdmap, encode_osdmap
 from ceph_tpu.osd.osdmap import OSDMap
-from ceph_tpu.osd.types import PgPool, PoolType
 
 log = logging.getLogger("ceph_tpu.mon")
 
 
-class Monitor:
+from ceph_tpu.mon.auth_service import AuthServiceMixin  # noqa: E402
+from ceph_tpu.mon.commands import CommandMixin  # noqa: E402
+from ceph_tpu.mon.config_service import ConfigServiceMixin  # noqa: E402
+from ceph_tpu.mon.osd_service import OSDMonitorMixin  # noqa: E402
+from ceph_tpu.mon.stats_service import StatsServiceMixin  # noqa: E402
+
+
+class Monitor(OSDMonitorMixin, StatsServiceMixin, AuthServiceMixin,
+              ConfigServiceMixin, CommandMixin):
     def __init__(
         self,
         crush: CrushMap | None = None,
@@ -415,65 +414,28 @@ class Monitor:
                 await asyncio.sleep(0.05)
         raise last
 
+    async def _apply_op(self, op: dict) -> None:
+        """Route one committed mutation to its owning service (the
+        PaxosService::update_from_paxos split, PaxosService.h:28)."""
+        kind = op["op"]
+        if kind in ("config_set", "config_rm"):
+            await self._apply_config_op(op)
+            return  # config changes don't mint osdmap epochs
+        if kind in ("auth_upsert", "auth_del"):
+            await self._apply_auth_op(op)
+            return  # auth changes don't mint osdmap epochs
+        if await self._apply_osd_op(op):
+            await self._new_epoch()
+
     @property
     def is_leader(self) -> bool:
         return self.paxos.is_leader
 
     # -- map publication ----------------------------------------------
 
-    def _snapshot(self) -> None:
-        from ceph_tpu.osd.mapenc import crush_sections
 
-        epoch = self.osdmap.epoch
-        blob = self._epoch_blobs[epoch] = encode_osdmap(self.osdmap)
-        # delta vs the previous epoch (OSDMap::Incremental): cheap
-        # publication; subscribers land bit-identical to the full map.
-        # The previous epoch's decoded map and crush encodes are cached
-        # so an epoch tick costs one diff, not two decodes + four
-        # crush encodes.
-        sections = crush_sections(self.osdmap)
-        prev = getattr(self, "_prev_snapshot", None)
-        if prev is not None and prev[0] == epoch - 1:
-            inc = diff_osdmap(
-                prev[1], self.osdmap,
-                old_sections=prev[2], new_sections=sections,
-            )
-            self._epoch_incs[epoch] = encode_incremental(inc)
-        self._prev_snapshot = (epoch, decode_osdmap(blob), sections)
-        # bound history
-        for e in sorted(self._epoch_blobs)[:-500]:
-            del self._epoch_blobs[e]
-        for e in sorted(self._epoch_incs)[:-500]:
-            del self._epoch_incs[e]
 
-    async def _new_epoch(self) -> None:
-        self.osdmap.epoch += 1
-        self._snapshot()
-        await self._publish()
 
-    async def _publish(self) -> None:
-        epoch = self.osdmap.epoch
-        inc = self._epoch_incs.get(epoch)
-        if inc is not None:
-            msg = MOSDMap(incs={epoch: inc})
-        else:
-            msg = MOSDMap(maps={epoch: self._epoch_blobs[epoch]})
-        for peer, conn in list(self._subscribers.items()):
-            try:
-                await conn.send_message(msg)
-            except ConnectionError:
-                self._subscribers.pop(peer, None)
-
-    def _maps_since(self, start_epoch: int) -> "MOSDMap":
-        """Catch-up payload for a subscriber at ``start_epoch``:
-        incrementals when the whole (start, current] range is on hand,
-        else the latest full map (OSDMonitor::send_incremental)."""
-        epoch = self.osdmap.epoch
-        if 0 < start_epoch <= epoch:
-            want = range(start_epoch + 1, epoch + 1)
-            if all(e in self._epoch_incs for e in want):
-                return MOSDMap(incs={e: self._epoch_incs[e] for e in want})
-        return MOSDMap(maps={epoch: self._epoch_blobs[epoch]})
 
     # -- dispatch ------------------------------------------------------
 
@@ -525,918 +487,28 @@ class Monitor:
         except (ConnectionError, OSError):
             pass
 
-    async def _handle_boot(self, m: MOSDBoot) -> None:
-        if not self.is_leader:
-            await self._forward_to_leader(m)
-            return
-        log.info("mon: osd.%d booted at %s:%d", m.osd, m.host, m.port)
-        self._last_beacon[m.osd] = time.monotonic()
-        self._down_at.pop(m.osd, None)
-        self._failure_reports.pop(m.osd, None)
-        await self._propose({
-            "op": "boot", "osd": m.osd, "host": m.host, "port": m.port,
-            "weight": m.weight, "incarnation": m.incarnation,
-        })
 
-    async def _handle_failure(self, m: MOSDFailure) -> None:
-        if not self.is_leader:
-            await self._forward_to_leader(m)
-            return
-        om = self.osdmap
-        if 0 <= m.failed < om.max_osd and om.is_up(m.failed):
-            if m.epoch < self._up_from.get(m.failed, 0):
-                # the report predates the target's latest boot: a
-                # straggler from before the reboot, not fresh evidence
-                # (OSDMonitor::check_failure vs up_from)
-                return
-            now = time.monotonic()
-            reporters = self._failure_reports.setdefault(m.failed, {})
-            reporters[m.reporter] = now
-            # expire stale reports (the reference ages failure_info by
-            # grace; 60 s here)
-            for r, t0 in list(reporters.items()):
-                if now - t0 > 60.0:
-                    del reporters[r]
-            if len(reporters) < self.min_down_reporters:
-                log.info(
-                    "mon: osd.%d failure report %d/%d (from osd.%d)",
-                    m.failed, len(reporters), self.min_down_reporters,
-                    m.reporter,
-                )
-                return
-            log.info(
-                "mon: osd.%d reported failed by %s", m.failed,
-                sorted(reporters),
-            )
-            self._failure_reports.pop(m.failed, None)
-            self._down_at[m.failed] = now
-            await self._propose({"op": "down", "osd": m.failed})
 
     # -- the replicated state machine ----------------------------------
 
-    async def _apply_op(self, op: dict) -> None:
-        """Apply one committed mutation deterministically — runs on
-        every quorum member in paxos order."""
-        kind = op["op"]
-        om = self.osdmap
-        if kind == "boot":
-            osd, addr = op["osd"], (op["host"], op["port"])
-            inc = op.get("incarnation", 0)
-            stored = self._osd_incarnation.get(osd, 0)
-            if inc and inc < stored:
-                # reordered boot from an EARLIER daemon start (e.g. a
-                # delayed peon-forwarded duplicate): drop it entirely so
-                # it can neither bump the epoch nor regress the address
-                return
-            if (
-                om.is_up(osd)
-                and om.osd_addrs.get(osd) == addr
-                and om.osd_weight[osd] == op["weight"]
-                and inc == stored
-            ):
-                # paxos replay of the same boot: no epoch bump.  A
-                # genuine fast restart carries a NEW incarnation and
-                # must bump the epoch so peers re-peer/recover toward
-                # the fresh (empty) daemon.
-                return
-            self._osd_incarnation[osd] = inc
-            om.new_osd(osd, weight=op["weight"], up=True)
-            om.osd_addrs[osd] = addr
-            self._up_from[osd] = om.epoch + 1  # the epoch this op creates
-        elif kind == "down":
-            if not (0 <= op["osd"] < om.max_osd) or not om.is_up(op["osd"]):
-                return  # no-op: no epoch bump
-            om.mark_down(op["osd"])
-        elif kind == "out":
-            if not (0 <= op["osd"] < om.max_osd) or om.is_out(op["osd"]):
-                return
-            om.mark_out(op["osd"])
-        elif kind == "full_state":
-            from ceph_tpu.osd.osdmap import CEPH_OSD_FULL_MASK
 
-            osd = op["osd"]
-            if not om.exists(osd):
-                return
-            cur = om.osd_state[osd]
-            new = (cur & ~CEPH_OSD_FULL_MASK) | (
-                op["bits"] & CEPH_OSD_FULL_MASK)
-            if new == cur:
-                return  # replay: no epoch
-            om.osd_state[osd] = new
-        elif kind == "profile":
-            om.erasure_code_profiles[op["name"]] = dict(op["profile"])
-        elif kind == "pool_create":
-            self._apply_pool_create(op)
-        elif kind == "config_set":
-            db = self._config_db.setdefault(op["who"], {})
-            db[op["name"]] = op["value"]
-            self._apply_config_locally()
-            await self._push_config()
-            return  # config changes don't mint osdmap epochs
-        elif kind == "config_rm":
-            self._config_db.get(op["who"], {}).pop(op["name"], None)
-            self._apply_config_locally()
-            await self._push_config()
-            return
-        elif kind == "crush_reweight":
-            from ceph_tpu.crush import builder as _builder
 
-            if not _builder.reweight_item(
-                    om.crush, op["item"], op["weight"]):
-                return  # unknown item: no epoch
-        elif kind == "crush_add_bucket":
-            from ceph_tpu.crush import builder as _builder
 
-            if op["name"] in om.crush.bucket_names:
-                return  # replay
-            _builder.add_bucket(om.crush, op["name"], op["type"])
-        elif kind == "crush_move":
-            from ceph_tpu.crush import builder as _builder
 
-            name = op["item_name"]
-            if name.startswith("osd."):
-                item = int(name[4:])
-            elif name in om.crush.bucket_names:
-                item = om.crush.bucket_names[name]
-            else:
-                return
-            parent = om.crush.bucket_names.get(op["loc"])
-            if parent is None:
-                return
-            if not _builder.move_item(
-                    om.crush, item, parent, op.get("weight")):
-                return  # cycle: no epoch
-        elif kind == "crush_rm":
-            from ceph_tpu.crush import builder as _builder
 
-            name = op["item_name"]
-            if name.startswith("osd."):
-                item = int(name[4:])
-            elif name in om.crush.bucket_names:
-                item = om.crush.bucket_names[name]
-            else:
-                return
-            if item < 0 and om.crush.buckets.get(item, None) is not None \
-                    and om.crush.buckets[item].items:
-                return  # became non-empty since validation: refuse
-            if not _builder.remove_item(om.crush, item):
-                return
-        elif kind == "snap_alloc":
-            pool = om.pools[op["pool"]]
-            pool.snap_seq = max(pool.snap_seq, op["snapid"])
-            if op.get("name"):
-                pool.pool_snaps[op["name"]] = op["snapid"]
-        elif kind == "snap_rm":
-            pool = om.pools[op["pool"]]
-            pool.removed_snaps.add(op["snapid"])
-            if op.get("name"):
-                pool.pool_snaps.pop(op["name"], None)
-        elif kind == "upmap":
-            from ceph_tpu.osd.types import pg_t
 
-            for pool, ps, pairs in op["items"]:
-                om.pg_upmap_items[pg_t(pool, ps)] = [
-                    (f, t) for f, t in pairs
-                ]
-        elif kind == "pool_set":
-            pool = om.pools.get(op["pool"])
-            if pool is None:
-                return
-            var, val = op["var"], op["val"]
-            if var == "pg_num":
-                n = int(val)
-                if n == pool.pg_num or n < 1:
-                    return  # replay / stale
-                # pgp_num follows pg_num in one step: on growth,
-                # children place independently at once and recovery
-                # pulls from the parent's prior interval
-                # (ancestor-aware); on shrink, OSDs fold dissolving
-                # children into their targets (PG::merge_from) and
-                # targets pull from the children's prior homes
-                pool.pg_num = n
-                pool.pgp_num = n
-                om.invalidate_mapping_cache()
-                # reports for dissolved children are meaningless now
-                book = getattr(self, "_pg_stats", {}) or {}
-                for pgid in [
-                    k for k in book
-                    if int(k.split(".")[0]) == op["pool"]
-                    and int(k.split(".")[1]) >= n
-                ]:
-                    del book[pgid]
-            elif var == "size":
-                pool.size = int(val)
-            elif var == "min_size":
-                pool.min_size = int(val)
-            else:
-                pool.extra[var] = val
-        elif kind == "pool_rm":
-            pid = op["pool"]
-            if pid not in om.pools:
-                return
-            name = om.pool_names.pop(pid, None)
-            om.pools.pop(pid, None)
-            if name is not None:
-                self._pool_ids.pop(name, None)
-            # dead placement overrides must not haunt the map forever
-            # (the reference clears upmap/pg_temp on pool deletion)
-            for d in (om.pg_upmap, om.pg_upmap_items, om.pg_temp):
-                for key in [k for k in d if k.pool == pid]:
-                    del d[key]
-        elif kind == "in":
-            osd = op["osd"]
-            if not om.exists(osd) or not om.is_out(osd):
-                return
-            om.osd_weight[osd] = 0x10000
-        elif kind == "tier_add":
-            tier = om.pools.get(op["tier"])
-            if tier is None or op["base"] not in om.pools:
-                return
-            tier.extra["tier_of"] = str(op["base"])
-            tier.extra.setdefault("cache_mode", "none")
-        elif kind == "tier_rm":
-            tier = om.pools.get(op["tier"])
-            if tier is None:
-                return
-            tier.extra.pop("tier_of", None)
-            tier.extra.pop("cache_mode", None)
-        elif kind == "tier_mode":
-            tier = om.pools.get(op["tier"])
-            if tier is None:
-                return
-            tier.extra["cache_mode"] = op["mode"]
-        elif kind == "tier_overlay":
-            base = om.pools.get(op["base"])
-            if base is None:
-                return
-            if op["tier"] < 0:
-                base.extra.pop("read_tier", None)
-                base.extra.pop("write_tier", None)
-            else:
-                base.extra["read_tier"] = str(op["tier"])
-                base.extra["write_tier"] = str(op["tier"])
-        elif kind == "auth_upsert":
-            self._auth_db[op["entity"]] = {
-                "key": op["key"], "caps": dict(op["caps"]),
-            }
-            self._sync_auth_keyring()
-            return  # auth changes don't mint osdmap epochs
-        elif kind == "auth_del":
-            self._auth_db.pop(op["entity"], None)
-            self._sync_auth_keyring()
-            return
-        else:
-            log.error("mon.%d: unknown committed op %r", self.rank, kind)
-            return
-        await self._new_epoch()
 
-    async def _tick(self) -> None:
-        was_leader = False
-        last_tick = time.monotonic()
-        while True:
-            await asyncio.sleep(self.beacon_grace / 4)
-            now = time.monotonic()
-            starved = now - last_tick > self.beacon_grace
-            last_tick = now
-            if not self.is_leader:
-                was_leader = False
-                continue
-            if starved:
-                # the event loop stalled (big computation, GC, swap):
-                # beacons queued but undelivered are not missing OSDs —
-                # re-seed rather than mass-mark the cluster down
-                was_leader = False
-            om = self.osdmap
-            if not was_leader:
-                # fresh leadership: beacons were landing on the old
-                # leader, so give every up OSD one full grace period to
-                # re-home before judging it (the reference's equivalent
-                # is last_beacon reset on win_election)
-                was_leader = True
-                for osd in range(om.max_osd):
-                    if om.is_up(osd):
-                        self._last_beacon[osd] = now
-                continue
-            try:
-                for osd, last in list(self._last_beacon.items()):
-                    if om.is_up(osd) and now - last > self.beacon_grace:
-                        log.info("mon: osd.%d beacon timeout -> down", osd)
-                        self._down_at[osd] = now
-                        await self._propose({"op": "down", "osd": osd})
-                if self.out_interval > 0:
-                    for osd, when in list(self._down_at.items()):
-                        if not om.is_out(osd) and now - when > self.out_interval:
-                            log.info("mon: osd.%d down too long -> out", osd)
-                            await self._propose({"op": "out", "osd": osd})
-            except ConnectionError:
-                continue  # lost quorum mid-sweep; retry next tick
 
-    def _ingest_pg_stats(self, osd: int, epoch: int, raw: bytes) -> None:
-        """MgrStatMonitor/DaemonServer role: fold one OSD's per-PG
-        report into the cluster pg map (newest epoch wins per pg)."""
-        import json
-        import re
 
-        try:
-            stats = json.loads(raw)
-            if not isinstance(stats, dict):
-                return
-        except ValueError:
-            return
-        book = getattr(self, "_pg_stats", None)
-        if book is None:
-            book = self._pg_stats = {}
-        for pgid, st in stats.items():
-            # shape-check: a version-skewed OSD must not be able to
-            # poison the status plane
-            if not (isinstance(pgid, str) and re.fullmatch(r"\d+\.\d+", pgid)
-                    and isinstance(st, dict)
-                    and isinstance(st.get("state"), str)):
-                continue
-            cur = book.get(pgid)
-            if cur is None or cur.get("epoch", 0) <= epoch:
-                st = dict(st)
-                st["epoch"] = epoch
-                st["primary"] = osd
-                book[pgid] = st
 
-    async def _ingest_statfs(self, osd: int, raw: bytes) -> None:
-        """Fold one OSD's store usage into the fullness plane
-        (reference OSDMonitor full-state tracking,
-        src/mon/OSDMonitor.cc:669-671 ratios + OSD.cc:773
-        recalc_full_state): keep the latest statfs for `df`, derive
-        the osd's fullness bits from the configured ratios, and commit
-        a map change whenever the bits flip so every daemon and client
-        gates on the same epoch's truth."""
-        import json
 
-        try:
-            sf = json.loads(raw)
-            total = int(sf["total"])
-            used = int(sf["used"])
-        except (ValueError, KeyError, TypeError):
-            return
-        book = getattr(self, "_osd_statfs", None)
-        if book is None:
-            book = self._osd_statfs = {}
-        book[osd] = sf
-        ratio = (used / total) if total > 0 else 0.0
-        from ceph_tpu.osd.osdmap import (
-            CEPH_OSD_BACKFILLFULL,
-            CEPH_OSD_FULL,
-            CEPH_OSD_FULL_MASK,
-            CEPH_OSD_NEARFULL,
-        )
 
-        bits = 0
-        if ratio >= self.conf["mon_osd_full_ratio"]:
-            bits = CEPH_OSD_FULL
-        elif ratio >= self.conf["mon_osd_backfillfull_ratio"]:
-            bits = CEPH_OSD_BACKFILLFULL
-        elif ratio >= self.conf["mon_osd_nearfull_ratio"]:
-            bits = CEPH_OSD_NEARFULL
-        om = self.osdmap
-        if not om.exists(osd):
-            return
-        cur = om.osd_state[osd] & CEPH_OSD_FULL_MASK
-        if cur != bits:
-            await self._propose({
-                "op": "full_state", "osd": osd, "bits": bits,
-            })
 
-    def _pg_summary(self) -> dict:
-        """Aggregate pg states (the `ceph -s` pgs block)."""
-        book = getattr(self, "_pg_stats", {}) or {}
-        om = self.osdmap
-        expected = sum(p.pg_num for p in om.pools.values())
-        by_state: dict[str, int] = {}
-        objects = 0
-        min_epoch = om.epoch
-        primaries = self._pg_primaries(om)
-        for pgid, st in book.items():
-            pid_s, ps_s = pgid.split(".")
-            pid = int(pid_s)
-            if pid not in om.pools:
-                continue
-            if int(ps_s) >= om.pools[pid].pg_num:
-                continue  # dissolved merge child (late beacon)
-            state = st.get("state", "unknown")
-            # a report from a primary that is now down — or that is no
-            # longer THE primary after a remap — is STALE until the
-            # current primary reports (reference pg_state stale
-            # semantics: stats are per-interval)
-            reporter = st.get("primary", -1)
-            cur_primary = primaries.get((pid, int(ps_s)), -1)
-            if not om.is_up(reporter) or reporter != cur_primary:
-                state = "stale"
-            by_state[state] = by_state.get(state, 0) + 1
-            objects += int(st.get("objects", 0))
-            min_epoch = min(min_epoch, int(st.get("epoch", 0)))
-        reported = sum(by_state.values())
-        return {
-            "num_pgs": expected,
-            "num_reported": reported,
-            "by_state": by_state,
-            "num_objects": objects,
-            # the oldest osdmap epoch any counted report was computed
-            # at: a waiter that just forced a map change can require
-            # min_reported_epoch >= that epoch so pre-change
-            # active+clean reports can't satisfy it (the qa-helper
-            # wait_for_clean checks last_epoch_clean the same way)
-            "min_reported_epoch": (
-                min_epoch if reported else 0),
-        }
 
-    def _pg_primaries(self, om) -> dict[tuple[int, int], int]:
-        """pg -> current primary, CACHED PER EPOCH: status/health are
-        the hottest mon read path and a full CRUSH pass per call would
-        stall beacon dispatch (the balancer learned this the hard way
-        — see the to_thread note there)."""
-        from ceph_tpu.osd.types import pg_t as _pg_t
 
-        cache_epoch, out, seen = getattr(
-            self, "_primaries_cache", (None, {}, set()))
-        if cache_epoch != om.epoch:
-            out, seen = {}, set()
-            self._primaries_cache = (om.epoch, out, seen)
-        # memoize per epoch, computing only the pgids actually present
-        # in the stats book (bounded by reports, not pools x pg_num) —
-        # lazily, so pgids whose first report lands mid-epoch still
-        # resolve; `seen` keeps warm calls near-O(1)
-        book = getattr(self, "_pg_stats", {}) or {}
-        if len(seen) != len(book):
-            for pgid in book:
-                if pgid in seen:
-                    continue
-                seen.add(pgid)
-                pid_s, ps_s = pgid.split(".")
-                pid, ps = int(pid_s), int(ps_s)
-                if pid not in om.pools:
-                    continue
-                _u, _up, _a, primary = om.pg_to_up_acting_osds(
-                    _pg_t(pid, ps), folded=True)
-                out[(pid, ps)] = primary
-        return out
 
-    def _health_checks(self, pgsum: dict | None = None) -> dict:
-        """HealthMonitor role (reference src/mon/HealthMonitor.cc +
-        per-map checks): OSD_DOWN, MON_DOWN, PG_DEGRADED."""
-        om = self.osdmap
-        checks: dict[str, dict] = {}
-        # down+IN only: a drained (down+out) osd is not a warning
-        # (HealthMonitor counts num_down_in_osds)
-        down = [
-            o for o in range(om.max_osd)
-            if om.exists(o) and not om.is_up(o) and not om.is_out(o)
-        ]
-        if down:
-            checks["OSD_DOWN"] = {
-                "severity": "HEALTH_WARN",
-                "summary": f"{len(down)} osds down",
-                "detail": [f"osd.{o} is down" for o in down],
-            }
-        if self.n_mons > 1:
-            q = sorted(self.paxos.quorum)
-            if len(q) < self.n_mons:
-                missing = [r for r in range(self.n_mons) if r not in q]
-                checks["MON_DOWN"] = {
-                    "severity": "HEALTH_WARN",
-                    "summary": (
-                        f"{len(missing)}/{self.n_mons} mons out of quorum"
-                    ),
-                    "detail": [f"mon.{r} out of quorum" for r in missing],
-                }
-        if pgsum is None:
-            pgsum = self._pg_summary()
-        bad = {
-            st: n for st, n in pgsum["by_state"].items()
-            if "degraded" in st or "recovering" in st or "stale" in st
-        }
-        if bad:
-            checks["PG_DEGRADED"] = {
-                "severity": "HEALTH_WARN",
-                "summary": (
-                    f"{sum(bad.values())} pgs not clean: "
-                    + ", ".join(f"{n} {st}" for st, n in sorted(bad.items()))
-                ),
-                "detail": [],
-            }
-        # fullness (reference OSD_FULL/OSD_BACKFILLFULL/OSD_NEARFULL
-        # health checks): FULL is an error — writes are bouncing
-        full = [o for o in range(om.max_osd) if om.is_full(o)]
-        bfull = [
-            o for o in range(om.max_osd)
-            if om.is_backfillfull(o) and o not in full
-        ]
-        near = [
-            o for o in range(om.max_osd)
-            if om.is_nearfull(o) and o not in full and o not in bfull
-        ]
-        if full:
-            checks["OSD_FULL"] = {
-                "severity": "HEALTH_ERR",
-                "summary": f"{len(full)} full osd(s); writes blocked",
-                "detail": [f"osd.{o} is full" for o in full],
-            }
-        if bfull:
-            checks["OSD_BACKFILLFULL"] = {
-                "severity": "HEALTH_WARN",
-                "summary": (
-                    f"{len(bfull)} backfillfull osd(s); backfill paused"
-                ),
-                "detail": [f"osd.{o} is backfillfull" for o in bfull],
-            }
-        if near:
-            checks["OSD_NEARFULL"] = {
-                "severity": "HEALTH_WARN",
-                "summary": f"{len(near)} nearfull osd(s)",
-                "detail": [f"osd.{o} is nearfull" for o in near],
-            }
-        if any(c["severity"] == "HEALTH_ERR" for c in checks.values()):
-            status = "HEALTH_ERR"
-        else:
-            status = "HEALTH_OK" if not checks else "HEALTH_WARN"
-        return {"status": status, "checks": checks}
 
-    def _config_sections_for(self, who: tuple[str, int]) -> dict:
-        """The sections addressing one entity, in precedence order
-        (global < type < type.id), pre-merged for the receiver."""
-        kind, ident = who
-        out: dict[str, dict[str, str]] = {}
-        for sec in ("global", kind, f"{kind}.{ident}"):
-            if sec in self._config_db:
-                out[sec] = dict(self._config_db[sec])
-        return out
 
-    def _autoscale_rows(self) -> list[dict]:
-        """pg_autoscaler sizing math: ideal pg count ~ eligible osds *
-        mon_target_pg_per_osd / size, rounded to a power of two."""
-        om2 = self.osdmap
-        target = self.conf["mon_target_pg_per_osd"]
-
-        def _eligible(pool) -> int:
-            rule = om2.crush.rules.get(pool.crush_rule)
-            cls = getattr(rule, "device_class", None)
-            n = sum(
-                1 for o in range(om2.max_osd)
-                if om2.exists(o) and not om2.is_out(o)
-                and (cls is None
-                     or om2.crush.device_classes.get(o) == cls)
-            )
-            return n or 1
-
-        rows = []
-        for pid, pool in sorted(om2.pools.items()):
-            n_in = _eligible(pool)
-            ideal = max(1, n_in * target // max(1, pool.size))
-            # nearest power of two, min 1
-            p2 = 1 << max(0, ideal.bit_length() - 1)
-            if ideal - p2 > (p2 * 2) - ideal:
-                p2 *= 2
-            rows.append({
-                "pool": om2.pool_names.get(pid, str(pid)),
-                "pool_id": pid,
-                "size": pool.size,
-                "pg_num": pool.pg_num,
-                "new_pg_num": p2,
-                "autoscale_mode": pool.extra.get(
-                    "pg_autoscale_mode", "off"),
-                "would_adjust": p2 != pool.pg_num,
-            })
-        return rows
-
-    async def _autoscale_tick(self) -> None:
-        """The acting half of the pg_autoscaler: pools that opted in
-        (pg_autoscale_mode=on) get their advised pg_num APPLIED through
-        paxos — reference src/pybind/mgr/pg_autoscaler/module.py
-        _maybe_adjust.  Shrinks as well as grows (pg merge); like the
-        reference's threshold, a shrink only fires when the advised
-        count is under half the current one, so the scaler can't
-        oscillate around a boundary."""
-        interval = self.conf["mon_pg_autoscale_interval"]
-        while True:
-            await asyncio.sleep(interval)
-            if not self.is_leader:
-                continue
-            try:
-                for row in self._autoscale_rows():
-                    pool = self.osdmap.pools.get(row["pool_id"])
-                    if pool is None or pool.extra.get(
-                            "pg_autoscale_mode") != "on":
-                        continue
-                    new = row["new_pg_num"]
-                    if new == pool.pg_num or (
-                        new < pool.pg_num and new * 2 > pool.pg_num
-                    ):
-                        continue
-                    log.info("mon.%d: autoscaler resizing pool %d "
-                             "pg_num %d -> %d", self.rank,
-                             row["pool_id"], pool.pg_num,
-                             row["new_pg_num"])
-                    await self._propose({
-                        "op": "pool_set", "pool": row["pool_id"],
-                        "var": "pg_num",
-                        "val": str(row["new_pg_num"]),
-                    })
-            except Exception:
-                log.exception("mon.%d: autoscale tick failed", self.rank)
-
-    def _pool_by_name(self, name: str):
-        import errno
-
-        pid = self.osdmap.lookup_pg_pool_name(name)
-        if pid < 0:
-            raise OSError(errno.ENOENT, f"no pool {name!r}")
-        return pid, self.osdmap.pools[pid]
-
-    async def _pool_set(self, cmd: dict[str, str]) -> tuple[int, str, bytes]:
-        """osd pool set <pool> <var> <val> (OSDMonitor::prepare_command
-        pool ops, src/mon/OSDMonitor.cc:7339+).  pg_num increases split
-        PGs on the OSDs; decreases merge them (PG::merge_from,
-        src/osd/PG.cc:563)."""
-        import errno
-
-        pid, pool = self._pool_by_name(cmd["pool"])
-        var, val = cmd["var"], cmd["val"]
-        if var == "pg_num":
-            n = int(val)
-            if n == pool.pg_num:
-                return 0, "no change", b""
-            if n < 1:
-                return -errno.EINVAL, "pg_num must be >= 1", b""
-            if n > 65536:
-                return -errno.ERANGE, "pg_num too large", b""
-            if n < pool.pg_num:
-                # merge only commits on a CLEAN pool (the reference's
-                # ready_to_merge gate, OSDMonitor pg_num_pending
-                # machinery): the dissolving children's logs fold into
-                # targets with incomparable version sequences, which
-                # is only safe when nothing is degraded or pending
-                book = getattr(self, "_pg_stats", {}) or {}
-                for ps in range(pool.pg_num):
-                    st = book.get(f"{pid}.{ps}")
-                    if (
-                        st is None
-                        or st.get("state") != "active+clean"
-                        or not self.osdmap.is_up(st.get("primary", -1))
-                    ):
-                        return (-errno.EBUSY,
-                                "pool not clean; merge requires every "
-                                "pg active+clean", b"")
-        elif var in ("size", "min_size"):
-            n = int(val)
-            if not 1 <= n <= 16:
-                return -errno.EINVAL, f"bad {var}", b""
-            if var == "size" and pool.type != 1:  # replicated only
-                return -errno.EPERM, "size is fixed for EC pools", b""
-            if var == "size" and n < pool.min_size:
-                return -errno.EINVAL, "size < min_size", b""
-            if var == "min_size" and n > pool.size:
-                return -errno.EINVAL, "min_size > size", b""
-        elif var == "pg_autoscale_mode":
-            if val not in ("on", "off"):
-                return -errno.EINVAL, "pg_autoscale_mode: on|off", b""
-        elif var == "target_max_bytes":
-            if int(val) < 0:
-                return -errno.EINVAL, "target_max_bytes >= 0", b""
-        elif var == "fast_read":
-            if val not in ("0", "1"):
-                return -errno.EINVAL, "fast_read: 0|1", b""
-        else:
-            return -errno.EINVAL, f"unsettable var {var!r}", b""
-        await self._propose({
-            "op": "pool_set", "pool": pid, "var": var, "val": str(val),
-        })
-        return 0, f"set pool {cmd['pool']} {var} to {val}", b""
-
-    async def _pool_rm(self, cmd: dict[str, str]) -> tuple[int, str, bytes]:
-        """osd pool rm <pool> <pool-again> --yes-i-really-really-mean-it
-        (the reference's double-confirmation)."""
-        import errno
-
-        pid, _pool = self._pool_by_name(cmd["pool"])
-        if cmd.get("pool2") != cmd["pool"] or cmd.get(
-                "sure") != "--yes-i-really-really-mean-it":
-            return (-errno.EPERM,
-                    "pass the pool name twice and "
-                    "--yes-i-really-really-mean-it", b"")
-        await self._propose({"op": "pool_rm", "pool": pid})
-        return 0, f"pool {cmd['pool']} removed", b""
-
-    async def _tier_command(
-        self, prefix: str, cmd: dict[str, str],
-    ) -> tuple[int, str, bytes]:
-        """Cache-tier admin (OSDMonitor::prepare_command tier verbs,
-        src/mon/OSDMonitor.cc 'osd tier add/remove/cache-mode/
-        set-overlay/remove-overlay')."""
-        import errno
-
-        _bpid, base = self._pool_by_name(cmd["pool"])
-        if prefix in ("osd tier add", "osd tier remove",
-                      "osd tier cache-mode", "osd tier set-overlay"):
-            tier_name = cmd.get("tierpool") or cmd.get("pool2", "")
-            if prefix == "osd tier cache-mode":
-                tier_name = cmd["pool"]
-        if prefix == "osd tier add":
-            tpid, tier = self._pool_by_name(tier_name)
-            if tpid == _bpid:
-                return -errno.EINVAL, "a pool cannot tier itself", b""
-            if tier.extra.get("tier_of"):
-                return -errno.EINVAL, "already a tier", b""
-            if base.extra.get("tier_of"):
-                return (-errno.EINVAL,
-                        "base is itself a tier (no tier chains)", b"")
-            if tier.type != 1:
-                return (-errno.EINVAL,
-                        "cache tier must be replicated (omap)", b"")
-            await self._propose({
-                "op": "tier_add", "base": _bpid, "tier": tpid,
-            })
-            return 0, f"{tier_name} is now a tier of {cmd['pool']}", b""
-        if prefix == "osd tier remove":
-            tpid, tier = self._pool_by_name(tier_name)
-            if tier.extra.get("tier_of") != str(_bpid):
-                return (-errno.ENOENT,
-                        f"{tier_name} is not a tier of {cmd['pool']}", b"")
-            if base.extra.get("read_tier") == str(tpid):
-                return -errno.EBUSY, "remove the overlay first", b""
-            await self._propose({
-                "op": "tier_rm", "base": _bpid, "tier": tpid,
-            })
-            return 0, "tier removed", b""
-        if prefix == "osd tier cache-mode":
-            mode = cmd["mode"]
-            if mode not in ("writeback", "none"):
-                return -errno.EINVAL, "mode: writeback|none", b""
-            if not base.extra.get("tier_of"):
-                return -errno.EINVAL, f"{cmd['pool']} is not a tier", b""
-            await self._propose({
-                "op": "tier_mode", "tier": _bpid, "mode": mode,
-            })
-            return 0, f"cache-mode {mode}", b""
-        if prefix == "osd tier set-overlay":
-            tpid, tier = self._pool_by_name(tier_name)
-            if tier.extra.get("tier_of") != str(_bpid):
-                return -errno.EINVAL, "not a tier of that pool", b""
-            await self._propose({
-                "op": "tier_overlay", "base": _bpid, "tier": tpid,
-            })
-            return 0, "overlay set", b""
-        if prefix == "osd tier remove-overlay":
-            await self._propose({"op": "tier_overlay", "base": _bpid,
-                                 "tier": -1})
-            return 0, "overlay removed", b""
-        return -errno.EOPNOTSUPP, prefix, b""
-
-    async def _auth_command(
-        self, prefix: str, cmd: dict[str, str],
-    ) -> tuple[int, str, bytes]:
-        """The AuthMonitor command slice (src/mon/AuthMonitor.cc
-        prepare_command): add / get-or-create / del / caps / get / ls.
-        ``caps`` argument is a JSON object {"mon": "allow r", ...}."""
-        import errno
-        import json
-
-        from ceph_tpu.common.caps import CapsError, validate
-        from ceph_tpu.msg.auth import make_secret
-
-        def parse_caps() -> dict[str, str]:
-            raw = cmd.get("caps", "")
-            caps = json.loads(raw) if raw else {}
-            if not isinstance(caps, dict):
-                raise CapsError("caps must be an object")
-            validate(caps)
-            return caps
-
-        entity = cmd.get("entity", "")
-        if prefix in ("auth add", "auth get-or-create", "auth del",
-                      "auth caps", "auth get") and not entity:
-            return -errno.EINVAL, "entity required", b""
-        if entity in getattr(self, "_bootstrap_entities", set()):
-            # construction-keyring identities are the cluster's root of
-            # trust (client.admin bootstrap): the command plane must
-            # not be able to rebind or delete them
-            return -errno.EPERM, f"{entity} is a bootstrap entity", b""
-        try:
-            if prefix == "auth add":
-                if entity in self._auth_db:
-                    return -errno.EEXIST, f"entity {entity} exists", b""
-                key = cmd.get("key") or make_secret().hex()
-                try:
-                    if len(bytes.fromhex(key)) not in (16, 24, 32):
-                        raise ValueError
-                except ValueError:
-                    # never let a malformed key reach paxos: applying
-                    # it would poison every restart's replay
-                    return -errno.EINVAL, "key must be 16/24/32 hex bytes", b""
-                await self._propose({
-                    "op": "auth_upsert", "entity": entity, "key": key,
-                    "caps": parse_caps(),
-                })
-                return 0, "added", json.dumps({"key": key}).encode()
-            if prefix == "auth get-or-create":
-                existing = self._auth_db.get(entity)
-                if existing is not None:
-                    if cmd.get("caps"):
-                        if parse_caps() != existing["caps"]:
-                            # the reference's EINVAL on caps mismatch:
-                            # a get-or-create never silently diverges
-                            # from what the caller asked for
-                            return (-errno.EINVAL,
-                                    "entity exists with different caps", b"")
-                    return 0, "exists", json.dumps(
-                        {"key": existing["key"]}).encode()
-                key = make_secret().hex()
-                await self._propose({
-                    "op": "auth_upsert", "entity": entity, "key": key,
-                    "caps": parse_caps(),
-                })
-                return 0, "created", json.dumps({"key": key}).encode()
-            if prefix == "auth del":
-                if entity not in self._auth_db:
-                    return -errno.ENOENT, f"no entity {entity}", b""
-                await self._propose({"op": "auth_del", "entity": entity})
-                return 0, "removed", b""
-            if prefix == "auth caps":
-                rec = self._auth_db.get(entity)
-                if rec is None:
-                    return -errno.ENOENT, f"no entity {entity}", b""
-                await self._propose({
-                    "op": "auth_upsert", "entity": entity,
-                    "key": rec["key"], "caps": parse_caps(),
-                })
-                return 0, "caps updated", b""
-            if prefix == "auth get":
-                rec = self._auth_db.get(entity)
-                if rec is None:
-                    return -errno.ENOENT, f"no entity {entity}", b""
-                return 0, "", json.dumps(
-                    {"entity": entity, **rec}).encode()
-            if prefix == "auth ls":
-                return 0, "", json.dumps({
-                    e: {"caps": r["caps"]}
-                    for e, r in sorted(self._auth_db.items())
-                }).encode()
-        except (CapsError, json.JSONDecodeError) as e:
-            return -errno.EINVAL, f"bad caps: {e}", b""
-        return -errno.EOPNOTSUPP, f"unknown {prefix!r}", b""
-
-    def _sync_auth_keyring(self) -> None:
-        """Mirror the paxos-committed auth database into the live
-        AuthContext so grants/tickets reflect it immediately (the
-        AuthMonitor -> KeyServer update path).  Statically-keyed
-        bootstrap entities (construction keyring) stay untouched."""
-        a = self.messenger.auth
-        if a is None:
-            return
-        synced = getattr(self, "_auth_synced", set())
-        for entity in synced - set(self._auth_db):
-            a.keyring.pop(entity, None)
-            a.caps_db.pop(entity, None)
-        ok: set[str] = set()
-        for entity, rec in self._auth_db.items():
-            if entity in self._bootstrap_entities:
-                continue  # never clobber the root of trust
-            try:
-                key = bytes.fromhex(rec["key"])
-                if len(key) not in (16, 24, 32):
-                    raise ValueError(len(key))
-            except ValueError:
-                # a poisoned record must degrade to "that entity can't
-                # auth", never to "the monitor can't restart"
-                log.error("mon.%d: unusable key for %s in auth db — "
-                          "skipped", self.rank, entity)
-                continue
-            a.keyring[entity] = key
-            a.caps_db[entity] = dict(rec["caps"])
-            ok.add(entity)
-        self._auth_synced = ok
-
-    def _apply_config_locally(self) -> None:
-        for sec in ("global", "mon", f"mon.{self.rank}"):
-            for name, value in self._config_db.get(sec, {}).items():
-                try:
-                    self.conf.set(name, value, source="mon")
-                except (KeyError, ValueError):
-                    pass
-
-    async def _push_config(self) -> None:
-        for peer, conn in list(self._subscribers.items()):
-            secs = self._config_sections_for(peer)
-            try:
-                await conn.send_message(MConfig(sections=secs))
-            except (ConnectionError, OSError):
-                self._subscribers.pop(peer, None)
-
-    def _snap_alloc_lock(self, pool_id: int):
-        locks = getattr(self, "_snap_locks", None)
-        if locks is None:
-            locks = self._snap_locks = {}
-        if pool_id not in locks:
-            import asyncio as _asyncio
-
-            locks[pool_id] = _asyncio.Lock()
-        return locks[pool_id]
 
     # -- commands (the MonCommands.h slice) ----------------------------
 
@@ -1456,544 +528,6 @@ class Monitor:
         "osd tier set-overlay", "osd tier remove-overlay",
     })
 
-    async def _command(
-        self, cmd: dict[str, str], caps: dict[str, str] | None = None,
-    ) -> tuple[int, str, bytes]:
-        import errno
-        import json
 
-        prefix = cmd.get("prefix", "")
-        if caps is not None:
-            # MonCap admission (Monitor::_allowed_command): mutations
-            # need mon w, everything else mon r — EXCEPT the auth
-            # plane, which is admin-only end to end (the reference
-            # tags MonCommands.h auth verbs with mon rwx): 'auth get'
-            # returns secret keys and 'auth caps' rewrites grants, so
-            # plain r/w must not reach either
-            from ceph_tpu.common.caps import capable
 
-            if prefix.startswith("auth "):
-                need = "rwx"
-            else:
-                need = "w" if prefix in self.WRITE_PREFIXES else "r"
-            if not capable(caps, "mon", need):
-                return -errno.EACCES, "access denied", b""
-        mutating = prefix in self.WRITE_PREFIXES or prefix in (
-            # not mutations, but only the leader ingests pg stats and
-            # knows the live quorum: redirect so peons don't serve an
-            # empty status plane
-            "status", "health", "pg stat", "df", "osd df",
-        )
-        if mutating and not self.is_leader:
-            leader = self.paxos.leader if self.paxos.leader is not None else -1
-            return -errno.EAGAIN, f"ENOTLEADER {leader}", b""
-        try:
-            if prefix == "osd erasure-code-profile set":
-                name = cmd["name"]
-                profile = dict(
-                    kv.split("=", 1) for kv in cmd.get("profile", "").split() if kv
-                )
-                profile.setdefault("plugin", "jax")
-                # instantiate once to validate + fill defaults
-                ec_registry.factory(profile["plugin"], profile)
-                await self._propose({
-                    "op": "profile", "name": name, "profile": profile,
-                })
-                return 0, f"profile {name} set", b""
-            if prefix == "osd pool create":
-                return await self._pool_create(cmd)
-            if prefix.startswith("auth "):
-                return await self._auth_command(prefix, cmd)
-            if prefix == "osd pool set":
-                return await self._pool_set(cmd)
-            if prefix == "osd pool rm":
-                return await self._pool_rm(cmd)
-            if prefix.startswith("osd tier "):
-                return await self._tier_command(prefix, cmd)
-            if prefix == "osd in":
-                osd = int(cmd["id"])
-                om = self.osdmap
-                if not om.exists(osd):
-                    return -errno.ENOENT, f"osd.{osd} does not exist", b""
-                if not om.is_out(osd):
-                    return 0, f"osd.{osd} is already in", b""
-                await self._propose({"op": "in", "osd": osd})
-                return 0, f"marked in osd.{osd}", b""
-            if prefix == "osd pool selfmanaged-snap create":
-                pid = self._pool_ids[cmd["pool"]]
-                # serialize id allocation: two concurrent creates must
-                # not both read snap_seq before either commits
-                async with self._snap_alloc_lock(pid):
-                    snapid = self.osdmap.pools[pid].snap_seq + 1
-                    await self._propose({
-                        "op": "snap_alloc", "pool": pid, "snapid": snapid,
-                    })
-                return 0, f"snap {snapid}", json.dumps(
-                    {"snapid": snapid}).encode()
-            if prefix == "osd pool selfmanaged-snap rm":
-                pid = self._pool_ids[cmd["pool"]]
-                snapid = int(cmd["snapid"])
-                if snapid not in self.osdmap.pools[pid].removed_snaps:
-                    await self._propose({
-                        "op": "snap_rm", "pool": pid, "snapid": snapid,
-                    })
-                return 0, f"snap {snapid} removed", b""
-            if prefix == "osd pool mksnap":
-                pid = self._pool_ids[cmd["pool"]]
-                name = cmd["snap"]
-                async with self._snap_alloc_lock(pid):
-                    pool = self.osdmap.pools[pid]
-                    if name in pool.pool_snaps:
-                        return -errno.EEXIST, f"snap {name} exists", b""
-                    snapid = pool.snap_seq + 1
-                    await self._propose({
-                        "op": "snap_alloc", "pool": pid, "snapid": snapid,
-                        "name": name,
-                    })
-                return 0, f"created pool snap {name}", json.dumps(
-                    {"snapid": snapid}).encode()
-            if prefix == "osd pool rmsnap":
-                pid = self._pool_ids[cmd["pool"]]
-                name = cmd["snap"]
-                pool = self.osdmap.pools[pid]
-                if name not in pool.pool_snaps:
-                    return -errno.ENOENT, f"no snap {name}", b""
-                await self._propose({
-                    "op": "snap_rm", "pool": pid,
-                    "snapid": pool.pool_snaps[name], "name": name,
-                })
-                return 0, f"removed pool snap {name}", b""
-            if prefix == "osd down":
-                osd = int(cmd["id"])
-                if self.osdmap.is_up(osd):
-                    await self._propose({"op": "down", "osd": osd})
-                return 0, f"osd.{osd} down", b""
-            if prefix == "osd out":
-                osd = int(cmd["id"])
-                if not self.osdmap.is_out(osd):
-                    await self._propose({"op": "out", "osd": osd})
-                return 0, f"osd.{osd} out", b""
-            if prefix == "osd balance":
-                import json
 
-                from ceph_tpu.osd.balancer import UpmapBalancer
-                from ceph_tpu.osd.mapenc import decode_osdmap, encode_osdmap
-
-                try:
-                    fd = self.osdmap.crush.type_id("host")
-                except KeyError:
-                    fd = 1
-                # the census is seconds of pure computation: run it on a
-                # SNAPSHOT in a worker thread so the event loop keeps
-                # dispatching beacons (a blocked loop looks like every
-                # OSD going silent at once)
-                snapshot = decode_osdmap(encode_osdmap(self.osdmap))
-                max_swaps = int(cmd.get("max_swaps", "64"))
-
-                def _optimize():
-                    bal = UpmapBalancer(snapshot, failure_domain_type=fd)
-                    return bal.optimize(max_swaps=max_swaps)
-
-                items = await asyncio.to_thread(_optimize)
-                if items:
-                    await self._propose({
-                        "op": "upmap",
-                        "items": [
-                            [pg.pool, pg.ps, [list(p) for p in pairs]]
-                            for pg, pairs in items.items()
-                        ],
-                    })
-                return 0, f"{len(items)} upmap items installed", json.dumps(
-                    {"swaps": len(items)}
-                ).encode()
-            if prefix in ("pg scrub", "pg deep-scrub", "pg repair"):
-                return await self._scrub(
-                    cmd, deep=prefix != "pg scrub",
-                    repair=prefix == "pg repair")
-            if prefix == "df":
-                # `ceph df` (reference MgrStatMonitor/`df` detail):
-                # cluster raw totals from beacon statfs + per-pool
-                # logical usage aggregated from pg stats
-                om = self.osdmap
-                book = getattr(self, "_osd_statfs", {}) or {}
-                live = {o: s for o, s in book.items() if om.exists(o)}
-                pools: dict[str, dict] = {}
-                for pgid, st in (getattr(self, "_pg_stats", {}) or {}).items():
-                    pid = int(pgid.split(".")[0])
-                    if pid not in om.pools:
-                        continue
-                    name = om.pool_names.get(pid, str(pid))
-                    d = pools.setdefault(
-                        name, {"id": pid, "objects": 0, "bytes_used": 0})
-                    d["objects"] += int(st.get("objects", 0))
-                    d["bytes_used"] += int(st.get("bytes", 0))
-                data = json.dumps({
-                    "stats": {
-                        "total_bytes": sum(
-                            int(s.get("total", 0)) for s in live.values()),
-                        "total_used_bytes": sum(
-                            int(s.get("used", 0)) for s in live.values()),
-                        "total_avail_bytes": sum(
-                            int(s.get("available", 0))
-                            for s in live.values()),
-                    },
-                    "pools": pools,
-                }).encode()
-                return 0, "", data
-            if prefix == "osd df":
-                # `ceph osd df`: per-osd usage + fullness state
-                om = self.osdmap
-                book = getattr(self, "_osd_statfs", {}) or {}
-                nodes = []
-                for o in range(om.max_osd):
-                    if not om.exists(o):
-                        continue
-                    sf = book.get(o, {})
-                    t = int(sf.get("total", 0))
-                    u = int(sf.get("used", 0))
-                    state = []
-                    if om.is_full(o):
-                        state.append("full")
-                    elif om.is_backfillfull(o):
-                        state.append("backfillfull")
-                    elif om.is_nearfull(o):
-                        state.append("nearfull")
-                    nodes.append({
-                        "id": o,
-                        "total": t,
-                        "used": u,
-                        "available": int(sf.get("available", 0)),
-                        "utilization": (u / t) if t else 0.0,
-                        "state": state,
-                    })
-                return 0, "", json.dumps({"nodes": nodes}).encode()
-            if prefix == "status":
-                om = self.osdmap
-                pgsum = self._pg_summary()
-                up = sum(om.is_up(o) for o in range(om.max_osd))
-                inn = sum(
-                    not om.is_out(o) for o in range(om.max_osd) if om.exists(o)
-                )
-                data = json.dumps({
-                    "epoch": om.epoch,
-                    "num_osds": sum(om.exists(o) for o in range(om.max_osd)),
-                    "num_up_osds": up,
-                    "num_in_osds": inn,
-                    "quorum": sorted(self.paxos.quorum),
-                    "pools": {
-                        str(pid): {"name": name, "pg_num": om.pools[pid].pg_num}
-                        for name, pid in self._pool_ids.items()
-                    },
-                    "pgs": pgsum,
-                    "health": self._health_checks(pgsum),
-                }).encode()
-                return 0, "", data
-            if prefix == "config set":
-                who = cmd.get("who", "global")
-                name, value = cmd["name"], cmd["value"]
-                from ceph_tpu.common.config import OPTIONS
-
-                opt = OPTIONS.get(name)
-                if opt is None:
-                    return -errno.ENOENT, f"unknown option {name!r}", b""
-                try:
-                    opt.cast(value)
-                except (ValueError, TypeError) as e:
-                    return -errno.EINVAL, str(e), b""
-                await self._propose({
-                    "op": "config_set", "who": who,
-                    "name": name, "value": value,
-                })
-                return 0, f"set {who}/{name}", b""
-            if prefix == "config rm":
-                await self._propose({
-                    "op": "config_rm", "who": cmd.get("who", "global"),
-                    "name": cmd["name"],
-                })
-                return 0, "removed", b""
-            if prefix == "config dump":
-                return 0, "", json.dumps(self._config_db).encode()
-            if prefix == "config get":
-                who = cmd.get("who", "global")
-                kind = who.split(".")[0]
-                merged: dict[str, str] = {}
-                for sec in ("global", kind, who):
-                    merged.update(self._config_db.get(sec, {}))
-                if "name" in cmd:
-                    if cmd["name"] not in merged:
-                        return -errno.ENOENT, "not set", b""
-                    return 0, "", merged[cmd["name"]].encode()
-                return 0, "", json.dumps(merged).encode()
-            if prefix == "osd pg-upmap-items":
-                # explicit placement override pairs (reference
-                # OSDMonitor osd pg-upmap-items): pgid from to [...]
-                pool_id, ps = cmd["pgid"].split(".", 1)
-                pool_id = int(pool_id)
-                ps = int(ps, 16) if ps.startswith("0x") else int(ps)
-                pool = self.osdmap.pools.get(pool_id)
-                if pool is None:
-                    return -errno.ENOENT, f"no pool {pool_id}", b""
-                if not 0 <= ps < pool.pg_num:
-                    return -errno.ENOENT, f"no pg {cmd['pgid']}", b""
-                pairs_raw = cmd["pairs"].split()
-                if len(pairs_raw) % 2:
-                    return -errno.EINVAL, "pairs must be from/to pairs", b""
-                items = [
-                    [int(pairs_raw[i]), int(pairs_raw[i + 1])]
-                    for i in range(0, len(pairs_raw), 2)
-                ]
-                for frm, to in items:
-                    if not (self.osdmap.exists(frm)
-                            and self.osdmap.exists(to)):
-                        return (-errno.ENOENT,
-                                f"osd {frm} or {to} does not exist", b"")
-                await self._propose({
-                    "op": "upmap",
-                    "items": [[pool_id, ps, items]],
-                })
-                return 0, f"upmap set on {cmd['pgid']}", b""
-            if prefix == "osd crush reweight":
-                name = cmd["name"]
-                om2 = self.osdmap
-                if name.startswith("osd."):
-                    item = int(name[4:])
-                elif name in om2.crush.bucket_names:
-                    item = om2.crush.bucket_names[name]
-                else:
-                    return -errno.ENOENT, f"no item {name!r}", b""
-                if not any(
-                    item in b.items for b in om2.crush.buckets.values()
-                ):
-                    return -errno.ENOENT, f"{name!r} not in the map", b""
-                weight = int(float(cmd["weight"]) * 0x10000)
-                await self._propose({
-                    "op": "crush_reweight", "item": item,
-                    "weight": weight,
-                })
-                return 0, f"reweighted {name} to {cmd['weight']}", b""
-            if prefix == "osd crush add-bucket":
-                # OSDMonitor 'osd crush add-bucket <name> <type>'
-                name, tname = cmd["name"], cmd["type"]
-                om2 = self.osdmap
-                try:
-                    om2.crush.type_id(tname)
-                except KeyError:
-                    return -errno.EINVAL, f"unknown type {tname!r}", b""
-                if name in om2.crush.bucket_names:
-                    return 0, f"bucket {name!r} already exists", b""
-                await self._propose({
-                    "op": "crush_add_bucket", "name": name,
-                    "type": tname,
-                })
-                return 0, f"added bucket {name}", b""
-            if prefix in ("osd crush move", "osd crush add"):
-                # 'osd crush move <name> <loc>' relocates an existing
-                # item; 'osd crush add osd.N <weight> <loc>' places a
-                # device (create-or-move).  <loc> is type=name, e.g.
-                # root=default or host=host3 (CrushWrapper::move_bucket
-                # / insert_item)
-                name = cmd["name"]
-                loc = cmd.get("loc") or cmd.get("args", "")
-                if "=" not in loc:
-                    return -errno.EINVAL, f"bad location {loc!r}", b""
-                _ltype, lname = loc.split("=", 1)
-                om2 = self.osdmap
-                if lname not in om2.crush.bucket_names:
-                    return -errno.ENOENT, f"no bucket {lname!r}", b""
-                if name.startswith("osd."):
-                    item = int(name[4:])
-                    if prefix == "osd crush add" and \
-                            not om2.exists(item):
-                        return -errno.ENOENT, \
-                            f"osd.{item} does not exist", b""
-                elif prefix == "osd crush add":
-                    # the reference restricts 'crush add' to devices:
-                    # an explicit weight on a bucket would desync the
-                    # parent's stored weight from the subtree sum
-                    return -errno.EINVAL, \
-                        "'osd crush add' takes an osd.N id (use " \
-                        "'osd crush move' for buckets)", b""
-                elif name in om2.crush.bucket_names:
-                    item = om2.crush.bucket_names[name]
-                else:
-                    return -errno.ENOENT, f"no item {name!r}", b""
-                from ceph_tpu.crush.builder import would_cycle
-
-                if would_cycle(
-                        om2.crush, item,
-                        om2.crush.bucket_names[lname]):
-                    return -errno.EINVAL, \
-                        f"moving {name!r} under {lname!r} would " \
-                        "create a loop", b""
-                op = {
-                    "op": "crush_move", "item_name": name,
-                    "loc": lname,
-                }
-                if prefix == "osd crush add":
-                    op["weight"] = int(float(cmd["weight"]) * 0x10000)
-                await self._propose(op)
-                return 0, f"moved {name} under {lname}", b""
-            if prefix == "osd crush rm":
-                name = cmd["name"]
-                om2 = self.osdmap
-                if name.startswith("osd."):
-                    item = int(name[4:])
-                elif name in om2.crush.bucket_names:
-                    item = om2.crush.bucket_names[name]
-                else:
-                    return -errno.ENOENT, f"no item {name!r}", b""
-                if item < 0 and om2.crush.buckets[item].items:
-                    return -errno.ENOTEMPTY, \
-                        f"bucket {name!r} is not empty", b""
-                await self._propose({
-                    "op": "crush_rm", "item_name": name,
-                })
-                return 0, f"removed {name}", b""
-            if prefix == "osd pool autoscale-status":
-                # the pg_autoscaler mgr module's sizing math
-                # (reference src/pybind/mgr/pg_autoscaler).  Advisory
-                # here; pools with pg_autoscale_mode=on get the advice
-                # APPLIED by _autoscale_tick (pg splitting exists now)
-                return 0, "", json.dumps(self._autoscale_rows()).encode()
-            if prefix == "health":
-                h = self._health_checks()
-                return 0, h["status"], json.dumps(h).encode()
-            if prefix == "pg stat":
-                book = getattr(self, "_pg_stats", {}) or {}
-                return 0, "", json.dumps({
-                    "pg_stats": book, "summary": self._pg_summary(),
-                }).encode()
-            return -errno.EINVAL, f"unknown command {prefix!r}", b""
-        except KeyError as e:
-            return -errno.EINVAL, f"missing arg {e}", b""
-        except Exception as e:  # command errors must not kill the mon
-            eno = getattr(e, "errno", None) or errno.EINVAL
-            return -eno, str(e) or type(e).__name__, b""
-
-    async def _scrub(self, cmd: dict[str, str], deep: bool,
-                     repair: bool = False) -> tuple[int, str, bytes]:
-        """Forward a scrub request to the PG's primary and return its
-        report (OSDMonitor scrub command -> MOSDScrub to the OSD)."""
-        import errno
-
-        from ceph_tpu.osd.types import pg_t
-
-        pool_id, ps = cmd["pgid"].split(".", 1)
-        pool_id, ps = int(pool_id), int(ps, 16) if ps.startswith("0x") else int(ps)
-        om = self.osdmap
-        if om.get_pg_pool(pool_id) is None:
-            return -errno.ENOENT, f"no pool {pool_id}", b""
-        _, _, _, primary = om.pg_to_up_acting_osds(pg_t(pool_id, ps), folded=True)
-        if primary < 0:
-            return -errno.EAGAIN, f"pg {cmd['pgid']} has no primary", b""
-        addr = om.osd_addrs.get(primary)
-        conn = self._subscribers.get(("osd", primary))
-        if conn is None and addr is not None:
-            conn = await self.messenger.connect_to(("osd", primary), *addr)
-        if conn is None:
-            return -errno.EAGAIN, f"primary osd.{primary} unreachable", b""
-        tid = next(self._tids)
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._scrub_waiters[tid] = fut
-        try:
-            await conn.send_message(
-                MOSDScrub(tid=tid, pool=pool_id, ps=ps, deep=deep,
-                          repair=repair)
-            )
-            # shorter than the client command timeout (30s): a slow
-            # scrub returns an error here instead of the client
-            # resending and stacking duplicate scrubs
-            reply: MOSDScrubReply = await asyncio.wait_for(fut, 25)
-        except asyncio.TimeoutError:
-            return -errno.ETIMEDOUT, "scrub did not finish in 25s", b""
-        finally:
-            self._scrub_waiters.pop(tid, None)
-        return reply.result, "", reply.report
-
-    async def _pool_create(self, cmd: dict[str, str]) -> tuple[int, str, bytes]:
-        """OSDMonitor::prepare_new_pool (OSDMonitor.cc:7339): leader
-        validates, then the creation replicates through paxos and
-        applies deterministically on every member."""
-        import errno
-        import json
-
-        name = cmd["name"]
-        if name in self._pool_ids:
-            pid = self._pool_ids[name]
-            return 0, f"pool {name!r} already exists", json.dumps({"pool_id": pid}).encode()
-        pool_type = cmd.get("pool_type", "replicated")
-        om = self.osdmap
-        if pool_type == "erasure":
-            profile_name = cmd.get("erasure_code_profile", "default")
-            profile = om.erasure_code_profiles.get(profile_name)
-            if profile is None:
-                return -errno.ENOENT, f"no profile {profile_name!r}", b""
-            ec_registry.factory(profile["plugin"], dict(profile))  # validate
-        elif om.crush.bucket_names.get("default") is None and (
-            cmd.get("rule", "replicated_rule") not in om.crush.rule_names
-        ):
-            return -errno.ENOENT, "no default crush root", b""
-        await self._propose({
-            "op": "pool_create", "name": name,
-            "pg_num": int(cmd.get("pg_num", "8")),
-            "pool_type": pool_type,
-            "size": int(cmd.get("size", "3")),
-            "rule": cmd.get("rule", ""),
-            "erasure_code_profile": cmd.get("erasure_code_profile", "default"),
-            "fast_read": cmd.get("fast_read", "") in ("1", "true", "yes"),
-        })
-        pid = self._pool_ids[name]
-        return 0, f"pool {name!r} created", json.dumps({"pool_id": pid}).encode()
-
-    def _apply_pool_create(self, op: dict) -> None:
-        """Deterministic half of pool creation (same inputs + same map
-        state -> same pool id, rule id and crush mutation on every
-        quorum member)."""
-        name = op["name"]
-        if name in self._pool_ids:
-            return
-        om = self.osdmap
-        pid = self._next_pool
-        if op["pool_type"] == "erasure":
-            profile_name = op["erasure_code_profile"]
-            profile = om.erasure_code_profiles[profile_name]
-            ec = ec_registry.factory(profile["plugin"], dict(profile))
-            rule_name = op["rule"] or name
-            if rule_name in om.crush.rule_names:
-                rule = om.crush.rule_names[rule_name]
-            else:
-                rule = ec.create_rule(rule_name, om.crush)
-            k = ec.get_data_chunk_count()
-            m = ec.get_coding_chunk_count()
-            pool = PgPool(
-                id=pid, type=PoolType.ERASURE, size=k + m, min_size=k,
-                crush_rule=rule, pg_num=op["pg_num"], pgp_num=op["pg_num"],
-                erasure_code_profile=profile_name,
-            )
-        else:
-            rule_name = op["rule"] or "replicated_rule"
-            if rule_name in om.crush.rule_names:
-                rule = om.crush.rule_names[rule_name]
-            else:
-                from ceph_tpu.crush import builder
-
-                root = om.crush.bucket_names["default"]
-                try:
-                    fd = om.crush.type_id("host")
-                except KeyError:
-                    fd = 1
-                rule = builder.add_simple_rule(om.crush, root, fd, mode="firstn")
-                om.crush.rule_names[rule_name] = rule
-            pool = PgPool(
-                id=pid, type=PoolType.REPLICATED, size=op["size"],
-                min_size=max(1, op["size"] - 1), crush_rule=rule,
-                pg_num=op["pg_num"], pgp_num=op["pg_num"],
-            )
-        if op.get("fast_read"):
-            # pool fast_read flag (pg_pool_t FLAG_..., ECCommon.cc:531
-            # read-all-decode-first-k)
-            pool.extra["fast_read"] = "1"
-        om.pools[pid] = pool
-        om.pool_names[pid] = name
-        self._pool_ids[name] = pid
-        self._next_pool += 1
