@@ -206,12 +206,18 @@ class BlockStore(ObjectStore):
                  compression_required_ratio: float = 0.875,
                  allocator: str = "first-fit",
                  capacity_bytes: int = 1 << 40):
+        from ceph_tpu.store.bluefs import BlueFSLite
+
         self.path = path
         # advertised device size for statfs (the block file itself
         # grows on demand up to this)
         self.capacity_bytes = capacity_bytes
         os.makedirs(path, exist_ok=True)
-        self.db = db if db is not None else FileDB(os.path.join(path, "kv"))
+        # default: BlueFS-lite — the KV (WAL + checkpoints) lives on
+        # the SAME device under the SAME allocator (the BlueStore raw-
+        # device model, src/os/bluestore/BlueFS.cc); pass an external
+        # db (e.g. FileDB) to split metadata out instead
+        self.db = db if db is not None else BlueFSLite()
         self._block_path = os.path.join(path, "block")
         self._fd: int | None = None
         self._alloc = (
@@ -239,10 +245,18 @@ class BlockStore(ObjectStore):
         }
 
     def mount(self) -> None:
-        if hasattr(self.db, "mount"):
-            self.db.mount()
+        from ceph_tpu.store.bluefs import BlueFSLite
+
         self._fd = os.open(
             self._block_path, os.O_RDWR | os.O_CREAT, 0o644)
+        bluefs = isinstance(self.db, BlueFSLite)
+        if bluefs:
+            # the KV lives on OUR device: superblock + chains first,
+            # then the blob sweep below can read its metadata
+            self.db.attach(self._fd)
+            self.db.mount()
+        elif hasattr(self.db, "mount"):
+            self.db.mount()
         # rebuild the allocator from the live blob set (FreelistManager
         # role); anything on disk not referenced by a committed extent
         # map is garbage from a torn write -> reclaimed here (fsck-lite)
@@ -256,15 +270,23 @@ class BlockStore(ObjectStore):
                 used.update(range(unit, unit + units))
                 end = max(end, unit + units)
             it.next()
+        if bluefs:
+            kv_units = self.db.used_units()
+            used |= kv_units
+            end = max(end, max(kv_units) + 1)
         self._alloc.init_from_used(used, end)
+        if bluefs:
+            # allocator live: the WAL may now grow and checkpoints run
+            self.db.activate(self._alloc)
 
     def umount(self) -> None:
+        # KV first: BlueFS's final checkpoint writes through our fd
+        if hasattr(self.db, "umount"):
+            self.db.umount()
         if self._fd is not None:
             os.fsync(self._fd)
             os.close(self._fd)
             self._fd = None
-        if hasattr(self.db, "umount"):
-            self.db.umount()
 
     def fsck(self) -> list[dict]:
         """Verify every blob's checksum at rest (BlueStore fsck role)."""

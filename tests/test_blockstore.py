@@ -34,11 +34,17 @@ class TestBlockStoreSpecifics:
         assert store.fsck() == []
 
     def test_checksum_at_rest_detects_bit_rot(self, store):
+        from ceph_tpu.store.blockstore import _okey, _parse_blob
+
         data = os.urandom(2 * MIN_ALLOC)
         store.queue_transaction(Transaction().write(C, O1, 0, data))
-        # flip bytes in the middle of the blob ON DISK
+        # flip bytes in the middle of the blob ON DISK (locate it via
+        # the extent map — with BlueFS co-located the device's first
+        # units are KV superblocks, not the blob)
+        meta = json.loads(store.db.get("O", _okey(C, O1)))
+        unit = _parse_blob(meta["extents"][0][1])[0]
         with open(store._block_path, "r+b") as f:
-            f.seek(MIN_ALLOC // 2)
+            f.seek(unit * MIN_ALLOC + MIN_ALLOC // 2)
             f.write(b"\xde\xad\xbe\xef")
         with pytest.raises(OSError) as ei:
             store.read(C, O1)
