@@ -849,6 +849,24 @@ class RGWStore:
                 await self._remove_quiet(io, oid)
         return etag
 
+    async def _mp_claim(self, bucket: dict, key: str, upload_id: str) -> bool:
+        """Atomically claim the upload for finalization: complete and
+        abort racing on one upload id must not interleave (the
+        fuzzer's seed-0 catch: abort deleted the part chains a
+        concurrent complete had just stitched into the live object).
+        Exclusive-create on a claim object is the arbiter — exactly
+        one finalizer wins (rgw_multi.cc serializes through the meta
+        object the same way)."""
+        try:
+            await self.meta.create(
+                self._mp_meta_oid(bucket, key, upload_id) + ".claim",
+                exclusive=True)
+        except RadosError as e:
+            if e.errno == errno.EEXIST:
+                return False
+            raise
+        return True
+
     async def complete_multipart(
         self, bucket: dict, key: str, upload_id: str,
         parts: list[tuple[int, str]],
@@ -876,6 +894,13 @@ class RGWStore:
             manifest += [[oid, sz] for oid, sz in entry["oids"]]
             total += entry["size"]
             md5s += bytes.fromhex(entry["etag"])
+        # claim only once the request validates: a rejected complete
+        # must not poison the upload for a retry (claim released on any
+        # later failure)
+        if not await self._mp_claim(bucket, key, upload_id):
+            # another finalizer (an abort, or a duplicate complete)
+            # owns the upload
+            raise RGWError("NoSuchUpload", 404, upload_id)
         io = self._data_io(bucket)
         head_oid = self._head_oid(bucket, key)
         etag = f"{hashlib.md5(md5s).hexdigest()}-{len(parts)}"
@@ -900,6 +925,9 @@ class RGWStore:
                              .setxattr("rgw.meta", json.dumps(meta).encode()))
         except BaseException:
             await self._index_abort(bucket, key, tag)
+            await self._remove_quiet(
+                self.meta,
+                self._mp_meta_oid(bucket, key, upload_id) + ".claim")
             raise
         await self._index_complete(bucket, key, tag, "put", {
             "size": total, "etag": etag, "mtime": meta["mtime"],
@@ -910,17 +938,26 @@ class RGWStore:
             if pn not in {p for p, _ in parts}:
                 for oid, _ in entry["oids"]:
                     await self._remove_quiet(io, oid)
-        await self._remove_quiet(self.meta, self._mp_meta_oid(bucket, key, upload_id))
+        mp_oid = self._mp_meta_oid(bucket, key, upload_id)
+        await self._remove_quiet(self.meta, mp_oid)
+        await self._remove_quiet(self.meta, mp_oid + ".claim")
         return meta
 
     async def abort_multipart(self, bucket: dict, key: str, upload_id: str) -> None:
         omap = await self._mp_state(bucket, key, upload_id)
+        if not await self._mp_claim(bucket, key, upload_id):
+            # a complete is (or was) finalizing this upload: the part
+            # chains belong to the live object now — touching them
+            # would corrupt it.  S3 abort is idempotent-quiet.
+            return
         io = self._data_io(bucket)
         for k, v in omap.items():
             if k.startswith("part."):
                 for oid, _ in json.loads(v)["oids"]:
                     await self._remove_quiet(io, oid)
-        await self._remove_quiet(self.meta, self._mp_meta_oid(bucket, key, upload_id))
+        mp_oid = self._mp_meta_oid(bucket, key, upload_id)
+        await self._remove_quiet(self.meta, mp_oid)
+        await self._remove_quiet(self.meta, mp_oid + ".claim")
 
     async def list_parts(self, bucket: dict, key: str, upload_id: str) -> list[dict]:
         omap = await self._mp_state(bucket, key, upload_id)
