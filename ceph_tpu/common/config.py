@@ -207,6 +207,19 @@ declare(
     Option("osd_ec_farm_min_bytes", int, 32768, LEVEL_ADVANCED,
            "payloads below this stay on the single-device path even "
            "when the farm is active", min=0),
+    Option("osd_recovery_decode_batch", str, "on", LEVEL_ADVANCED,
+           "coalesce concurrent recovery decodes sharing an erasure "
+           "signature into fixed-shape batched launches "
+           "(ceph_tpu/parallel/decode_batcher.py)",
+           enum=("on", "off")),
+    Option("osd_recovery_decode_batch_window", float, 0.002,
+           LEVEL_ADVANCED,
+           "coalescing window (s) the decode aggregator waits to "
+           "collect concurrent per-object recovery decodes", min=0.0),
+    Option("osd_ec_warmup", str, "on", LEVEL_ADVANCED,
+           "compile the fixed-bucket batched encode/decode shapes of "
+           "each EC profile at map-install time so no XLA compile "
+           "happens inside the I/O path", enum=("on", "off")),
     Option("debug_osd", int, 1, LEVEL_DEV, "osd log verbosity", min=0, max=5),
     Option("debug_mon", int, 1, LEVEL_DEV, "mon log verbosity", min=0, max=5),
 )

@@ -39,6 +39,43 @@ class PerfCounters:
             return {**self._counters, **self._gauges}
 
 
+class BucketCounters:
+    """Per-bucket counters for batched-dispatch layers (the encode farm
+    and the recovery-decode aggregator): each counter is tracked both as
+    an aggregate and per (width, batch) bucket, so `perf dump` /
+    prometheus can report batching efficiency — occupancy, launches and
+    cold compiles per compiled shape."""
+
+    def __init__(self, name: str):
+        self.pc = get_perf_counters(name)
+
+    def inc(self, key: str, *, by: float = 1.0, **labels) -> None:
+        self.pc.inc(key, by)
+        if labels:
+            suffix = "".join(
+                f"_{k}{v}" for k, v in sorted(labels.items()))
+            self.pc.inc(key + suffix, by)
+
+    def dump(self) -> dict[str, float]:
+        return self.pc.dump()
+
+    def efficiency(self) -> dict[str, float]:
+        """Aggregate batching-efficiency summary for bench reports."""
+        d = self.pc.dump()
+        out = {
+            "launches": d.get("launches", 0.0),
+            "cold_launches": d.get("cold_launches", 0.0),
+            "prewarmed_shapes": d.get("prewarmed_shapes", 0.0),
+        }
+        if d.get("padded_lanes"):
+            out["lane_occupancy"] = d["occupied_lanes"] / d["padded_lanes"]
+            out["mean_batch"] = d["occupied_lanes"] / max(
+                d.get("launches", 1.0), 1.0)
+        if d.get("padded_bytes"):
+            out["byte_occupancy"] = d["occupied_bytes"] / d["padded_bytes"]
+        return out
+
+
 _COLLECTIONS: dict[str, PerfCounters] = {}
 _REG_LOCK = threading.Lock()
 

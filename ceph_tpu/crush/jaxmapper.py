@@ -1030,7 +1030,11 @@ class BatchedRuleMapper:
             rew = np.zeros(max(cc.max_devices, 1), np.int32)
             rw = np.asarray(reweights, np.int64)
             rew[: len(rw)] = rw[: len(rew)]
-        with jax.enable_x64(True):
+        try:  # renamed from jax.experimental across jax releases
+            _enable_x64 = jax.enable_x64
+        except AttributeError:
+            from jax.experimental import enable_x64 as _enable_x64
+        with _enable_x64(True):
             if self._jitted is None:
                 self._jitted = self._build()
             vals, cnt = self._jitted(xs, rew)

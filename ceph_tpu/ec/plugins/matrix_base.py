@@ -190,6 +190,12 @@ class MatrixErasureCode(ErasureCode):
             self._decode_cache.popitem(last=False)
         return D
 
+    def decode_matrix(self, erasures) -> np.ndarray:
+        """Public form of the per-erasure-signature cached decode matrix
+        (consumed by the recovery-decode aggregator, which batches
+        matmuls across objects sharing the signature)."""
+        return self._decode_matrix(tuple(sorted(erasures)))
+
     def decode_plan(
         self,
         available: Mapping[int, np.ndarray],

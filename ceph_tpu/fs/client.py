@@ -161,7 +161,11 @@ class FSClient:
     async def stat(self, path: str) -> dict:
         attr = (await self.request("stat", path=path))["attr"]
         # overlay OUR buffered (EXCL) attrs: a client always sees its
-        # own writes even before the cap flush lands
+        # own writes even before the cap flush lands.  A snapshot view
+        # is frozen past — the live file's buffered size must NOT leak
+        # into it (the attr shares the live ino)
+        if attr.get("snapid") is not None:
+            return attr
         d = self._dirty.get(attr.get("ino"))
         if d is not None:
             if "size" in d:
@@ -200,6 +204,10 @@ class FSClient:
     # -- snapshots -----------------------------------------------------
 
     async def snap_create(self, path: str, name: str) -> int:
+        # buffered EXCL size/mtime must reach the MDS BEFORE it freezes
+        # the manifest, or the snapshot records a stale smaller size
+        # and snap reads silently truncate acked writes
+        await self.flush_dirty()
         out = await self.request("snap_create", path=path, name=name)
         seq, snaps = out["snapc"]
         self.data_io.set_snap_context(seq, snaps)

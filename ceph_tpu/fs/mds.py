@@ -516,8 +516,31 @@ class MDSDaemon:
         elif op == "rename":
             paths = [(args.get("src", ""), False),
                      (args.get("dst", ""), False)]
+        elif op == "snap_create":
+            # the freeze must see every holder's buffered size/mtime:
+            # recall EXCL across the WHOLE subtree before the manifest
+            # is frozen, or snapshot reads silently truncate acked
+            # writes (ADVICE r5 #1)
+            path = args.get("path", "")
+            if path and ".snap" not in path.strip("/").split("/"):
+                inos: list[int] = []
+                async with self._mutation_lock:
+                    try:
+                        rec = await self._lookup(path)
+                        if rec["type"] == "dir":
+                            inos = await self._subtree_inos(rec["ino"])
+                    except FSError:
+                        inos = []
+                for ino in inos:
+                    if ino in self._cap_holders:
+                        await self._recall(ino, except_conn=None,
+                                           only_excl=True)
+            return
         for path, only_excl in paths:
-            if not path or "/.snap" in f"/{path}":
+            # exact path-component test: only a literal ".snap"
+            # component is a snapshot view — a file merely named e.g.
+            # "dir/.snapshot" still needs cap coherence
+            if not path or ".snap" in path.strip("/").split("/"):
                 continue
             async with self._mutation_lock:
                 try:
@@ -856,6 +879,17 @@ class MDSDaemon:
             if rec["type"] == "dir":
                 r["children"] = await self._freeze(rec["ino"])
             out[name] = r
+        return out
+
+    async def _subtree_inos(self, ino: int) -> list[int]:
+        """Every file/dir ino under directory ``ino`` (recall scope of
+        a snapshot freeze)."""
+        out: list[int] = []
+        d = await self._dir(ino)
+        for rec in d["entries"].values():
+            out.append(rec["ino"])
+            if rec["type"] == "dir":
+                out.extend(await self._subtree_inos(rec["ino"]))
         return out
 
     async def _op_snap_create(self, path: str, name: str) -> dict:

@@ -374,6 +374,19 @@ class BitmatrixCodec:
         on TPU with a tileable S, else the XLA path."""
         return self._apply(self.encode_bits, data, pallas)
 
+    def decode_batch(
+        self, batch: jax.Array, erasures: tuple[int, ...]
+    ) -> jax.Array:
+        """Batched recovery decode: (B, k, S) survivor payload lanes
+        (survivors in codec order for this signature) -> (B, e, S)
+        reconstructed chunks, one XLA launch for the whole batch.  The
+        per-signature decode matrix comes from the same LRU cache the
+        per-object path uses (:meth:`decode_bits`), so a signature's
+        matrix is derived once no matter how many batches hit it —
+        the aggregator's fixed-shape dispatch rides this."""
+        _survivors, dbits = self.decode_bits(erasures)
+        return gf_bitmatmul(dbits, batch)
+
     def decode(
         self, chunks: jax.Array, erasures: tuple[int, ...], *, pallas: bool | None = None
     ) -> jax.Array:

@@ -182,6 +182,15 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         # never touch jax at boot
         self._encode_service = encode_service
         self._encode_service_resolved = encode_service is not None
+        # recovery-decode batching aggregator (parallel/decode_batcher):
+        # per-object recovery decodes coalesce into fixed-shape batched
+        # launches; resolved lazily like the farm
+        self._decode_aggregator = None
+        self._decode_aggregator_resolved = False
+        # EC profiles whose fixed-bucket shapes have been prewarmed (the
+        # no-compile-in-the-I/O-path discipline; see _warm_ec_profiles)
+        self._warmed_profiles: set[str] = set()
+        self._warm_tasks: set = set()
         self.messenger = Messenger(
             ("osd", osd_id), self._dispatch, on_reset=self._on_reset,
             auth=auth,
@@ -393,6 +402,11 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         sock.register(
             "dump_traces", "recent spans (blkin/otel role)",
             lambda cmd: self.tracer.dump(),
+        )
+        sock.register(
+            "dump_decode_batch", "recovery-decode aggregator batching "
+            "efficiency (per-bucket occupancy/launch/compile counters)",
+            lambda cmd: self._dump_decode_batch(),
         )
         sock.register(
             "config show", "effective configuration",
@@ -679,6 +693,94 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                     self._encode_service = svc
         return self._encode_service
 
+    @property
+    def decode_aggregator(self):
+        """The process recovery-decode aggregator, per
+        osd_recovery_decode_batch config.  Device-agnostic (the batched
+        XLA kernel is bit-exact on CPU and TPU), so default on."""
+        if not self._decode_aggregator_resolved:
+            self._decode_aggregator_resolved = True
+            if self.conf["osd_recovery_decode_batch"] != "off":
+                from ceph_tpu.parallel import decode_batcher as db
+
+                agg = db.shared()
+                agg.window_s = self.conf[
+                    "osd_recovery_decode_batch_window"]
+                self._decode_aggregator = agg
+        return self._decode_aggregator
+
+    def _dump_decode_batch(self) -> dict:
+        import os as _os
+
+        agg = self.decode_aggregator
+        if agg is None:
+            return {"active": False}
+        # pid lets multi-process harnesses dedupe the process-wide
+        # aggregator across co-hosted daemons' sockets
+        out = {"active": True, "pid": _os.getpid(),
+               "stats": dict(agg.stats)}
+        out["efficiency"] = agg.metrics.efficiency()
+        out["buckets"] = agg.metrics.dump()
+        svc = self._encode_service
+        if svc is not None:
+            out["encode_farm"] = {
+                "stats": dict(svc.stats),
+                "efficiency": svc.metrics.efficiency(),
+            }
+        return out
+
+    def _warm_ec_profiles(self) -> None:
+        """Map-time warmup: compile the fixed-bucket batched
+        decode/encode shapes for every EC profile the new map carries,
+        in a background thread — so after a profile's warmup completes,
+        no XLA compile can occur inside the recovery/write I/O path
+        (the discipline the decode aggregator's cold_launches counter
+        verifies).  Idempotent per profile name."""
+        om = self.osdmap
+        if om is None or self.conf["osd_ec_warmup"] == "off":
+            return
+        fresh = [
+            (name, dict(prof))
+            for name, prof in (om.erasure_code_profiles or {}).items()
+            if name not in self._warmed_profiles
+        ]
+        if not fresh:
+            return  # BEFORE resolving services: maps without EC
+            # profiles must not make replicated-only daemons touch jax
+        self._warmed_profiles.update(name for name, _ in fresh)
+        agg = self.decode_aggregator
+        svc = self.encode_service
+
+        def _warm() -> None:
+            import jax
+
+            # the farm's mesh/collective shapes are only worth
+            # compiling ahead of time on an accelerator backend (where
+            # a cold compile stalls the I/O path for ~30 s); on the CPU
+            # backend (tests, dev) compiles are milliseconds and the
+            # eager virtual-mesh warmup would cost more than it saves
+            farm_warm = jax.default_backend() not in ("cpu",)
+            for name, prof in fresh:
+                try:
+                    ec = ec_registry.factory(
+                        prof.get("plugin", "jax"), dict(prof))
+                    sinfo = self._sinfo(ec)
+                    cs = sinfo.chunk_size
+                    widths = [max(cs >> 2, 1), cs, cs << 2]
+                    if agg is not None:
+                        agg.prewarm(ec, widths)
+                    if (svc is not None and farm_warm
+                            and hasattr(ec, "coding_matrix")):
+                        svc.prewarm(ec.coding_matrix, widths)
+                except Exception:
+                    log.exception(
+                        "osd.%d: EC warmup for profile %r failed",
+                        self.id, name)
+
+        task = asyncio.ensure_future(asyncio.to_thread(_warm))
+        self._warm_tasks.add(task)
+        task.add_done_callback(self._warm_tasks.discard)
+
     def _extent_cache_get(self, pool_id, oid, version, lo, hi):
         ent = self._extent_cache.get((pool_id, oid))
         if ent is None:
@@ -834,6 +936,7 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             self._track_intervals(old_map, new_map)
             self._maybe_split_pgs(old_map, new_map)
             self._gc_removed_pools(old_map, new_map)
+            self._warm_ec_profiles()
         if gap:
             # ask the mon for the missing range (or a full map)
             await self._request_map_fill()

@@ -214,9 +214,16 @@ async def decode_shards_async(
     *,
     packed_repair: bool = False,
     service=None,
+    aggregator=None,
 ) -> dict[int, np.ndarray]:
-    """:func:`decode_shards` with farm-batched reconstruction (recovery
-    path; falls back for sub-chunk/packed codes)."""
+    """:func:`decode_shards` with batched reconstruction (recovery
+    path; falls back for sub-chunk/packed codes).
+
+    ``aggregator`` (a parallel.decode_batcher.DecodeAggregator) takes
+    precedence over the encode farm: per-object recovery decodes that
+    share an erasure signature coalesce into fixed-shape batched
+    launches — the repair-pipelining discipline — instead of one farm
+    matmul per object."""
     if packed_repair or (
         not isinstance(ec_impl, MatrixErasureCode)
         or ec_impl.get_sub_chunk_count() != 1
@@ -224,12 +231,36 @@ async def decode_shards_async(
         return decode_shards(sinfo, ec_impl, to_decode, need,
                              packed_repair=packed_repair)
     inv = {ec_impl.chunk_index(c): c for c in range(ec_impl.get_chunk_count())}
+    want_chunks = [inv[s] for s in need]
+    if aggregator is not None and aggregator.active() and to_decode:
+        rec = await _decode_chunks_batched(
+            ec_impl, to_decode, want_chunks, aggregator)
+        if rec is not None:
+            return {ec_impl.chunk_index(c): v for c, v in rec.items()}
     rec = await _decode_chunks_async(sinfo, ec_impl, to_decode,
-                                     [inv[s] for s in need], service=service)
+                                     want_chunks, service=service)
     if rec is None:
         return decode_shards(sinfo, ec_impl, to_decode, need,
                              packed_repair=packed_repair)
     return {ec_impl.chunk_index(c): v for c, v in rec.items()}
+
+
+async def _decode_chunks_batched(
+    ec_impl, to_decode, want_chunks, aggregator
+) -> dict[int, np.ndarray] | None:
+    """decode_payloads with the matmul coalesced across concurrent
+    recovery decodes by the aggregator; None = take another path."""
+    want_chunks = list(want_chunks)
+    erasures, survivors, need_rec, D = ec_impl.decode_plan(
+        to_decode, want_chunks)
+    rec_rows = None
+    if need_rec:
+        rows = ec_impl.decode_rows(to_decode, survivors)
+        if rows.shape[1] == 0:
+            return None
+        rec_rows = await aggregator.apply(D, rows)
+    return ec_impl.decode_assemble(
+        to_decode, want_chunks, erasures, need_rec, rec_rows)
 
 
 async def _decode_chunks_async(

@@ -263,13 +263,37 @@ class PGLog:
         matching the reference's conservative stance on merge (it
         forces backfill when either side's history is short).  The
         child's on-disk meta dies with its collection in the same
-        transaction (caller removes it)."""
+        transaction (caller removes it).
+
+        Version keys can COLLIDE across the two logs: child and target
+        ran independent per-PG version counters, so the same
+        (epoch, version) may name different ops in each.  Folding a
+        colliding child entry in directly would silently overwrite the
+        target's entry and its omap record — losing a log entry AND
+        its reqid dedup vouch.  On collision, the child's entries are
+        rewritten into a disjoint version range just past both logs'
+        heads (order preserved, reqids intact); the rewritten versions
+        only feed peering deltas and dup detection, and the post-merge
+        reconcile pass (merge_pending) re-verifies objects by their
+        stored attrs, so authority is unaffected — the reference's
+        don't-trust-merged-logs stance at entry granularity."""
         t.touch(self.cid, self.meta)
         kv: dict[str, bytes] = {}
-        for e in child.entries.values():
+        child_entries = [child.entries[v] for v in sorted(child.entries)]
+        if any(e.version in self.entries for e in child_entries):
+            base = max(self.info.last_update, child.info.last_update)
+            remapped = []
+            for i, e in enumerate(child_entries):
+                nv = eversion_t(base.epoch, base.version + 1 + i)
+                remapped.append(pg_log_entry_t(
+                    e.op, e.oid, nv, e.prior_version, e.reqid))
+            child_entries = remapped
+        for e in child_entries:
             self.entries[e.version] = e
             self._track_reqid(e)
             kv[LOG_KEY_PREFIX + e.version.key()] = e.encode()
+        if child_entries and child_entries[-1].version > self.info.last_update:
+            self.info.last_update = child_entries[-1].version
         if child.info.last_update > self.info.last_update:
             self.info.last_update = child.info.last_update
         if child.info.log_tail > self.info.log_tail:

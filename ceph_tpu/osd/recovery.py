@@ -819,6 +819,7 @@ class RecoveryMixin:
         rebuilt = await ecutil.decode_shards_async(
             sinfo, ec, chunks, need, packed_repair=used_packed,
             service=self.encode_service,
+            aggregator=self.decode_aggregator,
         )
         self.perf.inc("recovery_decode_seconds",
                       time.perf_counter() - _t0)

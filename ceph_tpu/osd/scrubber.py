@@ -279,6 +279,7 @@ class ScrubMixin:
                 rebuilt = await ecutil.decode_shards_async(
                     sinfo, ec, good, bad_shards,
                     service=self.encode_service,
+                    aggregator=self.decode_aggregator,
                 )
                 self.perf.inc("recovery_decode_seconds",
                               time.perf_counter() - _t0)

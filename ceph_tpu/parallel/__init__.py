@@ -12,6 +12,7 @@ Maps Ceph's parallelism strategies (SURVEY.md §2.9) onto a
   pod slice: the XOR combine rides ICI collectives instead of TCP.
 """
 
+from ceph_tpu.parallel.decode_batcher import DecodeAggregator  # noqa: F401
 from ceph_tpu.parallel.encode_farm import (  # noqa: F401
     batch_encode_dp,
     sharded_encode_tp,

@@ -27,6 +27,9 @@ import collections
 
 import numpy as np
 
+from ceph_tpu.common.metrics import BucketCounters
+from ceph_tpu.parallel.decode_batcher import pow2_bucket
+
 #: payloads smaller than this stay on the caller's local path — TPU/mesh
 #: dispatch overhead dwarfs the math (SURVEY.md §7 hard part 3)
 DEFAULT_MIN_BYTES = 32768
@@ -60,6 +63,12 @@ class EncodeService:
         self._flush_handle = None
         self._bits_cache: collections.OrderedDict = collections.OrderedDict()
         self.stats = collections.Counter()
+        #: compiled dispatch shapes (by prewarm or earlier launches); a
+        #: launch outside this set pays an XLA compile — the warmup
+        #: discipline (daemon map-time prewarm) keeps this at zero
+        #: inside the I/O path
+        self._warm: set[tuple] = set()
+        self.metrics = BucketCounters("encode_farm")
 
     # -- gating --------------------------------------------------------
 
@@ -151,31 +160,58 @@ class EncodeService:
             _, rows, _fut = group[0]
             nsh = self.mesh.shape["shard"]
             if nsh > 1 and k % nsh == 0:
+                # same fixed-bucket discipline as the dp path: pad S to
+                # its pow2 bucket so the tp program shape set is bounded
+                S = pow2_bucket(rows.shape[1], 1)
+                if S != rows.shape[1]:
+                    padded = np.zeros((rows.shape[0], S), np.uint8)
+                    padded[:, : rows.shape[1]] = rows
+                else:
+                    padded = rows
+                self._note_shape(("tp", bits.shape, k, S), w=S)
                 out = np.asarray(
-                    sharded_encode_tp(self.mesh, bits, jnp.asarray(rows)))
+                    sharded_encode_tp(self.mesh, bits, jnp.asarray(padded)))
                 self.stats["tp_dispatches"] += 1
-                return [out]
+                self.metrics.inc("launches", w=S)
+                return [np.ascontiguousarray(out[:, : rows.shape[1]])]
 
-        # data-parallel batch: pad each request to the widest S, pad the
-        # batch to the device count, one sharded dispatch
+        # data-parallel batch: pad each request's S to a fixed
+        # power-of-two width bucket and the batch dim to a power-of-two
+        # multiple of the device count, one sharded dispatch — launch
+        # shapes come from a tiny fixed set, so every compile happens
+        # at prewarm, never mid-I/O
         ndev = 1
         for ax in self.mesh.shape.values():
             ndev *= ax
         widths = [rows.shape[1] for _, rows, _ in group]
-        S = max(widths)
-        B = -(-len(group) // ndev) * ndev
+        S = pow2_bucket(max(widths), 1)
+        B = ndev * pow2_bucket(-(-len(group) // ndev), 1)
         batch = np.zeros((B, k, S), np.uint8)
         for i, (_, rows, _) in enumerate(group):
             batch[i, :, : rows.shape[1]] = rows
         axes = tuple(a for a in ("pg", "shard") if a in self.mesh.shape)
+        self._note_shape(("dp", bits.shape, B, k, S), w=S, b=B)
         out = np.asarray(
             batch_encode_dp(self.mesh, bits, jnp.asarray(batch), axis=axes))
         self.stats["dp_dispatches"] += 1
         self.stats["coalesced"] += len(group)
+        self.metrics.inc("launches", w=S, b=B)
+        self.metrics.inc("occupied_lanes", w=S, b=B, by=len(group))
+        self.metrics.inc("padded_lanes", w=S, b=B, by=B)
+        self.metrics.inc("occupied_bytes", w=S, b=B, by=sum(widths) * k)
+        self.metrics.inc("padded_bytes", w=S, b=B, by=B * k * S)
         return [
             np.ascontiguousarray(out[i, :, : rows.shape[1]])
             for i, (_, rows, _) in enumerate(group)
         ]
+
+    def _note_shape(self, shape_key: tuple, *, w: int, b: int = 1) -> None:
+        """Track whether a launch shape was already compiled; a miss is
+        a cold in-path compile the warmup should have covered."""
+        if shape_key not in self._warm:
+            self._warm.add(shape_key)
+            self.stats["cold_launches"] += 1
+            self.metrics.inc("cold_launches", w=w, b=b)
 
 
     def _run_group_single(self, group: list[tuple], bits, k) -> list[np.ndarray]:
@@ -189,22 +225,99 @@ class EncodeService:
 
         widths = [rows.shape[1] for _, rows, _ in group]
         total = sum(widths)
-        S = 1 << max(total - 1, 1).bit_length()  # pow2 bucket
+        S = pow2_bucket(total, 1)  # fixed pow2 width bucket
         big = np.zeros((k, S), np.uint8)
         off = 0
         for (_, rows, _), w in zip(group, widths):
             big[:, off:off + w] = rows
             off += w
+        self._note_shape(("single", bits.shape, k, S), w=S)
         out = np.asarray(BitmatrixCodec._apply(
             bits, jnp.asarray(big), None))
         self.stats["single_dispatches"] += 1
         self.stats["coalesced"] += len(group)
+        self.metrics.inc("launches", w=S)
+        self.metrics.inc("occupied_bytes", w=S, by=total * k)
+        self.metrics.inc("padded_bytes", w=S, by=k * S)
         outs = []
         off = 0
         for w in widths:
             outs.append(np.ascontiguousarray(out[:, off:off + w]))
             off += w
         return outs
+
+    # -- warmup --------------------------------------------------------
+
+    def prewarm(self, M: np.ndarray, widths, *, coalesce: int = 16) -> int:
+        """Compile the fixed-bucket launch shapes this service can hit
+        for matrix ``M`` and per-request payload widths ``widths``
+        (coalescing concatenates/batches up to ``coalesce`` concurrent
+        requests).  Blocking — run at daemon warmup, never in the I/O
+        path.  Returns the number of programs compiled."""
+        if not self.active():
+            return 0
+        import jax
+        import jax.numpy as jnp
+
+        from ceph_tpu.ops.compile_cache import ensure_persistent_cache
+        from ceph_tpu.ops.rs_kernels import BitmatrixCodec
+        from ceph_tpu.parallel.encode_farm import batch_encode_dp
+
+        ensure_persistent_cache()  # warmed programs persist across runs
+
+        bits = self._bits(np.asarray(M, np.uint8))
+        k = M.shape[1]
+        buckets: set[int] = set()
+        for w in widths:
+            f = 1
+            while f <= coalesce:
+                buckets.add(pow2_bucket(w * f, 1))
+                f <<= 1
+        n = 0
+        if self.mesh is not None:
+            ndev = 1
+            for ax in self.mesh.shape.values():
+                ndev *= ax
+            axes = tuple(
+                a for a in ("pg", "shard") if a in self.mesh.shape)
+            bbs = sorted({
+                ndev * pow2_bucket(-(-g // ndev), 1)
+                for g in range(1, coalesce + 1)
+            })
+            for S in sorted(pow2_bucket(w, 1) for w in widths):
+                for B in bbs:
+                    key = ("dp", bits.shape, B, k, S)
+                    if key in self._warm:
+                        continue
+                    jax.block_until_ready(batch_encode_dp(
+                        self.mesh, bits,
+                        jnp.zeros((B, k, S), np.uint8), axis=axes))
+                    self._warm.add(key)
+                    n += 1
+            nsh = self.mesh.shape.get("shard", 1)
+            if nsh > 1 and k % nsh == 0:
+                from ceph_tpu.parallel.encode_farm import sharded_encode_tp
+
+                for S in sorted(pow2_bucket(w, 1) for w in widths):
+                    key = ("tp", bits.shape, k, S)
+                    if key in self._warm:
+                        continue
+                    jax.block_until_ready(sharded_encode_tp(
+                        self.mesh, bits, jnp.zeros((k, S), np.uint8)))
+                    self._warm.add(key)
+                    n += 1
+        else:
+            for S in sorted(buckets):
+                key = ("single", bits.shape, k, S)
+                if key in self._warm:
+                    continue
+                jax.block_until_ready(BitmatrixCodec._apply(
+                    bits, jnp.zeros((k, S), np.uint8), None))
+                self._warm.add(key)
+                n += 1
+        self.stats["prewarmed_shapes"] += n
+        self.metrics.inc("prewarmed_shapes", by=n)
+        return n
 
 
 _shared: EncodeService | None = None

@@ -217,6 +217,20 @@ class BlockStore(ObjectStore):
         # the SAME device under the SAME allocator (the BlueStore raw-
         # device model, src/os/bluestore/BlueFS.cc); pass an external
         # db (e.g. FileDB) to split metadata out instead
+        if db is None and os.path.isdir(os.path.join(path, "kv")):
+            # legacy layout: a pre-BlueFS store keeps its KV in the
+            # kv/ sidecar directory and its device units 0-1 hold BLOB
+            # DATA, not superblocks — mounting it as BlueFS would read
+            # garbage superblocks, come up with an empty KV, and
+            # allocate the WAL over live blobs.  Keep such stores on
+            # FileDB (their on-disk contract) instead.
+            import logging
+
+            logging.getLogger("ceph_tpu.store").warning(
+                "blockstore %s: legacy kv/ sidecar layout detected; "
+                "staying on FileDB (create a fresh store to migrate "
+                "to the BlueFS-lite co-located KV)", path)
+            db = FileDB(os.path.join(path, "kv"))
         self.db = db if db is not None else BlueFSLite()
         self._block_path = os.path.join(path, "block")
         self._fd: int | None = None

@@ -523,3 +523,71 @@ class TestSnapshots:
                     await fs.unmount()
 
         run(go())
+
+
+class TestSnapCoherence:
+    """ADVICE r5 fixes: a snapshot freeze must see buffered EXCL state,
+    and cap coherence must not be disabled for files merely NAMED with
+    a .snap prefix."""
+
+    def test_snap_sees_buffered_excl_size(self):
+        """Writer A holds EXCL with a buffered (unflushed) size; a
+        DIFFERENT client snapshots the dir.  The frozen manifest must
+        record the full size — the MDS recalls EXCL across the subtree
+        before freezing (client-side flush_dirty alone can't cover the
+        other session's buffer)."""
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                mds, fs_a = await _fs(c)
+                fs_b = FSClient(mds.addr, c.client.ioctx("cephfs.data"),
+                                client_id=910)
+                await fs_b.mount()
+                try:
+                    await fs_a.mkdir("/snapd")
+                    f = await fs_a.create("/snapd/big.bin")
+                    payload = b"Z" * 9000
+                    await f.write(0, payload)
+                    from ceph_tpu.fs.mds import CAP_EXCL
+
+                    assert fs_a._caps[f.ino] & CAP_EXCL
+                    assert f.ino in fs_a._dirty  # buffered, NOT fsynced
+
+                    await fs_b.snap_create("/snapd", "s1")
+                    snap = await fs_b.open("/snapd/.snap/s1/big.bin")
+                    assert snap.size == len(payload)
+                    assert await snap.read(0) == payload
+                    attr = await fs_b.stat("/snapd/.snap/s1/big.bin")
+                    assert attr["size"] == len(payload)
+                finally:
+                    await fs_b.unmount()
+                    await fs_a.unmount()
+                    await mds.stop()
+
+        run(go())
+
+    def test_dot_snapshot_named_file_keeps_coherence(self):
+        """A file named '.snapshot' (substring of a .snap path, NOT a
+        snapshot component) still gets recall-based coherence."""
+        async def go():
+            async with Cluster(n_osds=6) as c:
+                mds, fs_a = await _fs(c)
+                fs_b = FSClient(mds.addr, c.client.ioctx("cephfs.data"),
+                                client_id=911)
+                await fs_b.mount()
+                try:
+                    await fs_a.mkdir("/dir")
+                    f = await fs_a.create("/dir/.snapshot")
+                    await f.write(0, b"q" * 4321)
+                    assert f.ino in fs_a._dirty  # buffered under EXCL
+
+                    # B's stat must recall A's EXCL (the old substring
+                    # test skipped any path containing '/.snap')
+                    attr = await fs_b.stat("/dir/.snapshot")
+                    assert attr["size"] == 4321
+                    assert f.ino not in fs_a._dirty  # flushed by recall
+                finally:
+                    await fs_b.unmount()
+                    await fs_a.unmount()
+                    await mds.stop()
+
+        run(go())

@@ -176,9 +176,19 @@ class TestBlockStoreBitRot:
                 bad_osd, bad_shard, folded = _locate_nonprimary_shard(
                     c, io, "victim")
                 store = c.osds[bad_osd].store
-                # flip bytes inside the shard's blob on DISK
+                # flip bytes inside the shard's blob on DISK — at the
+                # offset the extent map actually placed it (BlueFS-lite
+                # owns the first device units for its superblocks, so a
+                # fixed low offset would hit KV metadata, not data)
+                from ceph_tpu.store.blockstore import _parse_blob
+
+                meta = store._meta(
+                    coll_t(io.pool_id, folded.ps, bad_shard),
+                    ghobject_t("victim", shard=bad_shard))
+                assert meta and meta.get("extents"), meta
+                unit = _parse_blob(meta["extents"][0][1])[0]
                 with open(store._block_path, "r+b") as f:
-                    f.seek(64)
+                    f.seek(unit * MIN_ALLOC)
                     f.write(b"\xba\xad" * 16)
                 assert store.fsck(), "fsck must see the rot"
 

@@ -1,0 +1,59 @@
+"""CI guard for bench artifacts: every BENCH_*/MULTICHIP_* file the
+README cites must exist in the tree and parse as JSON (the README once
+cited a BENCH_ALL_r04.json that was never committed — this pins the
+honesty contract)."""
+
+import json
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _readme_artifacts() -> set[str]:
+    with open(os.path.join(REPO, "README.md")) as f:
+        text = f.read()
+    return set(re.findall(r"\b((?:BENCH|MULTICHIP)_[A-Za-z0-9_.]*\.json)\b",
+                          text))
+
+
+def test_readme_cites_at_least_one_artifact():
+    assert _readme_artifacts(), "README should cite its bench artifacts"
+
+
+def test_all_cited_artifacts_exist_and_parse():
+    missing, broken = [], []
+    for name in sorted(_readme_artifacts()):
+        path = os.path.join(REPO, name)
+        if not os.path.exists(path):
+            missing.append(name)
+            continue
+        with open(path) as f:
+            body = f.read().strip()
+        try:  # whole-document JSON, else line-delimited
+            json.loads(body)
+        except ValueError:
+            try:
+                for line in body.splitlines():
+                    if line.strip():
+                        json.loads(line)
+            except ValueError as e:
+                broken.append((name, str(e)))
+    assert not missing, f"README cites artifacts not in the tree: {missing}"
+    assert not broken, f"unparseable artifacts: {broken}"
+
+
+def test_committed_artifacts_parse():
+    """Every artifact in the tree is (line-delimited or plain) JSON."""
+    for name in sorted(os.listdir(REPO)):
+        if not re.fullmatch(r"(?:BENCH|MULTICHIP)_[A-Za-z0-9_.]*\.json",
+                            name):
+            continue
+        with open(os.path.join(REPO, name)) as f:
+            body = f.read().strip()
+        try:
+            json.loads(body)
+        except ValueError:
+            for line in body.splitlines():
+                if line.strip():
+                    json.loads(line)

@@ -266,3 +266,47 @@ class TestBitmapAllocator:
         a.free(x, 3)
         assert a.alloc(2) <= 1  # reuses the freed low run
         assert a.free_units() >= 1
+
+
+class TestLegacyLayoutGuard:
+    """A store created before the BlueFS-lite default (KV in the kv/
+    sidecar directory, blob data from device unit 0) must never be
+    mounted as BlueFS: its units 0-1 hold data, not superblocks, and
+    activate() would allocate the WAL over live blobs."""
+
+    def _make_legacy(self, path: str) -> bytes:
+        from ceph_tpu.kv import FileDB
+
+        legacy = BlockStore(
+            str(path), db=FileDB(os.path.join(path, "kv")))
+        legacy.mount()
+        legacy.queue_transaction(Transaction().create_collection(C))
+        data = os.urandom(2 * MIN_ALLOC)
+        legacy.queue_transaction(Transaction().write(C, O1, 0, data))
+        legacy.umount()
+        return data
+
+    def test_remount_keeps_filedb_and_data(self, tmp_path):
+        path = str(tmp_path / "old")
+        data = self._make_legacy(path)
+        from ceph_tpu.kv import FileDB
+        from ceph_tpu.store.bluefs import BlueFSLite
+
+        s = BlockStore(path)  # default db selection
+        assert isinstance(s.db, FileDB)
+        assert not isinstance(s.db, BlueFSLite)
+        s.mount()
+        assert s.read(C, O1) == data
+        assert s.fsck() == []
+        # still writable under the legacy layout
+        more = os.urandom(MIN_ALLOC)
+        O2 = ghobject_t("obj-post", shard=2)
+        s.queue_transaction(Transaction().write(C, O2, 0, more))
+        assert s.read(C, O2) == more
+        s.umount()
+
+    def test_fresh_store_still_defaults_to_bluefs(self, tmp_path):
+        from ceph_tpu.store.bluefs import BlueFSLite
+
+        s = BlockStore(str(tmp_path / "new"))
+        assert isinstance(s.db, BlueFSLite)

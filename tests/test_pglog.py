@@ -121,3 +121,78 @@ class TestMissing:
         assert log.missing_from(ev(1, 3)) is None     # backfill
         assert log.missing_from(ev(1, 9)) is not None  # delta still fine
         assert log.missing_from(ZERO) is None          # brand-new peer
+
+
+class TestMergeFrom:
+    """merge_from on pg_num shrink: version-key collisions between the
+    dissolving child and the target must never silently overwrite
+    target entries or their reqid dedup records."""
+
+    C2 = coll_t(1, 1, 0)
+
+    def _log(self, store, cid, oids_versions):
+        if not store.collection_exists(cid):
+            store.queue_transaction(Transaction().create_collection(cid))
+        log = PGLog(cid)
+        for oid, v, reqid in oids_versions:
+            t = Transaction()
+            log.append(t, pg_log_entry_t(MODIFY, oid, v, reqid=reqid))
+            store.queue_transaction(t)
+        return log
+
+    def test_disjoint_versions_fold_in(self, store):
+        tgt = self._log(store, C, [("a", ev(1, 1), "c1:1")])
+        child = self._log(store, self.C2, [("b", ev(2, 5), "c2:1")])
+        t = Transaction()
+        tgt.merge_from(t, child)
+        store.queue_transaction(t)
+        assert tgt.entries[ev(2, 5)].oid == "b"
+        assert tgt.info.last_update == ev(2, 5)
+
+    def test_collision_rewrites_child_into_disjoint_range(self, store):
+        tgt = self._log(store, C, [
+            ("a", ev(1, 1), "c1:1"), ("a2", ev(1, 2), "c1:2")])
+        child = self._log(store, self.C2, [
+            ("b", ev(1, 2), "c2:1"), ("b2", ev(1, 3), "c2:2")])
+        t = Transaction()
+        tgt.merge_from(t, child)
+        store.queue_transaction(t)
+        # the target's colliding entry survives untouched
+        assert tgt.entries[ev(1, 2)].oid == "a2"
+        assert tgt.entries[ev(1, 2)].reqid == "c1:2"
+        # the child's entries landed, in order, in a disjoint range
+        child_oids = [
+            e.oid for v, e in sorted(tgt.entries.items())
+            if e.oid.startswith("b")
+        ]
+        assert child_oids == ["b", "b2"]
+        assert len(tgt.entries) == 4
+        # both sides' reqids still answer dup detection
+        for rid in ("c1:1", "c1:2", "c2:1", "c2:2"):
+            assert rid in tgt.reqids
+        # last_update covers the rewritten range
+        assert tgt.info.last_update == max(tgt.entries)
+        # persisted state agrees (no omap record was lost)
+        log2 = PGLog(C)
+        log2.load(store)
+        assert sorted(log2.entries) == sorted(tgt.entries)
+
+    def test_collision_rewrite_preserves_delete_ops(self, store):
+        tgt = self._log(store, C, [("x", ev(1, 1), "t:1")])
+        store.queue_transaction(Transaction().create_collection(self.C2))
+        child = PGLog(self.C2)
+        t0 = Transaction()
+        child.append(t0, pg_log_entry_t(MODIFY, "y", ev(1, 1), reqid="s:1"))
+        child.append(t0, pg_log_entry_t(
+            DELETE, "y", ev(1, 2), ev(1, 1), reqid="s:2"))
+        store.queue_transaction(t0)
+        t = Transaction()
+        tgt.merge_from(t, child)
+        store.queue_transaction(t)
+        ys = [e for e in tgt.entries.values() if e.oid == "y"]
+        assert sorted(e.op for e in ys) == [MODIFY, DELETE]
+        # the rewritten DELETE is still the newest entry for "y"
+        newest = max(
+            (e for e in tgt.entries.values() if e.oid == "y"),
+            key=lambda e: e.version)
+        assert newest.op == DELETE

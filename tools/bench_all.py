@@ -239,6 +239,91 @@ def bench_clay_cpu_probe() -> None:
     print(json.dumps({"seconds": t, "chunk": cs}), flush=True)
 
 
+# -- config 3b: batched recovery decode vs per-object CPU plugin decode -----
+
+def bench_decode_batch() -> None:
+    """The ISSUE-1 acceptance microbench: the recovery-decode
+    aggregator's bucketed batched decode vs the per-object CPU plugin
+    decode on the SAME stripes.  With an accelerator the ratio must
+    clear 10x; on CPU-only hosts the gate is structural — the
+    aggregator must coalesce >= 4 objects per launch and match the
+    per-object decode bit-exactly (both asserted here)."""
+    import asyncio
+
+    import jax
+    import numpy as np
+
+    from ceph_tpu.ec import registry
+    from ceph_tpu.osd import ecutil
+    from ceph_tpu.parallel.decode_batcher import DecodeAggregator
+
+    k, m = 8, 3
+    on_tpu = jax.default_backend() not in ("cpu",)
+    n_obj = 16
+    obj_bytes = (8 * 2**20) if on_tpu else 512 * 1024
+    ec = registry.factory("jax", {"k": str(k), "m": str(m)})
+    sinfo = ecutil.StripeInfo(k, ec.get_chunk_size(obj_bytes) * k)
+    rng = np.random.default_rng(7)
+    objs = []
+    for _ in range(n_obj):
+        data = rng.integers(
+            0, 256, sinfo.logical_to_next_stripe_offset(obj_bytes),
+            dtype=np.uint8)
+        shards = ecutil.encode(sinfo, ec, data)
+        objs.append({s: c for s, c in shards.items() if s != 2})
+
+    # per-object host plugin decode (the CPU reference on this machine)
+    ec_host = registry.factory("jax", {"k": str(k), "m": str(m)})
+    ec_host.device_min_bytes = 1 << 62  # pin the numpy GF path
+    best_host = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        host_out = [
+            ecutil.decode_shards(sinfo, ec_host, avail, {2})
+            for avail in objs
+        ]
+        best_host = min(best_host, time.perf_counter() - t0)
+
+    # aggregator: concurrent per-object decodes coalesce into batched
+    # fixed-shape launches; prewarmed, so zero in-path compiles
+    agg = DecodeAggregator(window_s=0.002)
+    cs = len(next(iter(objs[0].values())))
+    agg.prewarm(ec, [cs], erasure_counts=(1,))
+
+    async def batched_once():
+        return await asyncio.gather(*(
+            ecutil.decode_shards_async(
+                sinfo, ec, avail, {2}, aggregator=agg)
+            for avail in objs
+        ))
+
+    outs = asyncio.run(batched_once())  # warm + correctness
+    for got, avail, ref in zip(outs, objs, host_out):
+        assert np.array_equal(got[2], ref[2]), "batched decode mismatch"
+    best_batch = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        outs = asyncio.run(batched_once())
+        best_batch = min(best_batch, time.perf_counter() - t0)
+    launches = agg.stats["launches"]
+    mean_batch = agg.stats["batched_requests"] / max(launches, 1)
+    assert mean_batch >= 4, (
+        f"aggregator batched only {mean_batch:.1f} obj/launch")
+    assert agg.stats["cold_launches"] == 0, dict(agg.stats)
+    ratio = best_host / best_batch
+    survivor_bytes = sum(
+        sum(c.nbytes for c in o.values()) for o in objs)
+    _emit(
+        f"batched recovery decode, {n_obj} x {obj_bytes >> 10} KiB "
+        f"objects EC({k},{m}) 1-erasure on "
+        f"{jax.default_backend()}: aggregator "
+        f"({mean_batch:.1f} obj/launch, 0 in-path compiles, "
+        f"{survivor_bytes / best_batch / 1e6:.0f} MB/s survivor bytes) "
+        "vs per-object CPU plugin decode",
+        ratio, "x speedup", ratio / 10.0,
+    )
+
+
 # -- config 4: 10k PGs x 1024 OSDs whole-map remap --------------------------
 
 def _big_map():
@@ -389,6 +474,19 @@ def _osd_group_main(argv: list[str]) -> int:
             _cs = len(_enc[0])
             _dec_in = {i: _enc[i] for i in range(_k + _m) if i != 2}
             _ec.decode({2}, _dec_in, _cs)
+            # fixed-bucket prewarm: compile every batched decode /
+            # farm shape the aggregator and encode service can launch
+            # for this profile NOW, before any client op exists (the
+            # daemon repeats this at map install, but doing it here
+            # guarantees the order even for ops racing the first map)
+            from ceph_tpu.parallel import decode_batcher as _db
+            from ceph_tpu.parallel import encode_service as _es
+
+            _agg = _db.shared()
+            _agg.prewarm(_ec, [max(_cs >> 2, 1), _cs, _cs << 2])
+            _svc = _es.shared()
+            if _svc.active() and hasattr(_ec, "coding_matrix"):
+                _svc.prewarm(_ec.coding_matrix, [_cs])
         except Exception:
             pass  # host-only environments still run (numpy path)
 
@@ -403,14 +501,16 @@ def _osd_group_main(argv: list[str]) -> int:
             # for seconds at a time; a 10s handshake budget would turn
             # those into false failure cascades
             "ms_connection_ready_timeout": 120.0,
-            # the farm coalesces concurrent requests into variable-
-            # width groups -> each new power-of-two bucket is a fresh
-            # XLA compile (~30s on the tunneled chip) INSIDE the I/O
-            # path, per worker process.  Config 5 measures the
-            # in-daemon recovery DECODE stage, not microbatching: the
-            # per-op plugin path (whose exact shapes the warmup above
-            # just compiled) keeps write/recovery latency sane
-            "osd_ec_encode_farm": "off",
+            # farm ON (ISSUE 1 tentpole): the farm + decode aggregator
+            # now pad into FIXED power-of-two buckets, and every bucket
+            # shape is compiled at daemon warmup (map-install prewarm +
+            # the plugin warmup above), so no XLA compile can occur
+            # inside the I/O path — the failure mode that previously
+            # forced this off (variable-width coalescing triggering
+            # ~30 s compiles mid-recovery) is structurally gone; the
+            # aggregator's cold_launches counter in dump_decode_batch
+            # verifies it per run
+            "osd_ec_encode_farm": "on",
         }
         osds = []
         for i in osd_ids:
@@ -466,9 +566,42 @@ async def _sum_decode_counters(admin_dir: str, osd_ids) -> tuple[float, float]:
     return secs, byts
 
 
-async def _recovery_scenario(profile_extra: dict) -> tuple[float, int, float, float]:
+async def _sum_batch_stats(admin_dir: str, osd_ids) -> dict:
+    """Merge the recovery-decode aggregator stats across worker
+    PROCESSES (daemons co-hosted in one process share the aggregator,
+    so sockets are deduped by pid)."""
+    from ceph_tpu.common import admin_command
+
+    seen_pids: set[int] = set()
+    total: dict[str, float] = {}
+    for i in osd_ids:
+        path = os.path.join(admin_dir, f"osd.{i}.asok")
+        try:
+            d = await admin_command(path, "dump_decode_batch")
+        except (OSError, ConnectionError):
+            continue
+        if not isinstance(d, dict) or not d.get("active"):
+            continue
+        pid = d.get("pid")
+        if pid in seen_pids:
+            continue
+        seen_pids.add(pid)
+        for k, v in (d.get("stats") or {}).items():
+            total[k] = total.get(k, 0.0) + float(v)
+    out = dict(total)
+    if total.get("launches"):
+        out["mean_batch"] = (
+            total.get("batched_requests", 0.0) / total["launches"])
+    return out
+
+
+async def _recovery_scenario(profile_extra: dict,
+                             decode_batch: str = "on"):
     """One full multi-process 1-OSD-down run.  Returns
-    (seconds_to_clean, bytes_written, decode_seconds, decode_bytes)."""
+    (seconds_to_clean, bytes_written, decode_seconds, decode_bytes,
+    decode_batch_stats).  ``decode_batch`` flips the workers'
+    osd_recovery_decode_batch (the host-baseline run measures the
+    per-object plugin decode, aggregator off)."""
     import asyncio
     import random
     import signal
@@ -500,12 +633,14 @@ async def _recovery_scenario(profile_extra: dict) -> tuple[float, int, float, fl
         list(range(g, min(g + group, n_osds - 1)))
         for g in range(0, n_osds - 1, group)
     ] + [[victim]]
+    worker_env = dict(os.environ)
+    worker_env["CEPH_TPU_OSD_RECOVERY_DECODE_BATCH"] = decode_batch
     for ids in groups:
         procs.append(subprocess.Popen(
             [sys.executable, __file__, "_osd_group",
              mon.addr[0], str(mon.addr[1]), admin_dir,
              ",".join(map(str, ids))],
-            env=dict(os.environ),
+            env=worker_env,
         ))
     victim_proc = procs[-1]
     cl = RadosClient(client_id=55, handshake_timeout=120.0)
@@ -592,31 +727,40 @@ async def _recovery_run(cl, mon, procs, victim, victim_proc, admin_dir,
     dt = time.perf_counter() - t0
     dsec, dbytes = await _sum_decode_counters(
         admin_dir, range(n_osds - 1))
-    return dt, total, dsec, dbytes
+    batch = await _sum_batch_stats(admin_dir, range(n_osds - 1))
+    print(f"bench5: decode-batch stats {batch}", file=sys.stderr,
+          flush=True)
+    return dt, total, dsec, dbytes, batch
 
 
 def bench_recovery() -> None:
     import asyncio
 
-    # run A: device decode (plugin dispatches the GF math to the chip
-    # when payloads clear device-min-bytes; the farm coalesces)
-    dt, total, dsec, dbytes = asyncio.run(
+    # run A: batched decode (the aggregator coalesces concurrent
+    # recovery decodes into fixed-shape launches; with an accelerator
+    # present the batched matmul runs on the chip, farm ON)
+    dt, total, dsec, dbytes, batch = asyncio.run(
         _recovery_scenario({"device-min-bytes": "4096"}))
     dev_mbs = (dbytes / dsec / 1e6) if dsec > 0 else 0.0
     # run B: host decode (device-min-bytes huge -> numpy GF path, the
-    # reference engine's role on this machine; farm bypassed the same
-    # way)
-    dt_h, total_h, dsec_h, dbytes_h = asyncio.run(
-        _recovery_scenario({"device-min-bytes": str(1 << 40)}))
+    # reference engine's role on this machine; aggregator bypassed so
+    # the decode stage is the per-object CPU plugin path)
+    dt_h, total_h, dsec_h, dbytes_h, _b = asyncio.run(
+        _recovery_scenario({"device-min-bytes": str(1 << 40)},
+                           decode_batch="off"))
     host_mbs = (dbytes_h / dsec_h / 1e6) if dsec_h > 0 else 0.0
     ratio = dev_mbs / host_mbs if host_mbs > 0 else 0.0
     k, m = _bench_ec_profile()
+    mb = batch.get("mean_batch", 0.0)
+    cold = batch.get("cold_launches", 0.0)
     _emit(
         f"e2e 1-OSD-down recovery, {os.environ.get('BENCH_RECOVERY_OSDS', '64')} "
-        f"OSDs in separate processes, EC({k},{m}), "
+        f"OSDs in separate processes, EC({k},{m}), encode farm ON, "
         f"{total // 2**20} MiB user data: to-clean "
-        f"(in-daemon decode stage {dev_mbs:.0f} MB/s device vs "
-        f"{host_mbs:.0f} MB/s host = {ratio:.1f}x; host-run e2e "
+        f"(in-daemon batched decode stage {dev_mbs:.1f} MB/s vs "
+        f"{host_mbs:.1f} MB/s per-object host = {ratio:.1f}x; "
+        f"aggregator mean batch {mb:.1f} obj/launch, "
+        f"{cold:.0f} cold compiles in-path; host-run e2e "
         f"{total_h / dt_h / 1e6:.1f} MB/s)",
         total / dt / 1e6, "MB/s to clean", 1.0,
     )
@@ -634,6 +778,8 @@ CONFIGS = {
     "decode_tpu": (bench_decode_tpu, True),
     "clay_repair": (bench_clay_repair, True),
     "_clay_cpu": (bench_clay_cpu_probe, False),
+    # batched recovery decode (ISSUE 1): aggregator vs per-object CPU
+    "decode_batch": (bench_decode_batch, True),
     # remap runs on the REAL chip: with the epoch-spanning program
     # cache (ceph_tpu/osd/remap.py _crush_fingerprint) a steady-state
     # epoch is a couple of launches, so the relay tax no longer
