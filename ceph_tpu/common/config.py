@@ -216,6 +216,15 @@ declare(
            LEVEL_ADVANCED,
            "coalescing window (s) the decode aggregator waits to "
            "collect concurrent per-object recovery decodes", min=0.0),
+    Option("osd_scrub_verify_batch", str, "on", LEVEL_ADVANCED,
+           "coalesce concurrent deep-scrub shard verifications (crc32c "
+           "+ parity re-encode) across objects and PGs into fixed-shape "
+           "batched launches (ceph_tpu/parallel/scrub_batcher.py)",
+           enum=("on", "off")),
+    Option("osd_scrub_verify_batch_window", float, 0.002,
+           LEVEL_ADVANCED,
+           "coalescing window (s) the scrub verifier waits to collect "
+           "concurrent per-object verification chunks", min=0.0),
     Option("osd_ec_warmup", str, "on", LEVEL_ADVANCED,
            "compile the fixed-bucket batched encode/decode shapes of "
            "each EC profile at map-install time so no XLA compile "

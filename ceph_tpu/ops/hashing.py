@@ -260,6 +260,138 @@ def crush_hash32_2_jax(a, b):
     return h
 
 
+# --- batched crc32c as a GF(2) bit-matrix matmul ---------------------------
+#
+# crc32c's table update is GF(2)-linear in (state, data):
+# T[a ^ b] = T[a] ^ T[b], so the crc of a W-byte message with seed s is
+#
+#     crc = S_W @ bits(s)  ^  M_W @ bits(message)     (mod 2)
+#
+# with S_W the 32x32 "advance through W zero bytes" operator and M_W a
+# 32x8W matrix.  That turns deep-scrub's per-shard host crc loop into
+# the repo's standard bit-matmul launch shape: a (B, W) batch of
+# payload lanes is one (32, 8W) x (8W, B) int8 MXU/XLA matmul — the
+# scrub analogue of rs_kernels.gf_bitmatmul.  Matrices build host-side
+# by doubling (M_2W = [S_W M_W | M_W], S_2W = S_W^2), so the 64 KiB
+# bucket costs 17 tiny numpy matmuls, cached per width.
+#
+# Padding discipline (parallel/scrub_batcher.py): lanes are right-
+# padded with zeros into their pow2 bucket, and crc(d || 0^p, s) ==
+# advance_zeros(p, crc(d, s)) — an injective linear map — so equality
+# against a stored crc is checked via native crc32c_zeros(p, stored),
+# and the true crc is recovered exactly with :func:`crc32c_unadvance`.
+
+_CRC_SEED_DEFAULT = 0xFFFFFFFF
+
+
+def _crc_bits(v: int, n: int = 32) -> np.ndarray:
+    return np.array([(v >> i) & 1 for i in range(n)], dtype=np.uint8)
+
+
+def _gf2_mm(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return ((a.astype(np.uint32) @ b.astype(np.uint32)) & 1).astype(np.uint8)
+
+
+@functools.lru_cache(maxsize=1)
+def _crc_base() -> tuple[np.ndarray, np.ndarray]:
+    """(M_1 (32,8), S_1 (32,32)): single-byte crc data/state operators."""
+    from ceph_tpu.native import crc32c, crc32c_zeros
+
+    m1 = np.zeros((32, 8), dtype=np.uint8)
+    for b in range(8):
+        m1[:, b] = _crc_bits(crc32c(bytes([1 << b]), 0))
+    s1 = np.zeros((32, 32), dtype=np.uint8)
+    for i in range(32):
+        s1[:, i] = _crc_bits(crc32c_zeros(1, 1 << i))
+    return m1, s1
+
+
+@functools.lru_cache(maxsize=32)
+def _crc_ops(width: int) -> tuple[np.ndarray, np.ndarray]:
+    """(M_W (32, 8W), S_W (32, 32)) for a power-of-two ``width``."""
+    assert width >= 1 and (width & (width - 1)) == 0, width
+    if width == 1:
+        return _crc_base()
+    m_half, s_half = _crc_ops(width // 2)
+    return (
+        np.concatenate([_gf2_mm(s_half, m_half), m_half], axis=1),
+        _gf2_mm(s_half, s_half),
+    )
+
+
+def crc32c_matrix(width: int) -> np.ndarray:
+    """The (32, 8*width) GF(2) matrix M_W: crc contribution of a
+    width-byte message at seed 0, bit j of byte i at column 8i+j."""
+    return _crc_ops(width)[0]
+
+
+@functools.lru_cache(maxsize=64)
+def _crc_unadvance_op(n: int) -> np.ndarray:
+    """32x32 inverse of the advance-by-n-zero-bytes operator S_n."""
+    if n == 0:
+        return np.eye(32, dtype=np.uint8)
+    # S_1^{-1} by GF(2) Gaussian elimination (S is invertible: the crc
+    # register update is a bijection), then binary decomposition
+    if n == 1:
+        s1 = _crc_base()[1]
+        aug = np.concatenate([s1.copy(), np.eye(32, dtype=np.uint8)], axis=1)
+        for col in range(32):
+            piv = next(r for r in range(col, 32) if aug[r, col])
+            aug[[col, piv]] = aug[[piv, col]]
+            for r in range(32):
+                if r != col and aug[r, col]:
+                    aug[r] ^= aug[col]
+        return np.ascontiguousarray(aug[:, 32:])
+    if n & (n - 1) == 0:
+        h = _crc_unadvance_op(n // 2)
+        return _gf2_mm(h, h)
+    lsb = n & -n
+    return _gf2_mm(_crc_unadvance_op(n - lsb), _crc_unadvance_op(lsb))
+
+
+def crc32c_unadvance(crc: int, n: int) -> int:
+    """Invert ``crc32c_zeros(n, x) == crc``: the crc BEFORE advancing
+    through ``n`` zero bytes (exact; the advance is injective)."""
+    if n == 0:
+        return crc
+    out = _gf2_mm(_crc_unadvance_op(n), _crc_bits(crc).reshape(32, 1))
+    return int(sum(int(b) << i for i, b in enumerate(out.reshape(32))))
+
+
+def batched_crc32c_device(mat, data):
+    """Device kernel: (B, W) uint8 payload lanes -> (B,) uint32 crc
+    contributions M_W @ bits(lane) (seed 0; callers fold seeds/padding
+    host-side via crc32c_zeros / crc32c_unadvance).  Jitted per (B, W)
+    shape; bit-exact with native crc32c on every backend."""
+    import jax
+
+    return _crc_kernel_jit()(jax.numpy.asarray(mat), data)
+
+
+@functools.lru_cache(maxsize=1)
+def _crc_kernel_jit():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def kern(mat, data):
+        b, w = data.shape
+        shifts = jnp.arange(8, dtype=jnp.uint8)
+        # byte i bit j (LSB first) -> column 8i+j, matching crc32c_matrix
+        bits = ((data[:, :, None] >> shifts[None, None, :]) & jnp.uint8(1))
+        bits = bits.reshape(b, w * 8).astype(jnp.int8)
+        acc = jnp.einsum(
+            "bq,pq->bp", bits, mat.astype(jnp.int8),
+            preferred_element_type=jnp.int32,
+        ) & 1
+        weights = jnp.left_shift(
+            jnp.uint32(1), jnp.arange(32, dtype=jnp.uint32))
+        return jnp.sum(acc.astype(jnp.uint32) * weights[None, :], axis=1,
+                       dtype=jnp.uint32)
+
+    return kern
+
+
 def ceph_str_hash_rjenkins(data: bytes | str) -> int:
     """Object-name hash (reference src/common/ceph_hash.cc
     ceph_str_hash_rjenkins): Jenkins lookup2 over 12-byte blocks with

@@ -70,6 +70,19 @@ def gf_bitmatmul(bitmat: jax.Array, data: jax.Array) -> jax.Array:
     return pack_bits(acc & 1)
 
 
+@jax.jit
+def gf_encode_compare(bitmat: jax.Array, data: jax.Array,
+                      parity: jax.Array) -> jax.Array:
+    """Batched re-encode-and-compare for deep scrub: apply the (8m, 8k)
+    encode bit-matrix to (B, k, S) data-shard lanes and compare against
+    the stored (B, m, S) parity lanes, returning a (B, m) bool mismatch
+    mask — the expected parity never leaves the device.  Zero-padded
+    columns are exact (encode(0) == 0 == padded parity), so bucketed
+    lanes report the same mask as the unpadded per-object compare."""
+    expect = gf_bitmatmul(bitmat, data)
+    return jnp.any(expect != parity, axis=-1)
+
+
 # ---------------------------------------------------------------------------
 # Pallas fused kernel
 # ---------------------------------------------------------------------------

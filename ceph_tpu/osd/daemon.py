@@ -187,6 +187,11 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         # launches; resolved lazily like the farm
         self._decode_aggregator = None
         self._decode_aggregator_resolved = False
+        # deep-scrub verification batcher (parallel/scrub_batcher):
+        # per-object crc32c + parity re-encode checks coalesce into
+        # fixed-shape batched launches; resolved lazily like the farm
+        self._scrub_verifier = None
+        self._scrub_verifier_resolved = False
         # EC profiles whose fixed-bucket shapes have been prewarmed (the
         # no-compile-in-the-I/O-path discipline; see _warm_ec_profiles)
         self._warmed_profiles: set[str] = set()
@@ -407,6 +412,12 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             "dump_decode_batch", "recovery-decode aggregator batching "
             "efficiency (per-bucket occupancy/launch/compile counters)",
             lambda cmd: self._dump_decode_batch(),
+        )
+        sock.register(
+            "dump_scrub_batch", "deep-scrub verification batcher "
+            "efficiency (batched crc32c + parity re-encode per-bucket "
+            "occupancy/launch/compile counters)",
+            lambda cmd: self._dump_scrub_batch(),
         )
         sock.register(
             "config show", "effective configuration",
@@ -709,6 +720,35 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                 self._decode_aggregator = agg
         return self._decode_aggregator
 
+    @property
+    def scrub_verifier(self):
+        """The process deep-scrub verification batcher, per
+        osd_scrub_verify_batch config.  Device-agnostic (batched
+        crc32c and re-encode-compare are bit-exact on CPU and TPU),
+        so default on."""
+        if not self._scrub_verifier_resolved:
+            self._scrub_verifier_resolved = True
+            if self.conf["osd_scrub_verify_batch"] != "off":
+                from ceph_tpu.parallel import scrub_batcher as sb
+
+                ver = sb.shared()
+                ver.window_s = self.conf["osd_scrub_verify_batch_window"]
+                self._scrub_verifier = ver
+        return self._scrub_verifier
+
+    def _dump_scrub_batch(self) -> dict:
+        import os as _os
+
+        ver = self.scrub_verifier
+        if ver is None:
+            return {"active": False}
+        # pid lets multi-process harnesses dedupe the process-wide
+        # verifier across co-hosted daemons' sockets
+        return {"active": True, "pid": _os.getpid(),
+                "stats": dict(ver.stats),
+                "efficiency": ver.metrics.efficiency(),
+                "buckets": ver.metrics.dump()}
+
     def _dump_decode_batch(self) -> dict:
         import os as _os
 
@@ -750,6 +790,7 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         self._warmed_profiles.update(name for name, _ in fresh)
         agg = self.decode_aggregator
         svc = self.encode_service
+        ver = self.scrub_verifier
 
         def _warm() -> None:
             import jax
@@ -769,6 +810,8 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                     widths = [max(cs >> 2, 1), cs, cs << 2]
                     if agg is not None:
                         agg.prewarm(ec, widths)
+                    if ver is not None:
+                        ver.prewarm(ec, widths)
                     if (svc is not None and farm_warm
                             and hasattr(ec, "coding_matrix")):
                         svc.prewarm(ec.coding_matrix, widths)

@@ -75,6 +75,28 @@ class StripeInfo:
         return start, self.logical_to_next_stripe_offset((off - start) + length)
 
 
+def bucket_lanes(
+    nbytes: int, *, min_bucket: int, tile_cap: int
+) -> list[tuple[int, int, int]]:
+    """Stripe -> bucket shaping for the batched dispatch layers
+    (parallel/decode_batcher, parallel/scrub_batcher): split a shard
+    payload of ``nbytes`` into column lanes of ``(offset, width,
+    bucket)`` where every bucket is drawn from the CLOSED power-of-two
+    ladder [min_bucket .. tile_cap].  Payloads wider than ``tile_cap``
+    split into full tile_cap lanes (GF matmuls and crc folds are both
+    column-composable); narrower ones pad up to their pow2 bucket —
+    so a prewarmed ladder covers every payload size an OSD can see."""
+    if nbytes <= 0:
+        return []
+    if nbytes <= tile_cap:
+        b = max(nbytes, min_bucket, 1)
+        return [(0, nbytes, 1 << (b - 1).bit_length())]
+    lanes = []
+    for off in range(0, nbytes, tile_cap):
+        lanes.append((off, min(tile_cap, nbytes - off), tile_cap))
+    return lanes
+
+
 def encode(
     sinfo: StripeInfo,
     ec_impl: ErasureCodeInterface,

@@ -95,17 +95,24 @@ class ScrubMixin:
         chunk_max = self.conf["osd_scrub_chunk_max"]
         chunk_sleep = self.conf["osd_scrub_sleep"]
         inconsistencies: list[dict] = []
+
+        async def _one(oid: str) -> list[dict]:
+            async with self._obj_lock(pool.id, oid):
+                return await self._scrub_object(pool, pg, pairs, oid, deep)
+
         for base in range(0, len(all_oids), chunk_max):
             # one gate admission per chunk at best-effort weight:
             # saturated client I/O outranks the scan (admission before
-            # the object locks, per the opqueue deadlock rule)
+            # the object locks, per the opqueue deadlock rule).  The
+            # chunk's objects run CONCURRENTLY (each under its own
+            # object lock) so their verification work lands in the
+            # scrub verifier's coalescing window as one batch instead
+            # of one launch per object.
             async with self.op_gate.admit("best_effort"):
-                for oid in all_oids[base : base + chunk_max]:
-                    async with self._obj_lock(pool.id, oid):
-                        inconsistencies.extend(
-                            await self._scrub_object(
-                                pool, pg, pairs, oid, deep)
-                        )
+                for incs in await asyncio.gather(*(
+                    _one(oid) for oid in all_oids[base : base + chunk_max]
+                )):
+                    inconsistencies.extend(incs)
             await asyncio.sleep(chunk_sleep)
 
         repaired: list[str] = []
@@ -153,11 +160,10 @@ class ScrubMixin:
         self, pool, pg, pairs, oid: str, deep: bool
     ) -> list[dict]:
         """One object's scrub checks (caller holds the object lock)."""
-        from ceph_tpu.native import crc32c
-
         out: list[dict] = []
         versions: dict[str, bytes | None] = {}
         payloads: dict[int, bytes] = {}
+        member_payloads: dict[str, bytes] = {}
         hinfos: dict[int, bytes | None] = {}
         crcs: dict[str, int] = {}
         present = 0
@@ -178,11 +184,30 @@ class ScrubMixin:
             present += 1
             versions[key] = (attrs or {}).get(VERSION_ATTR, b"")
             if deep:
-                crcs[key] = crc32c(payload)
                 payloads[s] = payload
+                member_payloads[key] = payload
                 hinfos[s] = (attrs or {}).get(HINFO_ATTR)
         if present == 0:
             return out  # deleted everywhere between listing and scrub
+        parity_bad = None
+        if deep and member_payloads:
+            if pool.is_erasure():
+                # EC: shard ids are distinct per member, so per-shard
+                # verification (batched when the verifier is attached)
+                # covers every member
+                shard_crcs, parity_bad = await self._verify_payloads(
+                    pool, payloads)
+                for s, o in pairs:
+                    if s in shard_crcs:
+                        crcs[f"{s}@osd.{o}"] = shard_crcs[s]
+            else:
+                # replicated: every member shares shard NO_SHARD — crc
+                # each member's copy individually
+                from ceph_tpu.native import crc32c as _crc32c
+
+                crcs = {
+                    k: _crc32c(p) for k, p in member_payloads.items()
+                }
         have = {k: v for k, v in versions.items() if v is not None}
         if len(have) != len(pairs) or len(set(have.values())) > 1:
             out.append({
@@ -225,29 +250,68 @@ class ScrubMixin:
                     ),
                 })
         if pool.is_erasure() and hinfo_raw is None and payloads:
-            ec = self._ec_for(pool)
-            sinfo = self._sinfo(ec)
-            k = ec.get_data_chunk_count()
-            import numpy as _np
+            if parity_bad is not None:
+                # the batched verifier already re-encoded the data
+                # shards on device and compared parity there
+                for s in sorted(parity_bad):
+                    out.append({
+                        "object": oid, "kind": "deep-parity",
+                        "member": f"{s}", "shard": s,
+                    })
+            else:
+                ec = self._ec_for(pool)
+                sinfo = self._sinfo(ec)
+                k = ec.get_data_chunk_count()
+                import numpy as _np
 
-            if all(s in payloads for s in range(k)) and len(payloads[0]):
-                chunks = {
-                    s: _np.frombuffer(payloads[s], _np.uint8)
-                    for s in range(k)
-                }
-                logical = ecutil.decode_concat(sinfo, ec, chunks)
-                expect = ecutil.encode(sinfo, ec, logical)
-                for s, payload in payloads.items():
-                    if s in expect and expect[s].tobytes() != payload:
-                        out.append({
-                            "object": oid, "kind": "deep-parity",
-                            "member": f"{s}", "shard": s,
-                        })
+                if all(s in payloads for s in range(k)) and len(payloads[0]):
+                    chunks = {
+                        s: _np.frombuffer(payloads[s], _np.uint8)
+                        for s in range(k)
+                    }
+                    logical = ecutil.decode_concat(sinfo, ec, chunks)
+                    expect = ecutil.encode(sinfo, ec, logical)
+                    for s, payload in payloads.items():
+                        if s in expect and expect[s].tobytes() != payload:
+                            out.append({
+                                "object": oid, "kind": "deep-parity",
+                                "member": f"{s}", "shard": s,
+                            })
         if not pool.is_erasure() and len(set(crcs.values())) > 1:
             out.append({
                 "object": oid, "kind": "deep-replica-crc", "crcs": crcs,
             })
         return out
+
+    async def _verify_payloads(
+        self, pool, payloads
+    ) -> tuple[dict[int, int], frozenset[int] | None]:
+        """Per-shard crc32c (+ parity re-encode check for eligible EC
+        objects) of one object's shard payloads.
+
+        EC payloads go through the process-wide ScrubVerifier
+        (parallel/scrub_batcher.py): concurrent scrub chunks — across
+        objects and PGs — coalesce into fixed-shape batched device
+        launches, bit-identical to the host loop.  Anything the
+        verifier declines (or any failure) answers from the host path,
+        so scrub behavior never depends on the batching layer.
+
+        Returns ``(shard -> crc32c, parity_bad)`` where ``parity_bad``
+        is the set of parity shards whose stored payload disagrees
+        with a re-encode of the data shards, or None when the parity
+        equations were not checked here."""
+        verifier = self.scrub_verifier if pool.is_erasure() else None
+        if verifier is not None:
+            try:
+                ec = self._ec_for(pool)
+            except Exception:
+                ec = None
+            check = await verifier.verify_object(ec, payloads)
+            if check is not None:
+                return check.crcs, check.parity_bad
+        from ceph_tpu.native import crc32c
+
+        return {s: crc32c(p) for s, p in payloads.items()}, None
 
     async def _repair_object(self, pool, pg, pairs, oid, incs) -> None:
         """`pg repair`: rebuild the authoritative copy of a damaged
@@ -357,12 +421,20 @@ class ScrubMixin:
                             due.append((last_deep, pid, ps, True))
                         elif now - last > interval:
                             due.append((last, pid, ps, False))
-                # drain everything due this tick, stalest first, so
-                # configured intervals hold however many PGs we lead
-                for _stamp, pid, ps, deep in sorted(due):
-                    if self.stopping:
-                        break
-                    await self.scrub_pg(pid, ps, deep=deep)
+                # drain everything due this tick CONCURRENTLY (stalest
+                # first for launch order): chunked admission through
+                # the op gate still paces each scan, and co-scheduled
+                # deep scrubs land their verification chunks in the
+                # shared scrub verifier's window — cross-PG batching
+                if due and not self.stopping:
+                    results = await asyncio.gather(*(
+                        self.scrub_pg(pid, ps, deep=deep)
+                        for _stamp, pid, ps, deep in sorted(due)
+                    ), return_exceptions=True)
+                    for r in results:
+                        if isinstance(r, BaseException):
+                            log.error("osd.%d: scheduled scrub failed: %r",
+                                      self.id, r)
             except asyncio.CancelledError:
                 raise
             except Exception:

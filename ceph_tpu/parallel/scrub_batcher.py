@@ -1,0 +1,461 @@
+"""ScrubVerifier: batched deep-scrub verification with fixed shapes.
+
+Deep scrub was the last per-object host loop in the EC data plane:
+`ScrubMixin._scrub_object` verified one object at a time with host
+`native.crc32c` and re-encoded parity per object (when it checked
+parity at all).  Scrub chunks are a stream of small independent
+checks — the same launch-bound regime the recovery-decode aggregator
+(`parallel/decode_batcher.py`) batches, per the repair-pipelining
+discipline (arxiv 1908.01527) and program-shaped XOR verification
+(arxiv 2108.02692).  This module is that layer for scrub:
+
+- concurrent in-flight scrub checks — across objects AND across PGs
+  (the verifier is process-wide, so co-scheduled PG scrubs sharing an
+  EC profile coalesce) — are collected during a short window;
+- every shard payload splits into the CLOSED power-of-two bucket
+  ladder (`ecutil.bucket_lanes`: pad to pow2 below the 64 KiB tile
+  cap, fixed tile_cap column lanes above it), and two kinds of fixed
+  -shape launches cover a whole group:
+
+  1. **batched crc32c**: a (B, W) stack of payload lanes is ONE
+     GF(2) bit-matmul (`ops.hashing.batched_crc32c_device`) — crc32c
+     is GF(2)-linear, so the device returns every lane's crc
+     contribution at once; host-side folding via native
+     ``crc32c_zeros`` / ``crc32c_unadvance`` recovers the exact
+     per-shard crc32c (bit-identical to the per-object host loop);
+  2. **RS re-encode compare**: (B, k, W) data-shard lanes re-encode
+     through the profile's bit-matrix and compare against the stored
+     (B, m, W) parity lanes on device (`ops.rs_kernels.
+     gf_encode_compare`), returning only a (B, m) mismatch mask —
+     parity never materializes off-device.  This catches silent
+     parity divergence that per-shard crc chains cannot see.
+
+- launch shapes come from the tiny fixed set (#width-buckets x
+  #batch-buckets [x #profiles for the compare kernel]), all compiled
+  by :meth:`prewarm` at daemon map-install — after warmup no XLA
+  compile can occur inside the scrub path, proven by the
+  ``cold_launches`` counter.
+
+Padding is exact in both kernels: encode of zero columns is zero
+columns, and crc of a zero-padded lane is the injective linear
+advance of the true crc — so batched results are bit-identical to the
+per-object host path (pinned by tests/test_scrub_batcher.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import threading
+
+import numpy as np
+
+from ceph_tpu.common.metrics import BucketCounters
+from ceph_tpu.parallel.decode_batcher import (
+    DEFAULT_MAX_BATCH,
+    DEFAULT_MIN_BUCKET,
+    DEFAULT_TILE_CAP,
+)
+
+#: ceiling on the lane dimension of one batched crc launch (crc lanes
+#: are single shard payloads, so many more fit per launch than the
+#: (k, W) re-encode items)
+DEFAULT_CRC_LANES = 32
+
+_SEED = 0xFFFFFFFF
+_BITS_CACHE_SIZE = 64
+
+
+class ObjectCheck:
+    """One object's batched verification result.
+
+    ``crcs`` maps shard id -> crc32c of the shard payload (seed -1,
+    reference ceph_crc32c semantics — bit-identical to the host
+    ``native.crc32c`` loop).  ``parity_bad`` is the set of shard ids
+    whose stored parity disagrees with a re-encode of the data shards,
+    or None when the parity check was not applicable (caller falls
+    back to the host re-encode path)."""
+
+    __slots__ = ("crcs", "parity_bad")
+
+    def __init__(self, crcs: dict[int, int],
+                 parity_bad: frozenset[int] | None):
+        self.crcs = crcs
+        self.parity_bad = parity_bad
+
+
+class ScrubVerifier:
+    """Coalesces concurrent deep-scrub checks into fixed-shape batched
+    crc32c + re-encode-compare launches.
+
+    Device-agnostic: both kernels are jitted XLA paths that run
+    bit-exactly on CPU and TPU; any dispatch failure answers the
+    affected lanes from the native host path, so behavior is always
+    identical to per-object verification.
+    """
+
+    def __init__(self, *, window_s: float = 0.002,
+                 max_batch: int = DEFAULT_MAX_BATCH,
+                 crc_lanes: int = DEFAULT_CRC_LANES,
+                 min_bucket: int = DEFAULT_MIN_BUCKET,
+                 tile_cap: int = DEFAULT_TILE_CAP):
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self.crc_lanes = crc_lanes
+        self.min_bucket = min_bucket
+        self.tile_cap = tile_cap
+        #: bucket width -> [(lane view, width, fut)] awaiting a crc
+        self._crc_pending: dict[int, list[tuple]] = {}
+        #: (matrix signature, bucket) -> [(C, data, parity, fut)]
+        self._enc_pending: dict[tuple, list[tuple]] = {}
+        self._flush_handle = None
+        self._bits_cache: collections.OrderedDict = collections.OrderedDict()
+        self._warm: set[tuple] = set()
+        self._warm_lock = threading.Lock()
+        self.stats = collections.Counter()
+        self.metrics = BucketCounters("scrub_verify_batch")
+
+    # -- gating --------------------------------------------------------
+
+    def active(self) -> bool:
+        return True
+
+    @staticmethod
+    def _parity_eligible(ec_impl, payloads) -> bool:
+        """The re-encode compare covers plain matrix codes with every
+        shard present at one length; anything else answers
+        ``parity_bad=None`` and the scrubber keeps its host path."""
+        from ceph_tpu.ec.plugins.matrix_base import MatrixErasureCode
+
+        if not isinstance(ec_impl, MatrixErasureCode):
+            return False
+        if ec_impl.rows_per_chunk != 1 or ec_impl.get_sub_chunk_count() != 1:
+            return False
+        n = ec_impl.get_chunk_count()
+        shards = {ec_impl.chunk_index(c) for c in range(n)}
+        if set(payloads) != shards:
+            return False
+        sizes = {len(p) for p in payloads.values()}
+        return len(sizes) == 1 and sizes.pop() > 0
+
+    # -- request side --------------------------------------------------
+
+    async def verify_object(
+        self, ec_impl, payloads: dict[int, np.ndarray]
+    ) -> ObjectCheck | None:
+        """Verify one object's shard payloads, coalescing the device
+        work with every other concurrent caller.  Returns None when the
+        whole check could not run batched (callers then take the
+        per-object host path verbatim)."""
+        from ceph_tpu.osd.ecutil import bucket_lanes
+
+        loop = asyncio.get_running_loop()
+        arrs = {
+            s: (np.frombuffer(bytes(p), dtype=np.uint8)
+                if isinstance(p, (bytes, bytearray, memoryview))
+                else np.ascontiguousarray(
+                    np.asarray(p, dtype=np.uint8).reshape(-1)))
+            for s, p in payloads.items()
+        }
+        crc_futs: dict[int, list[tuple[int, int, asyncio.Future]]] = {}
+        for s, arr in arrs.items():
+            lanes = bucket_lanes(
+                arr.nbytes, min_bucket=self.min_bucket,
+                tile_cap=self.tile_cap)
+            futs = []
+            for off, width, bucket in lanes:
+                fut = loop.create_future()
+                self._crc_pending.setdefault(bucket, []).append(
+                    (arr[off:off + width], width, fut))
+                futs.append((width, bucket, fut))
+            crc_futs[s] = futs
+
+        enc_futs: list[asyncio.Future] | None = None
+        k = m = 0
+        if ec_impl is not None and self._parity_eligible(ec_impl, arrs):
+            k = ec_impl.get_data_chunk_count()
+            m = ec_impl.get_chunk_count() - k
+            C = np.asarray(ec_impl.coding_matrix, dtype=np.uint8)
+            sig = C.shape[0].to_bytes(2, "little") + C.tobytes()
+            size = len(next(iter(arrs.values())))
+            enc_futs = []
+            for off, width, bucket in bucket_lanes(
+                    size, min_bucket=self.min_bucket,
+                    tile_cap=self.tile_cap):
+                fut = loop.create_future()
+                data = np.stack([
+                    arrs[ec_impl.chunk_index(c)][off:off + width]
+                    for c in range(k)
+                ])
+                parity = np.stack([
+                    arrs[ec_impl.chunk_index(k + j)][off:off + width]
+                    for j in range(m)
+                ])
+                self._enc_pending.setdefault((sig, bucket), []).append(
+                    (C, data, parity, fut))
+                enc_futs.append(fut)
+
+        self.stats["objects"] += 1
+        if self._flush_handle is None and (
+                self._crc_pending or self._enc_pending):
+            self._flush_handle = loop.call_later(self.window_s, self._flush)
+
+        from ceph_tpu.native import crc32c_zeros
+
+        from ceph_tpu.ops.hashing import crc32c_unadvance
+
+        try:
+            crcs: dict[int, int] = {}
+            for s, futs in crc_futs.items():
+                c = _SEED
+                pad = 0
+                for width, bucket, fut in futs:
+                    c = crc32c_zeros(bucket, c) ^ await fut
+                    pad = bucket - width
+                crcs[s] = crc32c_unadvance(c, pad)
+            parity_bad: frozenset[int] | None = None
+            if enc_futs is not None:
+                bad: set[int] = set()
+                for fut in enc_futs:
+                    mask = await fut
+                    bad.update(
+                        ec_impl.chunk_index(k + j)
+                        for j in range(m) if mask[j]
+                    )
+                parity_bad = frozenset(bad)
+            return ObjectCheck(crcs, parity_bad)
+        except Exception:
+            self.stats["fallbacks"] += 1
+            return None
+
+    # -- dispatch side -------------------------------------------------
+
+    def _flush(self) -> None:
+        """call_later callback: hand pending groups to worker threads —
+        JAX dispatch must not run on the event loop."""
+        self._flush_handle = None
+        crc_pending, self._crc_pending = self._crc_pending, {}
+        enc_pending, self._enc_pending = self._enc_pending, {}
+        loop = asyncio.get_running_loop()
+        for bucket, group in crc_pending.items():
+            loop.create_task(self._dispatch(
+                group, lambda g, w=bucket: self._run_crc_group(w, g),
+                lambda g, w=bucket: self._host_crc_group(w, g)))
+        for (_sig, bucket), group in enc_pending.items():
+            loop.create_task(self._dispatch(
+                group, lambda g, w=bucket: self._run_enc_group(w, g),
+                self._host_enc_group))
+
+    async def _dispatch(self, group, run, host_fallback) -> None:
+        try:
+            outs = await asyncio.to_thread(run, group)
+        except Exception:
+            self.stats["dispatch_fallbacks"] += 1
+            outs = await asyncio.to_thread(host_fallback, group)
+        for item, out in zip(group, outs):
+            fut = item[-1]
+            if not fut.done():
+                fut.set_result(out)
+
+    def _crc_mat(self, bucket: int):
+        import jax.numpy as jnp
+
+        from ceph_tpu.ops.compile_cache import ensure_persistent_cache
+        from ceph_tpu.ops.hashing import crc32c_matrix
+
+        key = ("crc", bucket)
+        hit = self._bits_cache.get(key)
+        if hit is None:
+            ensure_persistent_cache()
+            hit = jnp.asarray(crc32c_matrix(bucket))
+            self._bits_cache[key] = hit
+            if len(self._bits_cache) > _BITS_CACHE_SIZE:
+                self._bits_cache.popitem(last=False)
+        else:
+            self._bits_cache.move_to_end(key)
+        return hit
+
+    def _enc_bits(self, C: np.ndarray):
+        import jax.numpy as jnp
+
+        from ceph_tpu.ops.compile_cache import ensure_persistent_cache
+        from ceph_tpu.ops.gf256 import gf_matrix_to_bitmatrix
+
+        key = ("enc", C.shape[0].to_bytes(2, "little") + C.tobytes())
+        hit = self._bits_cache.get(key)
+        if hit is None:
+            ensure_persistent_cache()
+            hit = jnp.asarray(gf_matrix_to_bitmatrix(C))
+            self._bits_cache[key] = hit
+            if len(self._bits_cache) > _BITS_CACHE_SIZE:
+                self._bits_cache.popitem(last=False)
+        else:
+            self._bits_cache.move_to_end(key)
+        return hit
+
+    def _note_launch(self, shape_key, kind, w, b, b_real,
+                     real_bytes, padded_bytes) -> None:
+        if shape_key not in self._warm:
+            self._warm.add(shape_key)
+            self.stats["cold_launches"] += 1
+            self.metrics.inc("cold_launches", w=w, b=b, k=kind)
+        self.stats["launches"] += 1
+        self.stats[f"{kind}_launches"] += 1
+        self.stats["batched_lanes"] += b_real
+        self.metrics.inc("launches", w=w, b=b, k=kind)
+        self.metrics.inc("occupied_lanes", w=w, b=b, k=kind, by=b_real)
+        self.metrics.inc("padded_lanes", w=w, b=b, k=kind, by=b)
+        self.metrics.inc("occupied_bytes", w=w, b=b, k=kind, by=real_bytes)
+        self.metrics.inc("padded_bytes", w=w, b=b, k=kind, by=padded_bytes)
+
+    def _run_crc_group(self, w: int, group: list[tuple]) -> list[int]:
+        """Worker-thread body: batched crc32c launches over one bucket;
+        returns each lane's raw device crc (L_W of the padded lane)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ceph_tpu.ops.hashing import batched_crc32c_device
+
+        mat = self._crc_mat(w)
+        outs: list[int] = [0] * len(group)
+        for at in range(0, len(group), self.crc_lanes):
+            chunk = group[at:at + self.crc_lanes]
+            b_real = len(chunk)
+            # two batch shapes only (1 and max): one compiled program
+            # per bucket regardless of how many lanes coalesced
+            b = 1 if b_real == 1 else self.crc_lanes
+            batch = np.zeros((b, w), np.uint8)
+            for j, (arr, width, _f) in enumerate(chunk):
+                batch[j, :width] = arr
+            self._note_launch(("crc", b, w), "crc", w, b, b_real,
+                              sum(width for _, width, _ in chunk), b * w)
+            out = np.asarray(jax.block_until_ready(
+                batched_crc32c_device(mat, jnp.asarray(batch))))
+            for j in range(b_real):
+                outs[at + j] = int(out[j])
+        return outs
+
+    @staticmethod
+    def _host_crc_group(w: int, group: list[tuple]) -> list[int]:
+        from ceph_tpu.native import crc32c, crc32c_zeros
+
+        # L_W of the padded lane == advance of the seed-0 crc through
+        # the pad, so the host answer folds identically downstream
+        return [
+            crc32c_zeros(w - width, crc32c(arr, 0))
+            for arr, width, _f in group
+        ]
+
+    def _run_enc_group(self, w: int, group: list[tuple]) -> list[np.ndarray]:
+        """Worker-thread body: batched re-encode-compare launches for
+        one (profile, bucket); returns each item's (m,) mismatch mask."""
+        import jax
+        import jax.numpy as jnp
+
+        from ceph_tpu.ops.rs_kernels import gf_encode_compare
+
+        C = group[0][0]
+        bits = self._enc_bits(C)
+        m, k = C.shape
+        outs: list[np.ndarray] = [None] * len(group)
+        for at in range(0, len(group), self.max_batch):
+            chunk = group[at:at + self.max_batch]
+            b_real = len(chunk)
+            b = 1 if b_real == 1 else self.max_batch
+            data = np.zeros((b, k, w), np.uint8)
+            parity = np.zeros((b, m, w), np.uint8)
+            for j, (_C, d, p, _f) in enumerate(chunk):
+                data[j, :, :d.shape[1]] = d
+                parity[j, :, :p.shape[1]] = p
+            self._note_launch((bits.shape, b, k, w), "enc", w, b, b_real,
+                              sum((k + m) * d.shape[1]
+                                  for _C, d, _p, _f in chunk),
+                              b * (k + m) * w)
+            out = np.asarray(jax.block_until_ready(gf_encode_compare(
+                bits, jnp.asarray(data), jnp.asarray(parity))))
+            for j in range(b_real):
+                outs[at + j] = out[j]
+        return outs
+
+    @staticmethod
+    def _host_enc_group(group: list[tuple]) -> list[np.ndarray]:
+        from ceph_tpu.ops.gf256 import gf_matmul
+
+        return [
+            np.any(gf_matmul(C, d) != p, axis=-1)
+            for C, d, p, _f in group
+        ]
+
+    # -- warmup --------------------------------------------------------
+
+    def prewarm(self, ec_impl=None, widths=None, *, batches=None) -> int:
+        """Compile every launch shape this verifier can dispatch: the
+        crc kernel over the full bucket ladder, plus the re-encode
+        compare for ``ec_impl``'s code when given.  Blocking — call
+        from daemon warmup (map install), never the scrub path.
+        Returns the number of programs compiled."""
+        import jax
+        import jax.numpy as jnp
+
+        from ceph_tpu.ops.compile_cache import ensure_persistent_cache
+        from ceph_tpu.ops.hashing import batched_crc32c_device
+        from ceph_tpu.ops.rs_kernels import gf_encode_compare
+
+        ensure_persistent_cache()
+        buckets = set()
+        w = self.min_bucket
+        while w <= self.tile_cap:
+            buckets.add(w)
+            w <<= 1
+        for x in widths or ():
+            x = max(min(x, self.tile_cap), self.min_bucket, 1)
+            buckets.add(1 << (x - 1).bit_length())
+        n = 0
+        with self._warm_lock:
+            for w in sorted(buckets):
+                mat = self._crc_mat(w)
+                for b in (1, self.crc_lanes):
+                    key = ("crc", b, w)
+                    if key in self._warm:
+                        continue
+                    jax.block_until_ready(batched_crc32c_device(
+                        mat, jnp.zeros((b, w), np.uint8)))
+                    self._warm.add(key)
+                    n += 1
+            if ec_impl is not None and getattr(
+                    ec_impl, "rows_per_chunk", 1) == 1 and hasattr(
+                    ec_impl, "coding_matrix"):
+                C = np.asarray(ec_impl.coding_matrix, dtype=np.uint8)
+                m, k = C.shape
+                bits = self._enc_bits(C)
+                for w in sorted(buckets):
+                    for b in (batches or (1, self.max_batch)):
+                        key = (bits.shape, b, k, w)
+                        if key in self._warm:
+                            continue
+                        jax.block_until_ready(gf_encode_compare(
+                            bits, jnp.zeros((b, k, w), np.uint8),
+                            jnp.zeros((b, m, w), np.uint8)))
+                        self._warm.add(key)
+                        n += 1
+        self.stats["prewarmed_shapes"] += n
+        self.metrics.inc("prewarmed_shapes", by=n)
+        return n
+
+
+_shared: ScrubVerifier | None = None
+
+
+def shared() -> ScrubVerifier:
+    """Process-wide verifier (one compiled-shape set per process, so
+    co-hosted daemons' scrubs coalesce across PGs)."""
+    global _shared
+    if _shared is None:
+        _shared = ScrubVerifier()
+    return _shared
+
+
+def reset_shared() -> None:
+    """Test hook: drop the process-wide verifier."""
+    global _shared
+    _shared = None

@@ -303,8 +303,13 @@ class BlockStore(ObjectStore):
             self._fd = None
 
     def fsck(self) -> list[dict]:
-        """Verify every blob's checksum at rest (BlueStore fsck role)."""
+        """Verify every blob's checksum at rest (BlueStore fsck role),
+        plus the co-located KV's own metadata (superblock generations +
+        WAL frames) when BlueFS hosts it."""
         bad: list[dict] = []
+        db_fsck = getattr(self.db, "fsck", None)
+        if callable(db_fsck):
+            bad.extend(db_fsck())
         it = self.db.get_iterator("O").seek_to_first()
         while it.valid():
             meta = json.loads(it.value())

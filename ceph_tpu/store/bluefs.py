@@ -200,6 +200,63 @@ class BlueFSLite(MemDB):
         self._fd = None
         self._alloc = None
 
+    # -- fsck ----------------------------------------------------------
+
+    def fsck(self) -> list[dict]:
+        """Verify BlueFS metadata at rest: BOTH superblock generation
+        slots and every applied WAL frame's crc.
+
+        Mount TOLERATES a corrupt stale superblock (it falls back to
+        the other generation) and a torn WAL tail (replay stops) —
+        correct for availability, but silent rot in the fallback slot
+        means the NEXT crash has no good generation to land on.  fsck
+        therefore REPORTS what mount tolerates (the BlueStore
+        fsck-vs-mount split)."""
+        out: list[dict] = []
+        if self._fd is None:
+            return out
+        for slot in SUPER_UNITS:
+            raw = os.pread(self._fd, MIN_ALLOC, slot * MIN_ALLOC)
+            if not raw.rstrip(b"\0"):
+                continue  # never-written slot (young device), not rot
+            ok = len(raw) >= 8
+            if ok:
+                crc, ln = struct.unpack_from("<II", raw)
+                body = raw[8:8 + ln]
+                ok = len(body) == ln and crc32c(body) == crc
+                if ok:
+                    try:
+                        json.loads(body)
+                    except ValueError:
+                        ok = False
+            if not ok:
+                out.append({"kind": "bluefs-superblock", "slot": slot})
+        # WAL frames: every record up to the applied position must
+        # still frame and crc — rot under an already-applied record
+        # would silently truncate replay after the next crash
+        pos = 0
+        seq = self.wal_seq
+        total = self._chain_len(self.wal_extents)
+        while pos < self._wal_pos and pos + _REC_HDR.size <= total:
+            hdr = self._chain_read(self.wal_extents, pos, _REC_HDR.size)
+            magic, ln, crc, rseq = _REC_HDR.unpack(hdr)
+            body_ok = (
+                magic == _MAGIC and rseq == seq
+                and pos + _REC_HDR.size + ln <= total
+            )
+            if body_ok:
+                body = self._chain_read(
+                    self.wal_extents, pos + _REC_HDR.size, ln)
+                body_ok = crc32c(body) == crc
+            if not body_ok:
+                out.append({
+                    "kind": "bluefs-wal-frame", "pos": pos, "seq": seq,
+                })
+                break  # framing is lost from here on
+            pos += _REC_HDR.size + ln
+            seq += 1
+        return out
+
     # -- writes --------------------------------------------------------
 
     def submit(self, batch: WriteBatch, sync: bool = True) -> None:

@@ -43,6 +43,42 @@ def test_all_cited_artifacts_exist_and_parse():
     assert not broken, f"unparseable artifacts: {broken}"
 
 
+def _artifact_lines(name: str) -> list[dict]:
+    with open(os.path.join(REPO, name)) as f:
+        body = f.read().strip()
+    try:
+        doc = json.loads(body)
+        return doc if isinstance(doc, list) else [doc]
+    except ValueError:
+        return [json.loads(line) for line in body.splitlines()
+                if line.strip()]
+
+
+def test_scrub_verify_citation_is_backed_by_artifact():
+    """The README's scrub_verify claim (batched deep-scrub verification,
+    same honesty contract as r06's decode_batch guard): the sentence
+    citing the config must name a committed artifact that actually
+    contains a scrub-verify metric line."""
+    with open(os.path.join(REPO, "README.md")) as f:
+        text = f.read()
+    assert "scrub_verify" in text, (
+        "README must document the scrub_verify bench config")
+    cited = [
+        name for name in _readme_artifacts()
+        if re.search(
+            r"scrub_verify[^.]*`" + re.escape(name) + r"`",
+            text, re.DOTALL)
+    ]
+    assert cited, "scrub_verify claim cites no artifact"
+    for name in cited:
+        path = os.path.join(REPO, name)
+        assert os.path.exists(path), f"cited artifact {name} not committed"
+        assert any(
+            "scrub" in str(line.get("metric", ""))
+            for line in _artifact_lines(name)
+        ), f"{name} carries no scrub-verify metric"
+
+
 def test_committed_artifacts_parse():
     """Every artifact in the tree is (line-delimited or plain) JSON."""
     for name in sorted(os.listdir(REPO)):

@@ -55,10 +55,14 @@ def test_cache_populates_and_speeds_cold_start(tmp_path):
     t_cold = run()
     entries = os.listdir(tmp_path)
     assert entries, "persistent cache dir stayed empty"
-    t_warm = run()
-    # the XLA compile is served from disk in process 2; tracing still
-    # runs, so assert a solid improvement rather than a magic ratio
-    assert t_warm < t_cold, (t_cold, t_warm)
+    # the XLA compile is served from disk in the warm processes;
+    # tracing still runs, so the floor is not ~0 — but a cache that
+    # works must beat a REAL margin, not just `<` (which passes on
+    # noise alone).  Measured on the CPU CI host: cold ~5.5 s, warm
+    # ~2.5-2.9 s (0.46-0.53x; BENCH_ALL_r07 notes) — best-of-two warm
+    # runs against 0.7x keeps honest headroom for scheduler jitter.
+    t_warm = min(run(), run())
+    assert t_warm < 0.7 * t_cold, (t_cold, t_warm)
 
 
 def test_opt_out(tmp_path):
