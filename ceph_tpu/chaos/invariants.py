@@ -1,0 +1,229 @@
+"""Durability invariant checkers over a chaos run.
+
+Pure functions over recorded state — each returns a list of violation
+dicts (empty = invariant holds), so they are unit-testable on
+hand-built violating histories without booting a cluster (the
+``ceph_test_rados`` history-check role, src/test/osd/RadosModel.h
+``update_object_version``/``check_ref``):
+
+- :func:`check_history` — read-your-writes over the live run: every
+  read returns a whole payload of a version between the newest write
+  acked before the read began (no stale/lost reads) and the newest
+  write started before it ended (no time travel);
+- :func:`check_final_reads` — post-thrash: every head read returns the
+  last acked version (or a later, indeterminate-fate write), every
+  snap read returns exactly the version frozen at snap creation;
+- :func:`check_converged` — the cluster reports every PG active+clean;
+- :func:`check_quorum` — every monitor settled on the SAME leader and
+  map epoch (split-brain detector — the seed-66 bug class);
+- :func:`check_scrub_reports` — zero deep-scrub inconsistencies after
+  the thrash;
+- :func:`check_cold_launches` — the decode/scrub batchers minted ZERO
+  cold XLA launches during chaos (recovery under failure must run on
+  prewarmed shapes; a compile in the I/O path is a perf regression
+  the thrash would otherwise hide).
+"""
+
+from __future__ import annotations
+
+import errno
+
+
+def _write_bounds(writes: list[dict]) -> dict:
+    """Per (pool, oid): sorted write records."""
+    by_obj: dict[tuple, list[dict]] = {}
+    for w in writes:
+        by_obj.setdefault((w["pool"], w["oid"]), []).append(w)
+    for recs in by_obj.values():
+        recs.sort(key=lambda w: w["start"])
+    return by_obj
+
+
+def check_history(history) -> list[dict]:
+    """Read-your-writes / no-lost-ack over the recorded live run."""
+    out: list[dict] = []
+    by_obj = _write_bounds(history.writes)
+    for r in history.reads:
+        key = (r["pool"], r["oid"])
+        writes = by_obj.get(key, [])
+        acked_before = [
+            w["version"] for w in writes
+            if w["ack"] is not None and w["ack"] < r["start"]
+        ]
+        started_before = [
+            w["version"] for w in writes if w["start"] < r["end"]
+        ]
+        lo = max(acked_before, default=0)
+        hi = max(started_before, default=0)
+        if r.get("error") is not None:
+            # availability errors are not durability violations —
+            # EXCEPT ENOENT: an object with an acked write must exist
+            if lo >= 1 and f"errno={errno.ENOENT}" in r["error"]:
+                out.append({
+                    "invariant": "acked_write_lost", **r,
+                    "detail": f"ENOENT but v{lo} was acked before read",
+                })
+            continue
+        if r["version"] is None or not r.get("valid"):
+            out.append({
+                "invariant": "corrupt_read", **r,
+                "detail": "payload is not a whole write of any version",
+            })
+        elif r["version"] < lo:
+            out.append({
+                "invariant": "stale_read", **r,
+                "detail": f"returned v{r['version']} < acked v{lo}",
+            })
+        elif r["version"] > hi:
+            out.append({
+                "invariant": "phantom_read", **r,
+                "detail": f"returned v{r['version']} > newest started v{hi}",
+            })
+    return out
+
+
+def check_final_reads(history, final_reads: list[dict]) -> list[dict]:
+    """Post-thrash verification: last acked version (or newer
+    indeterminate write) on every head; exact frozen version on every
+    snap read."""
+    out: list[dict] = []
+    by_obj = _write_bounds(history.writes)
+    for r in final_reads:
+        key = (r["pool"], r["oid"])
+        writes = by_obj.get(key, [])
+        lo = max((w["version"] for w in writes if w["ack"] is not None),
+                 default=0)
+        hi = max((w["version"] for w in writes), default=0)
+        if r.get("kind") == "snap":
+            if r.get("error") is not None or r.get("version") is None:
+                out.append({
+                    "invariant": "snap_lost", **r,
+                    "detail": "snap read failed or returned garbage",
+                })
+            elif r["version"] != r["expect_version"]:
+                out.append({
+                    "invariant": "snap_moved", **r,
+                    "detail": (
+                        f"snap {r['snapid']} froze v{r['expect_version']}"
+                        f" but reads v{r['version']}"
+                    ),
+                })
+            continue
+        if r.get("error") is not None:
+            if lo >= 1:
+                out.append({
+                    "invariant": "acked_write_lost", **r,
+                    "detail": f"final read failed but v{lo} was acked",
+                })
+            continue
+        if r.get("version") is None or not r.get("valid"):
+            out.append({
+                "invariant": "corrupt_read", **r,
+                "detail": "final payload is not a whole write",
+            })
+        elif r["version"] < lo:
+            out.append({
+                "invariant": "acked_write_lost", **r,
+                "detail": f"final v{r['version']} < last acked v{lo}",
+            })
+        elif r["version"] > hi:
+            out.append({
+                "invariant": "phantom_read", **r,
+                "detail": f"final v{r['version']} > newest started v{hi}",
+            })
+    return out
+
+
+def check_converged(status: dict) -> list[dict]:
+    """The mon's aggregated pg summary must be all active+clean."""
+    pgs = (status or {}).get("pgs", {})
+    by_state = pgs.get("by_state", {})
+    ok = (
+        pgs.get("num_pgs", 0) > 0
+        and pgs.get("num_reported", 0) >= pgs.get("num_pgs", 0)
+        and set(by_state) == {"active+clean"}
+    )
+    if ok:
+        return []
+    return [{
+        "invariant": "not_converged",
+        "detail": f"pg summary {pgs!r} not all active+clean",
+    }]
+
+
+def check_quorum(mon_views: list[dict]) -> list[dict]:
+    """``mon_views``: one snapshot per monitor — {"rank", "stable",
+    "leader", "epoch"}.  All must be stable on ONE leader who claims
+    leadership, at ONE osdmap epoch."""
+    out: list[dict] = []
+    unstable = [v["rank"] for v in mon_views if not v.get("stable")]
+    if unstable:
+        out.append({
+            "invariant": "quorum_unstable",
+            "detail": f"mons {unstable} not settled",
+        })
+        return out
+    leaders = {v.get("leader") for v in mon_views}
+    if len(leaders) != 1 or None in leaders:
+        out.append({
+            "invariant": "split_brain",
+            "detail": "disagreeing leader views "
+            + str({v['rank']: v.get('leader') for v in mon_views}),
+        })
+    else:
+        leader = leaders.pop()
+        if not any(
+            v["rank"] == leader and v.get("leader") == leader
+            for v in mon_views
+        ):
+            out.append({
+                "invariant": "leaderless_quorum",
+                "detail": f"agreed leader mon.{leader} view missing or "
+                "doesn't claim leadership",
+            })
+    epochs = {v.get("epoch") for v in mon_views}
+    if len(epochs) != 1:
+        out.append({
+            "invariant": "map_epoch_skew",
+            "detail": "osdmap epochs "
+            + str({v['rank']: v.get('epoch') for v in mon_views}),
+        })
+    return out
+
+
+def check_scrub_reports(reports: list[dict]) -> list[dict]:
+    """Post-thrash deep scrub must find nothing."""
+    out: list[dict] = []
+    for rep in reports:
+        if rep.get("error"):
+            out.append({
+                "invariant": "scrub_failed", "pg": rep.get("pg"),
+                "detail": str(rep["error"]),
+            })
+        elif rep.get("inconsistencies"):
+            out.append({
+                "invariant": "scrub_inconsistency", "pg": rep.get("pg"),
+                "detail": rep["inconsistencies"],
+            })
+    return out
+
+
+def check_cold_launches(before: dict, after: dict) -> list[dict]:
+    """``before``/``after``: {batcher_name: cold_launches count}
+    snapshots around the run; any growth means chaos minted an XLA
+    compile inside the I/O path."""
+    out: list[dict] = []
+    for name, b in before.items():
+        a = after.get(name, b)
+        if a > b:
+            out.append({
+                "invariant": "cold_launch", "batcher": name,
+                "detail": f"cold_launches grew {b} -> {a} during chaos",
+            })
+    return out
+
+
+#: checker registry: name -> callable, for reporting
+ALL_INVARIANTS = (
+    "history", "final_reads", "converged", "quorum", "scrub", "cold_launches",
+)

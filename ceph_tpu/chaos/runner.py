@@ -1,0 +1,530 @@
+"""Chaos runner: replay a generated schedule against a live cluster.
+
+The teuthology-thrasher role (qa/tasks/thrasher.py do_thrash loop),
+inverted for determinism: the schedule is generated up front
+(ceph_tpu/chaos/schedule.py), the runner boots a mini-cluster, starts
+the recording workload, applies each event at its virtual time, then
+settles the cluster and judges every durability invariant
+(ceph_tpu/chaos/invariants.py):
+
+1. workload history clean (no lost/stale/corrupt read),
+2. final + snap reads return the acked content,
+3. cluster converges back to active+clean within the bound,
+4. every monitor agrees on one leader and one map epoch,
+5. post-thrash deep scrub over every PG reports zero inconsistencies,
+6. the decode/scrub batchers minted ZERO cold XLA launches — chaos
+   must exercise the prewarmed recovery path, not compile mid-flight.
+
+Every applied event opens a ``chaos`` tracer span and counts into the
+``chaos`` perf collection (dumped by the daemons' ``dump_chaos``
+admin-socket command).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from ceph_tpu.chaos import chaos_counters, chaos_tracer
+from ceph_tpu.chaos.netem import Netem
+from ceph_tpu.chaos.schedule import generate_schedule, trace_hash
+from ceph_tpu.chaos.workload import Workload
+from ceph_tpu.chaos import invariants as inv
+
+log = logging.getLogger("ceph_tpu.chaos")
+
+
+#: built-in scenario configs (the qa/suites role).  Each is a plain
+#: dict so CLI users can ship their own as JSON.
+SCENARIOS: dict[str, dict] = {
+    # the classic OSDThrasher: kill/revive, out/in, reweight, repair
+    # and balancer runs against replicated + EC pools
+    "osd_thrash": {
+        "name": "osd_thrash",
+        "n_osds": 5, "n_mons": 1,
+        "duration": 3.0, "n_events": 9,
+        "mix": {"osd_kill": 3.0, "osd_out": 2.0, "reweight": 1.0,
+                "scrub": 0.5, "repair": 0.5, "balance": 0.5},
+        "pools": [
+            {"name": "rep", "type": "replicated", "pg_num": 4,
+             "size": 2, "snaps": True},
+            {"name": "ec", "type": "erasure", "pg_num": 2,
+             "k": 2, "m": 1},
+        ],
+        "workload": {"objects": 3, "rounds": 3, "object_size": 8192},
+    },
+    # deterministic network faults: partitions, one-way drops, delay,
+    # bounded reordering — the netem shim's beat
+    "netem_storm": {
+        "name": "netem_storm",
+        "n_osds": 4, "n_mons": 1,
+        "duration": 3.0, "n_events": 10,
+        "mix": {"partition": 2.0, "drop_oneway": 2.0, "delay": 2.0,
+                "reorder": 2.0, "netem_clear": 0.5},
+        "max_partitions": 1,
+        "pools": [
+            {"name": "rep", "type": "replicated", "pg_num": 4,
+             "size": 2, "snaps": True},
+            {"name": "ec", "type": "erasure", "pg_num": 2,
+             "k": 2, "m": 1},
+        ],
+        "workload": {"objects": 3, "rounds": 3, "object_size": 8192},
+    },
+    # monitor-plane chaos: restarts + osd kills over a 3-mon quorum,
+    # plus pg_num splitting mid-storm
+    "quorum_thrash": {
+        "name": "quorum_thrash",
+        "n_osds": 4, "n_mons": 3,
+        "duration": 3.0, "n_events": 8,
+        "mix": {"mon_restart": 2.0, "osd_kill": 1.0, "pg_split": 1.0,
+                "scrub": 0.5, "balance": 0.5},
+        "max_splits": 1,
+        "pools": [
+            {"name": "rep", "type": "replicated", "pg_num": 2,
+             "size": 2, "snaps": True},
+            {"name": "ec", "type": "erasure", "pg_num": 2,
+             "k": 2, "m": 1},
+        ],
+        "workload": {"objects": 3, "rounds": 3, "object_size": 8192},
+    },
+}
+
+
+def _cold_launch_snapshot() -> dict:
+    """cold_launches on the process-wide batchers (delta-checked:
+    the collections are process-global and other work may have warmed
+    them before this run)."""
+    from ceph_tpu.parallel import decode_batcher, scrub_batcher
+
+    return {
+        "decode_batch": int(
+            decode_batcher.shared().stats.get("cold_launches", 0)),
+        "scrub_verify_batch": int(
+            scrub_batcher.shared().stats.get("cold_launches", 0)),
+    }
+
+
+class ChaosCluster:
+    """Mini-cluster under chaos: mons + OSDs + recording client, every
+    messenger wearing one shared netem shim."""
+
+    def __init__(self, scenario: dict, time_scale: float = 1.0):
+        self.scenario = scenario
+        self.time_scale = time_scale
+        self.netem = Netem()
+        self.mons: list = []
+        self.monmap: list[tuple[str, int]] = []
+        self.osds: list = []
+        self.client = None
+        self._crush_template = None
+        self._heal_tasks: set = set()
+        self.event_errors: list[dict] = []
+        self.events_applied = 0
+
+    # -- lifecycle -----------------------------------------------------
+
+    async def start(self) -> None:
+        from ceph_tpu.client import RadosClient
+        from ceph_tpu.crush import builder as B
+        from ceph_tpu.crush.types import CrushMap
+        from ceph_tpu.mon import Monitor
+        from ceph_tpu.osd.daemon import OSDDaemon
+
+        sc = self.scenario
+        crush = CrushMap()
+        B.build_hierarchy(crush, osds_per_host=1, n_hosts=sc["n_osds"])
+        self._crush_template = crush
+        n_mons = sc.get("n_mons", 1)
+        self.mons = [
+            Monitor(crush=crush.copy(), rank=r, n_mons=n_mons)
+            for r in range(n_mons)
+        ]
+        for m in self.mons:
+            self.netem.attach(m.messenger)
+            await m.start()
+        self.monmap = [m.addr for m in self.mons]
+        if n_mons > 1:
+            for m in self.mons:
+                await m.open_quorum(list(self.monmap))
+            for m in self.mons:
+                await m.wait_stable()
+        self.osds = []
+        for i in range(sc["n_osds"]):
+            osd = OSDDaemon(i, list(self.monmap))
+            self.netem.attach(osd.messenger)
+            await osd.start()
+            self.osds.append(osd)
+        self.client = RadosClient(client_id=8080)
+        # the workload's acks are the oracle: the client stays outside
+        # the blast radius (the thrasher never cuts the observer)
+        await self.client.connect_multi(list(self.monmap))
+        for pool in sc.get("pools", []):
+            if pool.get("type") == "erasure":
+                prof = f"chaos-{pool['name']}"
+                await self.client.ec_profile_set(prof, {
+                    "plugin": "jax", "k": str(pool.get("k", 2)),
+                    "m": str(pool.get("m", 1)),
+                })
+                await self.client.pool_create(
+                    pool["name"], pg_num=pool.get("pg_num", 2),
+                    pool_type="erasure", erasure_code_profile=prof)
+            else:
+                await self.client.pool_create(
+                    pool["name"], pg_num=pool.get("pg_num", 4),
+                    size=pool.get("size", 2))
+        await self._await_warmup()
+
+    async def _await_warmup(self, timeout: float = 30.0) -> None:
+        """Wait for every daemon's EC-profile warmup to finish: the
+        cold_launches==0 invariant judges the steady state, and a kill
+        landing mid-compile would blame chaos for a boot-time cold
+        launch."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(not osd._warm_tasks for osd in self.osds if osd):
+                return
+            await asyncio.sleep(0.05)
+
+    async def stop(self) -> None:
+        for t in list(self._heal_tasks):
+            t.cancel()
+        if self.client is not None:
+            await self.client.shutdown()
+        for osd in self.osds:
+            if osd is not None:
+                await osd.stop()
+        for m in self.mons:
+            if m is not None:
+                await m.stop()
+
+    # -- event application ---------------------------------------------
+
+    async def apply_event(self, ev) -> None:
+        counters = chaos_counters()
+        counters.inc("events", kind=ev.kind)
+        with chaos_tracer().span(
+            "chaos_event", kind=ev.kind, t=ev.t,
+            **{k: str(v) for k, v in ev.args.items()},
+        ) as sp:
+            try:
+                await self._apply(ev)
+                self.events_applied += 1
+            except Exception as e:
+                # a refused event (no primary mid-thrash, EAGAIN storm)
+                # is part of chaos, not a failure of the harness — but
+                # it is recorded and counted
+                sp.tag(error=type(e).__name__)
+                counters.inc("event_errors", kind=ev.kind)
+                self.event_errors.append({
+                    "kind": ev.kind, "args": dict(ev.args),
+                    "error": f"{type(e).__name__}: {e}",
+                })
+
+    async def _apply(self, ev) -> None:
+        a = ev.args
+        kind = ev.kind
+        if kind == "osd_kill":
+            osd = self.osds[a["osd"]]
+            if osd is not None:
+                # keep the store: revive is a daemon restart (the
+                # reference thrasher's revive keeps the disk too).
+                # Wiping here would let TWO sequential kills destroy
+                # more shards than m — the second kill lands before the
+                # first revive's rebuild finishes, and that is operator
+                # data loss, not a cluster bug
+                self._stashed_stores = getattr(self, "_stashed_stores", {})
+                self._stashed_stores[a["osd"]] = osd.store
+                await osd.stop()
+                self.osds[a["osd"]] = None
+        elif kind == "osd_revive":
+            if self.osds[a["osd"]] is None:
+                from ceph_tpu.osd.daemon import OSDDaemon
+
+                store = getattr(self, "_stashed_stores", {}).pop(
+                    a["osd"], None)
+                osd = OSDDaemon(a["osd"], list(self.monmap), store=store)
+                self.netem.attach(osd.messenger)
+                await osd.start()
+                self.osds[a["osd"]] = osd
+                # missed-write catch-up recovery (log replay / decode
+                # toward the restarted member) runs from the new map;
+                # data-LOSS rebuilds are exercised by osd_out remaps
+                # (backfill + EC decode onto fresh members)
+        elif kind == "osd_out":
+            await self._command({"prefix": "osd out", "id": str(a["osd"])})
+        elif kind == "osd_in":
+            await self._command({"prefix": "osd in", "id": str(a["osd"])})
+        elif kind == "reweight":
+            await self._command({
+                "prefix": "osd crush reweight",
+                "name": f"osd.{a['osd']}", "weight": str(a["weight"]),
+            })
+        elif kind == "mon_restart":
+            await self._mon_restart(a["rank"])
+        elif kind == "pg_split":
+            om = self.client.osdmap
+            pid = om.lookup_pg_pool_name(a["pool"])
+            if pid >= 0:
+                cur = om.pools[pid].pg_num
+                await self._command({
+                    "prefix": "osd pool set", "pool": a["pool"],
+                    "var": "pg_num", "val": str(min(cur * 2, 16)),
+                })
+        elif kind in ("scrub", "deep_scrub", "repair"):
+            om = self.client.osdmap
+            pid = om.lookup_pg_pool_name(a["pool"])
+            if pid >= 0:
+                ps = int(ev.t * 1000) % max(1, om.pools[pid].pg_num)
+                prefix = {
+                    "scrub": "pg scrub", "deep_scrub": "pg deep-scrub",
+                    "repair": "pg repair",
+                }[kind]
+                await self._command({
+                    "prefix": prefix, "pgid": f"{pid}.{ps}"})
+        elif kind == "balance":
+            await self._command({
+                "prefix": "osd balance",
+                "max_swaps": str(a.get("max_swaps", 8)),
+            })
+        elif kind == "partition":
+            self.netem.partition(tuple(a["a"]), tuple(a["b"]))
+            self._schedule_heal(
+                a.get("ttl"),
+                lambda: self.netem.heal_partition(
+                    tuple(a["a"]), tuple(a["b"])))
+        elif kind == "heal_partition":
+            self.netem.heal_partition(tuple(a["a"]), tuple(a["b"]))
+        elif kind == "drop_oneway":
+            self.netem.drop_oneway(tuple(a["src"]), tuple(a["dst"]))
+            self._schedule_heal(
+                a.get("ttl"),
+                lambda: self.netem.heal_oneway(
+                    tuple(a["src"]), tuple(a["dst"])))
+        elif kind == "heal_oneway":
+            self.netem.heal_oneway(tuple(a["src"]), tuple(a["dst"]))
+        elif kind == "delay":
+            self.netem.delay(
+                tuple(a["src"]), tuple(a["dst"]), a["seconds"])
+            self._schedule_heal(
+                a.get("ttl"),
+                lambda: self.netem.heal_delay(
+                    tuple(a["src"]), tuple(a["dst"])))
+        elif kind == "reorder":
+            self.netem.reorder(
+                tuple(a["src"]), tuple(a["dst"]),
+                every=a.get("every", 3), hold=a.get("hold", 0.01))
+            self._schedule_heal(
+                a.get("ttl"),
+                lambda: self.netem.heal_reorder(
+                    tuple(a["src"]), tuple(a["dst"])))
+        elif kind == "netem_clear":
+            self.netem.clear()
+        else:
+            raise ValueError(f"unknown chaos event kind {kind!r}")
+
+    def _schedule_heal(self, ttl, heal) -> None:
+        if not ttl:
+            return
+
+        async def _later():
+            await asyncio.sleep(ttl * self.time_scale)
+            heal()
+
+        t = asyncio.ensure_future(_later())
+        self._heal_tasks.add(t)
+        t.add_done_callback(self._heal_tasks.discard)
+
+    async def _command(self, cmd: dict) -> tuple[int, str, bytes]:
+        code, rs, data = await self.client.command(cmd)
+        if code != 0:
+            raise OSError(-code, f"{cmd.get('prefix')}: {rs}")
+        return code, rs, data
+
+    async def _mon_restart(self, rank: int) -> None:
+        from ceph_tpu.mon import Monitor
+
+        old = self.mons[rank]
+        if old is None:
+            return
+        host, port = old.addr
+        await old.stop()
+        m = Monitor(
+            crush=self._crush_template.copy(), rank=rank,
+            n_mons=len(self.mons),
+        )
+        self.netem.attach(m.messenger)
+        await m.start(host, port)
+        self.mons[rank] = m
+        await m.open_quorum(list(self.monmap))
+
+    # -- post-thrash verification ---------------------------------------
+
+    def mon_views(self) -> list[dict]:
+        return [
+            {
+                "rank": m.rank,
+                "stable": m.paxos.stable.is_set(),
+                "leader": m.paxos.leader,
+                "epoch": m.osdmap.epoch,
+            }
+            for m in self.mons if m is not None
+        ]
+
+    async def await_quorum_agreement(self, timeout: float = 30.0) -> list:
+        """Poll until every mon agrees (one leader, one epoch); returns
+        the surviving violations (empty = invariant holds)."""
+        deadline = time.monotonic() + timeout
+        views = self.mon_views()
+        while time.monotonic() < deadline:
+            views = self.mon_views()
+            if not inv.check_quorum(views):
+                return []
+            await asyncio.sleep(0.2)
+        return inv.check_quorum(views)
+
+    async def deep_scrub_sweep(self, retries: int = 6) -> list[dict]:
+        """Deep scrub every PG of every scenario pool; returns reports."""
+        import json as _json
+
+        reports: list[dict] = []
+        om = self.client.osdmap
+        for pool in self.scenario.get("pools", []):
+            pid = om.lookup_pg_pool_name(pool["name"])
+            if pid < 0:
+                continue
+            for ps in range(om.pools[pid].pg_num):
+                rep = None
+                for attempt in range(retries):
+                    code, _rs, data = await self.client.command({
+                        "prefix": "pg deep-scrub",
+                        "pgid": f"{pid}.{ps}",
+                    })
+                    if code == 0:
+                        rep = _json.loads(data)
+                        break
+                    await asyncio.sleep(0.3 * (attempt + 1))
+                reports.append(rep if rep is not None else {
+                    "pg": f"{pid}.{ps}",
+                    "error": "deep scrub never reached a primary",
+                })
+        return reports
+
+
+async def run_scenario(
+    scenario: dict | str, seed: int, *, time_scale: float = 1.0,
+    settle_timeout: float = 90.0,
+) -> dict:
+    """One (scenario, seed) chaos run end to end; returns the result
+    record that lands in the chaos artifact."""
+    if isinstance(scenario, str):
+        scenario = SCENARIOS[scenario]
+    events = generate_schedule(seed, scenario)
+    th = trace_hash(events)
+    counters = chaos_counters()
+    counters.inc("runs")
+    t_wall = time.monotonic()
+    cluster = ChaosCluster(scenario, time_scale=time_scale)
+    result: dict = {
+        "scenario": scenario["name"], "seed": seed,
+        "trace_hash": th, "n_events": len(events),
+    }
+    try:
+        await cluster.start()
+        cold_before = _cold_launch_snapshot()
+        wl_conf = scenario.get("workload", {})
+        workload = Workload(
+            cluster.client, scenario.get("pools", []),
+            objects=wl_conf.get("objects", 3),
+            rounds=wl_conf.get("rounds", 3),
+            object_size=wl_conf.get("object_size", 8192),
+        )
+        wl_task = asyncio.ensure_future(workload.run())
+
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        for ev in events:
+            delay = t0 + ev.t * time_scale - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            await cluster.apply_event(ev)
+        history = await wl_task
+
+        # settle: converge back to active+clean under the final map
+        violations: dict[str, list] = {}
+        settle_epoch = cluster.client.osdmap.epoch
+        try:
+            status = await cluster.client.wait_clean(
+                timeout=settle_timeout, min_epoch=settle_epoch)
+            violations["converged"] = inv.check_converged(status)
+        except TimeoutError as e:
+            violations["converged"] = [{
+                "invariant": "not_converged", "detail": str(e)}]
+        violations["quorum"] = await cluster.await_quorum_agreement()
+        violations["history"] = inv.check_history(history)
+        final = await workload.final_reads()
+        violations["final_reads"] = inv.check_final_reads(history, final)
+        reports = await cluster.deep_scrub_sweep()
+        violations["scrub"] = inv.check_scrub_reports(reports)
+        violations["cold_launches"] = inv.check_cold_launches(
+            cold_before, _cold_launch_snapshot())
+
+        ok = not any(violations.values())
+        counters.inc("runs_green" if ok else "runs_red")
+        for name, vs in violations.items():
+            if vs:
+                counters.inc("violations", invariant=name, by=len(vs))
+        result.update({
+            "ok": ok,
+            "events_applied": cluster.events_applied,
+            "event_errors": len(cluster.event_errors),
+            "workload": history.summary(),
+            "netem": dict(cluster.netem.stats),
+            "invariants": {
+                name: {"ok": not vs, "violations": vs}
+                for name, vs in violations.items()
+            },
+            "wall_s": round(time.monotonic() - t_wall, 2),
+        })
+        return result
+    finally:
+        await cluster.stop()
+
+
+def run_sweep(
+    scenario_names: list[str], seeds, *, time_scale: float = 1.0,
+    scenarios: dict[str, dict] | None = None,
+) -> dict:
+    """Synchronous driver for CLI/tests: every scenario x every seed,
+    each on a fresh event loop (daemon state never leaks across runs).
+    Raises nothing — red runs land in the artifact with their
+    violations."""
+    book = scenarios or SCENARIOS
+    runs: list[dict] = []
+    for name in scenario_names:
+        for seed in seeds:
+            loop = asyncio.new_event_loop()
+            try:
+                runs.append(loop.run_until_complete(
+                    run_scenario(book[name], seed, time_scale=time_scale)
+                ))
+            except Exception as e:  # harness crash: record, keep going
+                log.exception("chaos run %s/%s crashed", name, seed)
+                runs.append({
+                    "scenario": name, "seed": seed, "ok": False,
+                    "crash": f"{type(e).__name__}: {e}",
+                })
+            finally:
+                loop.close()
+    green = sum(1 for r in runs if r.get("ok"))
+    return {
+        "schema": "ceph_tpu.chaos/v1",
+        "scenarios": list(scenario_names),
+        "seeds": list(seeds),
+        "runs": runs,
+        "summary": {
+            "total": len(runs), "green": green,
+            "red": len(runs) - green,
+            "all_green": green == len(runs),
+        },
+    }

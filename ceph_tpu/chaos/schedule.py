@@ -1,0 +1,229 @@
+"""Seeded deterministic event-schedule generation.
+
+The OSDThrasher (qa/tasks/thrasher.py) draws its next action from a
+live RNG while the cluster runs, so no two runs are alike and a failure
+is unreproducible without the full teuthology log.  Here the WHOLE
+event trace is generated up front as a pure function of ``(seed,
+scenario)``: the runner then merely replays it against the cluster, so
+
+- the same seed always yields the identical trace (asserted by
+  :func:`trace_hash`, committed into the chaos artifact), and
+- a failing seed replays the exact same thrash sequence for debugging
+  (the ``ceph_test_rados --seed`` contract).
+
+The generator is stateful *internally* — it tracks which OSDs its own
+trace has killed/outed and which links it has partitioned, so traces
+are always applicable (never reviving a live OSD, never exceeding the
+down budget that would lose quorum/min_size) — but that state derives
+only from the seed and scenario, never from the wall clock or the
+cluster.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+from dataclasses import dataclass, field
+
+#: every event kind a schedule may emit (the thrasher's action
+#: vocabulary + the netem verbs)
+EVENT_KINDS = (
+    "osd_kill",       # stop the daemon (store survives for the revive)
+    "osd_revive",     # restart a killed osd on its surviving store
+    "osd_out",        # mon: mark out (remap + backfill away)
+    "osd_in",         # mon: mark in again
+    "reweight",       # crush reweight an osd
+    "mon_restart",    # bounce a monitor (quorum re-forms, catch-up)
+    "pg_split",       # double a pool's pg_num
+    "scrub",          # shallow scrub a random pg
+    "deep_scrub",     # deep scrub a random pg
+    "repair",         # pg repair a random pg
+    "balance",        # run the upmap balancer
+    "partition",      # netem: symmetric partition between two entities
+    "heal_partition",  # netem: heal one active partition
+    "drop_oneway",    # netem: silently drop src->dst
+    "heal_oneway",    # netem: heal one active one-way drop
+    "delay",          # netem: fixed per-send latency on a link
+    "reorder",        # netem: bounded reordering on a link
+    "netem_clear",    # netem: drop every active rule
+)
+
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    """One scheduled action.  ``t`` is the virtual time offset (seconds
+    from chaos start; the runner scales it), ``kind`` one of
+    EVENT_KINDS, ``args`` the kind-specific parameters."""
+
+    t: float
+    kind: str
+    args: dict = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {"t": self.t, "kind": self.kind, "args": dict(self.args)}
+
+
+def trace_hash(events: list[ChaosEvent]) -> str:
+    """Canonical sha256 over the event trace — the replay assertion:
+    regenerating a seed must reproduce this hash bit-identically."""
+    blob = json.dumps(
+        [e.to_json() for e in events], sort_keys=True,
+        separators=(",", ":"),
+    ).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+class _TraceState:
+    """What the generator must remember about its own trace so every
+    drawn event is applicable when replayed in order."""
+
+    def __init__(self, n_osds: int, n_mons: int):
+        self.alive = set(range(n_osds))     # daemons running
+        self.in_set = set(range(n_osds))    # marked in
+        self.partitions: list[tuple] = []   # active symmetric cuts
+        self.oneways: list[tuple] = []      # active one-way drops
+        self.n_mons = n_mons
+        self.splits = 0
+
+
+def _entity_pool(rng: random.Random, scenario: dict) -> list[tuple]:
+    """Link endpoints netem rules may target: osd<->osd and, in
+    multi-mon scenarios, osd<->mon links (never client links — the
+    workload oracle needs its acks)."""
+    ents = [("osd", i) for i in range(scenario["n_osds"])]
+    if scenario.get("n_mons", 1) > 1:
+        ents += [("mon", r) for r in range(scenario["n_mons"])]
+    return ents
+
+
+def generate_schedule(seed: int, scenario: dict) -> list[ChaosEvent]:
+    """Draw ``scenario['n_events']`` events over ``scenario
+    ['duration']`` virtual seconds, honoring the scenario's event-mix
+    weights and its safety budgets.  Pure in ``(seed, scenario)``."""
+    rng = random.Random(f"chaos:{seed}:{scenario['name']}")
+    n_osds = scenario["n_osds"]
+    n_mons = scenario.get("n_mons", 1)
+    n_events = scenario.get("n_events", 10)
+    duration = float(scenario.get("duration", 5.0))
+    mix = dict(scenario.get("mix", {"osd_kill": 1.0}))
+    # revive/heal verbs are implied counterparts, not independent
+    # draws: the generator emits them to keep its budgets
+    for implied in ("osd_revive", "osd_in", "heal_partition",
+                    "heal_oneway"):
+        mix.pop(implied, None)
+    # at most this many osds simultaneously dead+out: keeps a k+m EC
+    # pool writable while the thrash runs (the OSDThrasher's
+    # min_in/max_dead budget)
+    max_dead = scenario.get("max_dead", max(1, n_osds - 1 - max(
+        p.get("k", p.get("size", 2)) + p.get("m", 0)
+        for p in scenario.get("pools", [{"size": 2}])
+    )))
+    max_dead = max(1, min(max_dead, n_osds - 2))
+    max_cuts = scenario.get("max_partitions", 1)
+    pg_pools = [p["name"] for p in scenario.get("pools", [])] or ["rep"]
+
+    st = _TraceState(n_osds, n_mons)
+    kinds = sorted(mix)
+    weights = [float(mix[k]) for k in kinds]
+    times = sorted(round(rng.uniform(0.05, duration), 3)
+                   for _ in range(n_events))
+    events: list[ChaosEvent] = []
+
+    def emit(t: float, kind: str, **args) -> None:
+        events.append(ChaosEvent(t=t, kind=kind, args=args))
+
+    for t in times:
+        kind = rng.choices(kinds, weights=weights)[0]
+        dead = sorted(set(range(n_osds)) - st.alive)
+        outed = sorted(set(range(n_osds)) - st.in_set)
+        down_ish = len(dead) + len(set(outed) - set(dead))
+        if kind == "osd_kill":
+            if down_ish >= max_dead:
+                # budget spent: revive the longest-dead instead
+                if dead:
+                    emit(t, "osd_revive", osd=dead[0])
+                    st.alive.add(dead[0])
+                elif outed:
+                    emit(t, "osd_in", osd=outed[0])
+                    st.in_set.add(outed[0])
+                continue
+            victim = rng.choice(sorted(st.alive))
+            st.alive.discard(victim)
+            emit(t, "osd_kill", osd=victim)
+        elif kind == "osd_out":
+            if down_ish >= max_dead or len(st.in_set) <= 2:
+                if outed:
+                    emit(t, "osd_in", osd=outed[0])
+                    st.in_set.add(outed[0])
+                continue
+            victim = rng.choice(sorted(st.in_set))
+            st.in_set.discard(victim)
+            emit(t, "osd_out", osd=victim)
+        elif kind == "reweight":
+            emit(t, "reweight", osd=rng.randrange(n_osds),
+                 weight=round(rng.choice([0.25, 0.5, 0.75, 1.0]), 2))
+        elif kind == "mon_restart":
+            if n_mons < 2:
+                continue  # single-mon cluster: a restart is an outage
+            emit(t, "mon_restart", rank=rng.randrange(n_mons))
+        elif kind == "pg_split":
+            if st.splits >= scenario.get("max_splits", 1):
+                continue
+            st.splits += 1
+            emit(t, "pg_split", pool=rng.choice(pg_pools))
+        elif kind in ("scrub", "deep_scrub", "repair"):
+            emit(t, kind, pool=rng.choice(pg_pools))
+        elif kind == "balance":
+            emit(t, "balance", max_swaps=8)
+        elif kind == "partition":
+            if len(st.partitions) >= max_cuts:
+                cut = st.partitions.pop(rng.randrange(len(st.partitions)))
+                emit(t, "heal_partition", a=list(cut[0]), b=list(cut[1]))
+                continue
+            ents = _entity_pool(rng, scenario)
+            a, b = rng.sample(ents, 2)
+            st.partitions.append((a, b))
+            emit(t, "partition", a=list(a), b=list(b),
+                 ttl=round(rng.uniform(0.3, 1.2), 3))
+        elif kind == "drop_oneway":
+            if len(st.oneways) >= max_cuts:
+                link = st.oneways.pop(rng.randrange(len(st.oneways)))
+                emit(t, "heal_oneway", src=list(link[0]), dst=list(link[1]))
+                continue
+            ents = _entity_pool(rng, scenario)
+            a, b = rng.sample(ents, 2)
+            st.oneways.append((a, b))
+            emit(t, "drop_oneway", src=list(a), dst=list(b),
+                 ttl=round(rng.uniform(0.3, 1.0), 3))
+        elif kind == "delay":
+            ents = _entity_pool(rng, scenario)
+            a, b = rng.sample(ents, 2)
+            emit(t, "delay", src=list(a), dst=list(b),
+                 seconds=round(rng.uniform(0.005, 0.04), 4),
+                 ttl=round(rng.uniform(0.3, 1.5), 3))
+        elif kind == "reorder":
+            ents = _entity_pool(rng, scenario)
+            a, b = rng.sample(ents, 2)
+            emit(t, "reorder", src=list(a), dst=list(b),
+                 every=rng.choice([2, 3, 5]),
+                 hold=round(rng.uniform(0.005, 0.03), 4),
+                 ttl=round(rng.uniform(0.3, 1.5), 3))
+        elif kind == "netem_clear":
+            st.partitions.clear()
+            st.oneways.clear()
+            emit(t, "netem_clear")
+    # the trace always ends whole: every dead osd revives, every outed
+    # osd returns, every cut heals — the runner's convergence invariant
+    # judges a complete cluster, not a half-thrashed one
+    t_end = round(duration + 0.05, 3)
+    for cut in st.partitions:
+        emit(t_end, "heal_partition", a=list(cut[0]), b=list(cut[1]))
+    for link in st.oneways:
+        emit(t_end, "heal_oneway", src=list(link[0]), dst=list(link[1]))
+    emit(t_end, "netem_clear")
+    for osd in sorted(set(range(n_osds)) - st.alive):
+        emit(t_end, "osd_revive", osd=osd)
+    for osd in sorted(set(range(n_osds)) - st.in_set):
+        emit(t_end, "osd_in", osd=osd)
+    return events

@@ -194,6 +194,13 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, AuthServiceMixin,
                     "num_pools": len(self.osdmap.pools),
                 },
             )
+            self._admin.register(
+                "dump_chaos", "chaos-engine event counters + recent "
+                "event spans (process-wide, ceph_tpu/chaos)",
+                lambda cmd: __import__(
+                    "ceph_tpu.chaos", fromlist=["dump_chaos"]
+                ).dump_chaos(),
+            )
             await self._admin.start()
         await self._replay()
         if self.beacon_grace > 0:

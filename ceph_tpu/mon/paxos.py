@@ -257,10 +257,18 @@ class Paxos:
                 self._election_acks.add(from_rank)
                 await self._check_victory()
         elif msg.op == VICTORY:
-            if from_rank > self.rank and self._electing:
+            if from_rank > self.rank and (self._electing or self.is_leader):
                 # a higher rank won a race our candidacy should win:
                 # keep contesting (the reference's lowest-rank
-                # guarantee; the new leader will defer on our PROPOSE)
+                # guarantee; the new leader will defer on our PROPOSE).
+                # The is_leader arm closes the simultaneous-victory
+                # cross-adoption race (quorum-storm seed 66): two mons
+                # win concurrent elections whose epochs renumber to the
+                # SAME even value and the VICTORYs cross — the higher
+                # rank correctly yields to ours, but we were no longer
+                # _electing and would adopt THEIRS, leaving a stable
+                # split brain where each side redirects commands to the
+                # other forever.
                 self.election_epoch = max(self.election_epoch, msg.epoch)
                 await self.start_election()
                 return

@@ -113,6 +113,14 @@ class Connection:
     async def send_message(self, msg: Message) -> None:
         if self._closed:
             raise ConnectionError("connection closed")
+        # deterministic network emulation (ceph_tpu/chaos/netem.py):
+        # per-peer partitions raise, one-way drops swallow the message,
+        # delay/reorder holds run here — BEFORE the send lock, so a
+        # held message is genuinely overtaken on the wire
+        shim = self.messenger.netem
+        if shim is not None and self.peer is not None:
+            if not await shim.on_send(self.messenger.entity, self.peer):
+                return
         n = self.messenger.inject_socket_failures
         if n > 0:
             self.messenger._inject_counter += 1
@@ -276,6 +284,9 @@ class Messenger:
         # ms_inject_delay analogue: seconds of latency added to every
         # outgoing message (0 = off)
         self.inject_delay = 0.0
+        # deterministic chaos shim (ceph_tpu/chaos/netem.py Netem);
+        # None = transparent
+        self.netem = None
 
     async def _dispatch(self, msg: Message) -> None:
         if self.dispatcher is not None:

@@ -420,6 +420,12 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             lambda cmd: self._dump_scrub_batch(),
         )
         sock.register(
+            "dump_chaos", "chaos-engine event counters + recent event "
+            "spans (process-wide, ceph_tpu/chaos)",
+            lambda cmd: __import__(
+                "ceph_tpu.chaos", fromlist=["dump_chaos"]).dump_chaos(),
+        )
+        sock.register(
             "config show", "effective configuration",
             lambda cmd: self.conf.show(),
         )
@@ -448,6 +454,7 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             self._beacon_task, self._hb_task, self._recovery_task,
             self._scrub_task, getattr(self, "_rehome_task", None),
             getattr(self, "_tier_task", None),
+            *getattr(self, "_repair_tasks", ()),
         ):
             if t:
                 t.cancel()
@@ -2022,10 +2029,38 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                 version=version, ops=effects, reqid=msg.reqid,
             ), tid))
         if waits:
-            replies = await asyncio.gather(*waits)
+            replies = await asyncio.gather(*waits, return_exceptions=True)
+            lost = False
             for rep in replies:
-                if rep.result != 0:
-                    return MOSDOpReply(tid=msg.tid, result=rep.result, epoch=self.epoch)
+                if isinstance(rep, asyncio.CancelledError):
+                    raise rep
+                if isinstance(rep, ECConnErrors + (OSError,)):
+                    lost = True
+                elif isinstance(rep, BaseException):
+                    raise rep
+                elif rep.result != 0:
+                    return MOSDOpReply(
+                        tid=msg.tid, result=rep.result, epoch=self.epoch)
+            if lost:
+                # partial replication: the primary applied + logged but
+                # a replica never confirmed.  Reconcile NOW under the
+                # object lock (push the logged version over the stale
+                # replica) so the client's dup-detected retry vouches
+                # for a write that actually replicated — not one the
+                # next scrub flags as a version mismatch
+                repaired = False
+                try:
+                    repaired = await self._reconcile_object(
+                        pool, pg, self._pg_members(pool, acting),
+                        msg.oid, have_lock=True)
+                except Exception:
+                    log.exception(
+                        "osd.%d: post-partial-repop reconcile of %s "
+                        "failed", self.id, msg.oid)
+                if not repaired:
+                    self._queue_object_repair(pool, pg, msg.oid)
+                return MOSDOpReply(
+                    tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
         first_out = next((d for _r, d, _kv in call_outs if d), b"")
         return MOSDOpReply(
             tid=msg.tid, result=0, epoch=self.epoch, outs=call_outs,

@@ -57,8 +57,10 @@ from ceph_tpu.msg.messages import (
     MOSDOpReply,
 )
 from ceph_tpu.osd.pgutil import (
+    ECConnErrors,
     ECFetchError,
     HINFO_ATTR,
+    RB_SNAP,
     SIZE_ATTR,
     USER_XATTR_PREFIX,
     VERSION_ATTR,
@@ -150,11 +152,42 @@ class ECBackendMixin:
                     ), tid)))
         first_err = 0
         if waits:
-            for rep in await asyncio.gather(*waits):
-                if rep.result == -errno.ESTALE:
+            reps = await asyncio.gather(*waits, return_exceptions=True)
+            lost = False
+            for rep in reps:
+                if isinstance(rep, asyncio.CancelledError):
+                    raise rep
+                if isinstance(rep, ECConnErrors + (OSError,)):
+                    lost = True
+                elif isinstance(rep, BaseException):
+                    raise rep
+                elif rep.result == -errno.ESTALE:
                     estale = True
                 elif rep.result != 0 and first_err == 0:
                     first_err = rep.result
+            if lost:
+                # PARTIAL fan-out: some shard never confirmed while
+                # others may already hold this version.  Repair NOW,
+                # under the object lock, while the previous version
+                # still has >= k holders — deferring to the next map
+                # change lets a second partial write destroy the last
+                # reconstructible version (chaos-engine-found: a
+                # one-way drop + dup-acked retry left an object with
+                # no version on >= k shards, wedging recovery forever)
+                repaired = False
+                try:
+                    repaired = await self._reconcile_object(
+                        pool, pg, list(live), oid, have_lock=True)
+                except Exception:
+                    log.exception(
+                        "osd.%d: post-partial-fan-out reconcile of %s "
+                        "failed", self.id, oid)
+                if not repaired:
+                    # links still cut: keep repairing in the background
+                    # until the object reconciles (a partial write
+                    # after the last map epoch has no other trigger)
+                    self._queue_object_repair(pool, pg, oid)
+                return -errno.EAGAIN
         if first_err:
             return first_err
         if not estale:
@@ -249,6 +282,18 @@ class ECBackendMixin:
                 pool, pg, acting, msg.oid, lg)
             if served is not None and served >= logged_v:
                 return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
+            if served is None:
+                # the cluster state is UNREADABLE right now (links cut
+                # mid-thrash, shards unreachable): absence of evidence
+                # is not divergence.  Rolling back on a failed probe
+                # rewound the log to ZERO and re-applied this op's old
+                # payload as a fresh low version — clobbering newer
+                # acked writes shard by shard (chaos-engine-found
+                # time-travel corruption).  Bounce and let the client
+                # retry once the cluster is observable again.
+                self._queue_object_repair(pool, pg, msg.oid)
+                return MOSDOpReply(
+                    tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
             if msg.reqid in lg.reqids:
                 # reconcile did not strip it (e.g. zombie entry adopted
                 # from a peer log): drop it here so the op re-applies
@@ -562,6 +607,18 @@ class ECBackendMixin:
             if self.store.exists(c, o) and not self.store.exists(c, cl):
                 t.clone(c, o, cl)
                 t.setattrs(c, cl, {SNAPS_ATTR: clone_snaps})
+        if pool.is_erasure() and (version > ZERO or delete):
+            # rollback sidecar (the reference ECTransaction keeps
+            # roll-backward info until the write commits cluster-wide):
+            # preserve this shard's pre-write state so a PARTIAL
+            # fan-out can restore the member to the previous version —
+            # without it, an in-place partial overwrite destroys the
+            # old version's shard quorum and the object wedges unfound
+            rb = ghobject_t(oid, snap=RB_SNAP, shard=shard)
+            if self.store.exists(c, rb):
+                t.remove(c, rb)
+            if not delete and self.store.exists(c, o):
+                t.clone(c, o, rb)
         if delete:
             if self.store.exists(c, o):
                 t.remove(c, o)

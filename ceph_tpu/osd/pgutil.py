@@ -26,6 +26,14 @@ HINFO_ATTR = "hinfo"
 VERSION_ATTR = "_v"  # object_info version (oi attr analogue)
 USER_XATTR_PREFIX = "u_"  # client xattrs, namespaced off internal attrs
 
+#: snap id of the per-shard ROLLBACK SIDECAR object (the reference
+#: ECTransaction's roll-backward info): every versioned EC shard
+#: overwrite first clones the pre-write state here, so a partial
+#: fan-out can RESTORE a member to the previous version instead of
+#: wedging the pg.  Far above any real snap id, below NOSNAP, and
+#: within int64 (durable stores encode ghobject snaps as i64).
+RB_SNAP = 0x7FFFFFFFFFFFFF00
+
 ECConnErrors = (ConnectionError, asyncio.TimeoutError)
 
 

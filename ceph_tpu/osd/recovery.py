@@ -36,10 +36,12 @@ from ceph_tpu.msg.messages import (
 )
 from ceph_tpu.osd.pgutil import (
     NO_SHARD,
+    RB_SNAP,
     SIZE_ATTR,
     SUBOP_TIMEOUT,
     VERSION_ATTR,
     _v_parse,
+    object_to_pg,
 )
 
 log = logging.getLogger("ceph_tpu.osd")
@@ -480,6 +482,23 @@ class RecoveryMixin:
                 self._save_past_acting()  # one write after the drain
             auth = max(lus, key=lambda k: lus[k])
             strays = objs - lists[auth]
+            # an object the (adopted) authoritative log names as LIVE
+            # but missing from the auth member's listing is not
+            # deleted-while-down debris — it is missing ON the auth
+            # (log-sync hands members entries without data, so a
+            # freshly-seated member can be "newest" while empty).
+            # Reaping those deleted shards of acked objects from the
+            # members that still held them (chaos-engine-found).  The
+            # genuine stray case (DELETE entry trimmed away) has no
+            # retained live entry, so it still reaps.
+            if strays:
+                latest_op: dict[str, int] = {}
+                for v in sorted(lg.entries):
+                    e = lg.entries[v]
+                    latest_op[e.oid] = e.op
+                strays -= {
+                    o_ for o_, op_ in latest_op.items() if op_ != DELETE
+                }
             log.debug(
                 "osd.%d: pg %s backfill: objs=%d prior=%s lists=%s "
                 "auth=%s strays=%d", self.id, pg, len(objs), prior,
@@ -629,11 +648,15 @@ class RecoveryMixin:
                 break
 
         state: dict[tuple[int, int], tuple[bool, eversion_t, dict]] = {}
+        unprobed: list[tuple[int, int]] = []
         for s, o in pairs:
             try:
                 payload, attrs = await self._probe_shard(pool, pg, s, o, oid)
             except (OSError, asyncio.TimeoutError, ConnectionError):
-                continue  # unreachable: not a source nor target now
+                # unreachable: not a source nor target now — but its
+                # unseen state VETOES destructive decisions below
+                unprobed.append((s, o))
+                continue
             if payload is None:
                 state[(s, o)] = (False, ZERO, {})
             else:
@@ -676,8 +699,23 @@ class RecoveryMixin:
             (s, o) for (s, o), (p, v, _a) in state.items()
             if not p or v < vmax
         ]
+        clone_ok = True
+        if not is_ec and sources:
+            # clone objects are immutable COW copies that never appear
+            # in per-name reconciliation: a member rebuilt after data
+            # loss gets the head (and its SnapSet) pushed but would
+            # serve ENOENT for every snap read — sync any clone the
+            # authoritative SnapSet lists (chaos-engine-found gap)
+            src_attrs0 = next(
+                a for (s, o), (p, v, a) in all_state.items()
+                if p and v == vmax
+            )
+            clone_ok = await self._sync_clones(
+                pool, pg, pairs, oid, next(iter(sources.items())),
+                src_attrs0,
+            )
         if not targets:
-            return True
+            return clone_ok
         log.info(
             "osd.%d: recovering %s/%s to %s on %s", self.id, pg, oid,
             vmax, targets,
@@ -697,12 +735,27 @@ class RecoveryMixin:
                 self._push(pool, pg, s, o, oid, payload, src_attrs)
                 for s, o in targets
             ), return_exceptions=True)  # a dead target must not abort
-            return not any(              # the rest of the recovery pass
+            return clone_ok and not any(  # the rest of the recovery pass
                 isinstance(r, BaseException) for r in results)
         ec = self._ec_for(pool)
         sinfo = self._sinfo(ec)
         k = ec.get_data_chunk_count()
         force_push = False
+        rb_srcs: set[int] = set()
+        if len(sources) < k and unprobed:
+            # rollback is DESTRUCTIVE (strips log entries, force-pushes
+            # old data) and must never be decided on a partial view: an
+            # unreachable member may hold the very shards that make
+            # vmax reconstructible.  Absence of evidence is not
+            # divergence (chaos-engine-found: mid-partition reconciles
+            # rolled logs back to the reachable minority's version,
+            # after which stale dup-resends re-applied old payloads as
+            # fresh low versions).  Retry when every member answers.
+            log.info(
+                "osd.%d: %s/%s rollback deferred: %s unprobed",
+                self.id, pg, oid, unprobed,
+            )
+            return False
         if len(sources) < k:
             # vmax is not reconstructible (a client write died mid
             # fan-out): ROLL BACK to the newest version at least k
@@ -718,13 +771,145 @@ class RecoveryMixin:
             for (s, o), (p, v, _a) in state.items():
                 if p:
                     by_v.setdefault(v, []).append((s, o))
+            # rollback-sidecar votes (see _shard_write_txn): a member
+            # whose OBJECT moved past the quorum version still holds
+            # the pre-write shard state in its sidecar — restorable,
+            # so it counts toward reconstructibility of that version
+            rb_votes: dict = {}  # (s, o) -> (version, attrs)
+            for (s, o), (p, _v, _a) in state.items():
+                if not p:
+                    continue
+                _sp, sa, _se = await self._read_shard_quiet(
+                    pool, pg, s, o, oid, length=1, snap=RB_SNAP)
+                if _sp is None:
+                    continue
+                rb_votes[(s, o)] = (
+                    _v_parse((sa or {}).get(VERSION_ATTR)), sa or {})
+            for (s, o), (rv, _ra) in rb_votes.items():
+                lst = by_v.setdefault(rv, [])
+                if s not in {s2 for s2, _o2 in lst}:
+                    lst.append((s, o))
             candidates = [v for v, lst in by_v.items() if len(lst) >= k]
             if not candidates:
-                log.error(
-                    "osd.%d: %s/%s unrecoverable: %d/%d consistent shards",
-                    self.id, pg, oid, len(sources), k,
-                )
-                return False
+                # current members alone can reconstruct NOTHING — e.g.
+                # a remap seated an empty member while a partial write
+                # bumped another past the quorum version.  Count
+                # prior-interval holders toward reconstructibility too
+                # (distinct shard ids).  Safe: an acked write reached
+                # every live acting member at ack time, so a version
+                # invisible on >= k current+prior shards while an older
+                # one IS reconstructible was never acked — rolling it
+                # back loses nothing a client was promised (the wedge
+                # this unblocks spams "unrecoverable" forever and the
+                # PG never converges; chaos-engine-found).
+                by_v_all: dict = {}
+                for (s, o), (p, v, _a) in all_state.items():
+                    if p:
+                        by_v_all.setdefault(v, {}).setdefault(s, o)
+                for (s, o), (rv, _ra) in rb_votes.items():
+                    by_v_all.setdefault(rv, {}).setdefault(s, o)
+                candidates = [
+                    v for v, m in by_v_all.items() if len(m) >= k
+                ]
+                by_v = {
+                    v: list(m.items()) for v, m in by_v_all.items()
+                }
+            if not candidates:
+                # interval tracking can miss homes under heavy thrash
+                # (kills racing remaps faster than past_acting chains
+                # propagate): the reference's might_have_unfound sweep
+                # — probe EVERY up osd for every shard before declaring
+                # the object unfound.  Desperate path only: it is
+                # O(shards x osds) probes and runs solely when the
+                # normal evidence cannot reconstruct any version.
+                om = self.osdmap
+                desperate_blind = False
+                for s in range(pool.size):
+                    for o2 in range(om.max_osd):
+                        if not om.is_up(o2) or (s, o2) in all_state:
+                            continue
+                        try:
+                            payload, attrs = await self._probe_shard(
+                                pool, pg, s, o2, oid)
+                        except (OSError, asyncio.TimeoutError,
+                                ConnectionError):
+                            # an unanswered probe may hide the k-th
+                            # holder: destructive verdicts below need
+                            # FULL coverage
+                            desperate_blind = True
+                            continue
+                        if payload is not None:
+                            all_state[(s, o2)] = (
+                                True,
+                                _v_parse((attrs or {}).get(VERSION_ATTR)),
+                                attrs or {},
+                            )
+                by_v_all = {}
+                for (s, o2), (p, v, _a) in all_state.items():
+                    if p:
+                        by_v_all.setdefault(v, {}).setdefault(s, o2)
+                for (s, o2), (rv, _ra) in rb_votes.items():
+                    by_v_all.setdefault(rv, {}).setdefault(s, o2)
+                # a version regaining >= k distinct shards here may be
+                # vmax itself — then this is a roll FORWARD onto the
+                # acting set, not a rollback
+                candidates = [
+                    v for v, m in by_v_all.items() if len(m) >= k
+                ]
+                by_v = {
+                    v: list(m.items()) for v, m in by_v_all.items()
+                }
+            if not candidates:
+                if unprobed or desperate_blind:
+                    log.error(
+                        "osd.%d: %s/%s unrecoverable so far: %d/%d "
+                        "consistent shards, view incomplete",
+                        self.id, pg, oid, len(sources), k,
+                    )
+                    return False
+                # FULL coverage and still no version on >= k shards:
+                # no write to this object can ever have been ACKED (an
+                # acked EC write reaches every live acting member, and
+                # kills preserve stores) — what remains is debris of
+                # partial fan-outs at assorted versions.  Roll the
+                # object back to NONEXISTENCE: delete the orphan
+                # shards, strip its log entries so reqid dedup stops
+                # vouching, and let any client retry re-apply from
+                # scratch.  Without this the PG wedges forever — no
+                # version reconstructible, nothing deletable
+                # (chaos-engine-found terminal state).
+                # An ACKED version cannot land here: acking required
+                # every live acting member to apply it, and a member
+                # whose payload later moved past it keeps the pre-write
+                # state in its rollback sidecar — so an acked version
+                # that lost its payload quorum still reaches k votes
+                # via sidecars and resolves as a restorable CANDIDATE
+                # above.  (Residual risk: two+ partial overwrites on
+                # the same member rotate its single sidecar slot past
+                # an acked version — the bounded-rollback-window
+                # tradeoff the reference also makes.)
+                log.warning(
+                    "osd.%d: %s/%s: no version on >= %d shards anywhere;"
+                    " rolling back to nonexistence", self.id, pg, oid, k)
+                guard = vmax
+                for (s2, o2), (p, _v, _a) in sorted(all_state.items()):
+                    if p:
+                        try:
+                            await self._recovery_delete(
+                                pool, pg, s2, o2, oid, guard)
+                        except (OSError, asyncio.TimeoutError,
+                                ConnectionError):
+                            return False  # a holder vanished: retry
+                t = Transaction()
+                self._ensure_coll(t, self._shard_coll(pool, pg, my_shard))
+                lg.rollback_divergent(t, oid, ZERO)
+                if t.ops:
+                    if getattr(self.store, "blocking_commit", False):
+                        await asyncio.to_thread(
+                            self.store.queue_transaction, t)
+                    else:
+                        self.store.queue_transaction(t)
+                return True
             v_star = max(candidates)
             log.warning(
                 "osd.%d: %s/%s rolling back %s -> %s (partial write)",
@@ -736,10 +921,26 @@ class RecoveryMixin:
                 (s, o) for (s, o), (p, v, _a) in state.items()
                 if not p or v != v_star
             ]
+            # shards whose v_star copy lives in the rollback sidecar,
+            # not the object (their object is at a doomed version):
+            # reads below must target the sidecar
+            rb_srcs = {
+                s for (s, o), (rv, _ra) in rb_votes.items()
+                if rv == v_star and not (
+                    (s, o) in all_state
+                    and all_state[(s, o)][0]
+                    and all_state[(s, o)][1] == v_star
+                )
+            }
             src_attrs = next(
-                a for (s, o), (p, v, a) in state.items()
-                if p and v == v_star
+                (a for (s, o), (p, v, a) in all_state.items()
+                 if p and v == v_star),
+                None,
             )
+            if src_attrs is None:
+                src_attrs = next(
+                    ra for (rv, ra) in rb_votes.values() if rv == v_star
+                )
             force_push = True
             t = Transaction()
             self._ensure_coll(t, self._shard_coll(pool, pg, my_shard))
@@ -757,6 +958,7 @@ class RecoveryMixin:
         repair_extents: dict[int, list[tuple[int, int]]] | None = None
         if (
             len(need) == 1 and ec.get_sub_chunk_count() > 1
+            and not rb_srcs
             and not getattr(self, "disable_subchunk_repair", False)
         ):
             try:
@@ -800,7 +1002,9 @@ class RecoveryMixin:
         if not chunks:
             src_items = list(sources.items())
             payloads = await asyncio.gather(*(
-                self._read_shard_quiet(pool, pg, s, o, oid)
+                self._read_shard_quiet(
+                    pool, pg, s, o, oid,
+                    **({"snap": RB_SNAP} if s in rb_srcs else {}))
                 for s, o in src_items
             ))
             for (s, o), (payload, _a, _e) in zip(src_items, payloads):
@@ -831,6 +1035,135 @@ class RecoveryMixin:
             for s, o in targets
         ), return_exceptions=True)  # dead targets retry on the next pass
         return not any(isinstance(r, BaseException) for r in results)
+
+    #: reserved push-attr key carrying a clone's snap id (clone pushes
+    #: reuse the MOSDPGPush frame; the receiver pops this and files the
+    #: payload under ghobject(oid, snap=...) instead of the head)
+    CLONE_PUSH_ATTR = "__clone_snap__"
+
+    def _queue_object_repair(self, pool, pg, oid: str) -> None:
+        """A write-path repair failed (links cut mid-thrash, member
+        unreachable): keep retrying in the background until the object
+        reconciles.  Without this, damage inflicted AFTER the last map
+        epoch is never repaired — recovery passes only trigger on map
+        changes, so the cluster reports clean while a partial write
+        sits unreconstructible until the next scrub finds it
+        (chaos-engine-found).  Deduplicated per (pool, oid)."""
+        key = (pool.id, oid)
+        pend = getattr(self, "_repair_pending", None)
+        if pend is None:
+            pend = self._repair_pending = set()
+        if key in pend:
+            return
+        pend.add(key)
+
+        async def _retry() -> None:
+            try:
+                for attempt in range(60):
+                    if self.stopping:
+                        return
+                    await asyncio.sleep(min(0.25 * (attempt + 1), 2.0))
+                    om = self.osdmap
+                    cur_pool = om.get_pg_pool(pool.id) if om else None
+                    if cur_pool is None:
+                        return  # pool deleted
+                    cur_pg = object_to_pg(cur_pool, oid)
+                    acting, primary = self._acting(cur_pool, cur_pg)
+                    if primary != self.id:
+                        return  # the new primary owns the repair
+                    try:
+                        if await self._reconcile_object(
+                            cur_pool, cur_pg,
+                            self._pg_members(cur_pool, acting), oid,
+                        ):
+                            return
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        continue
+                log.warning(
+                    "osd.%d: background repair of %s/%s gave up",
+                    self.id, pg, oid)
+            finally:
+                pend.discard(key)
+
+        t = asyncio.ensure_future(_retry())
+        hold = getattr(self, "_repair_tasks", None)
+        if hold is None:
+            hold = self._repair_tasks = set()
+        hold.add(t)
+        t.add_done_callback(hold.discard)
+
+    async def _sync_clones(
+        self, pool, pg, pairs, oid: str,
+        src_pair: tuple[int, int], src_attrs: dict,
+    ) -> bool:
+        """Replicated pools: ensure every acting member holds every
+        clone the authoritative head's SnapSet lists.  Clones are
+        immutable once COW'd, so presence is sufficiency — a member
+        that has the clone object is done, one that lacks it gets the
+        source's copy pushed (reference recovery ships clones as
+        ordinary objects because its missing-sets are ghobject-keyed;
+        our name-keyed reconcile needs this explicit pass)."""
+        import errno
+
+        from ceph_tpu.osd.snaps import SS_ATTR, SnapSet
+
+        raw = (src_attrs or {}).get(SS_ATTR)
+        if not raw:
+            return True
+        ss = SnapSet.from_bytes(raw)
+        if not ss.clones:
+            return True
+        s_src, o_src = src_pair
+        ok = True
+        for cl in ss.clones:
+            payload = attrs = None
+            if o_src == self.id:
+                c = self._shard_coll(pool, pg, s_src)
+                co = ghobject_t(oid, snap=cl.id, shard=s_src)
+                if self.store.exists(c, co):
+                    payload = bytes(self.store.read(c, co))
+                    attrs = dict(self.store.getattrs(c, co))
+            else:
+                payload, attrs, _e = await self._read_shard_quiet(
+                    pool, pg, s_src, o_src, oid, snap=cl.id)
+            if payload is None:
+                # the source lost this clone too: nothing to sync from;
+                # a prior-interval member may still serve it next pass
+                ok = False
+                continue
+            for s, o in pairs:
+                if o == CRUSH_ITEM_NONE:
+                    continue
+                if o == self.id:
+                    c = self._shard_coll(pool, pg, s)
+                    co = ghobject_t(oid, snap=cl.id, shard=s)
+                    if not self.store.exists(c, co):
+                        t = Transaction()
+                        self._ensure_coll(t, c)
+                        t.touch(c, co)
+                        t.truncate(c, co, len(payload))
+                        if payload:
+                            t.write(c, co, 0, payload)
+                        if attrs:
+                            t.setattrs(c, co, dict(attrs))
+                        self.store.queue_transaction(t)
+                    continue
+                probe, _a, perr = await self._read_shard_quiet(
+                    pool, pg, s, o, oid, length=1, snap=cl.id)
+                if probe is not None:
+                    continue  # clone present (immutable: done)
+                if perr not in (errno.ENOENT,):
+                    ok = False  # unreachable member: retry next pass
+                    continue
+                try:
+                    await self._push(
+                        pool, pg, s, o, oid, payload, dict(attrs or {}),
+                        snap=cl.id)
+                except (OSError, asyncio.TimeoutError, ConnectionError):
+                    ok = False
+        return ok
 
     async def _recovery_delete(
         self, pool, pg, shard, osd, oid, guard: eversion_t
@@ -974,7 +1307,12 @@ class RecoveryMixin:
         return rep.data, rep.attrs
 
     async def _push(self, pool, pg, shard, osd, oid, payload, attrs,
-                    force: bool = False) -> None:
+                    force: bool = False, snap: int | None = None) -> None:
+        if snap is not None:
+            # clone push: the snap id rides a reserved attr so the
+            # frame format stays unchanged (see CLONE_PUSH_ATTR)
+            attrs = dict(attrs)
+            attrs[self.CLONE_PUSH_ATTR] = str(snap).encode()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         tid = next(self._tids)
         self._push_waiters[tid] = fut
@@ -991,11 +1329,32 @@ class RecoveryMixin:
     async def _handle_push(self, msg: MOSDPGPush) -> None:
         pool = self.osdmap.get_pg_pool(msg.pg.pool)
         for oid, payload, attrs in msg.pushes:
+            c = self._shard_coll(pool, msg.pg, msg.shard)
+            clone_snap = attrs.pop(self.CLONE_PUSH_ATTR, None)
+            if clone_snap is not None:
+                # clone push (see _sync_clones): clones are immutable,
+                # so an existing clone object never gets overwritten
+                co = ghobject_t(
+                    oid, snap=int(clone_snap), shard=msg.shard)
+                if not self.store.exists(c, co):
+                    t = Transaction()
+                    self._ensure_coll(t, c)
+                    t.touch(c, co)
+                    t.truncate(c, co, len(payload))
+                    if payload:
+                        t.write(c, co, 0, payload)
+                    if attrs:
+                        t.setattrs(c, co, attrs)
+                    if getattr(self.store, "blocking_commit", False):
+                        await asyncio.to_thread(
+                            self.store.queue_transaction, t)
+                    else:
+                        self.store.queue_transaction(t)
+                continue
             # never regress: a write may have landed here between the
             # primary's probe and this push (the reference serializes
             # this with per-object rw locks; we reconcile on the next
             # recovery pass instead)
-            c = self._shard_coll(pool, msg.pg, msg.shard)
             o = ghobject_t(oid, shard=msg.shard)
             local_v = self._object_version(c, o)
             pushed_v = _v_parse(attrs.get(VERSION_ATTR))

@@ -22,3 +22,10 @@ if not os.environ.get("CEPH_TPU_TEST_REAL_DEVICE"):
         pin_virtual_cpu(8)
     except ImportError:
         pass
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running sweeps excluded from tier-1 (-m 'not slow')",
+    )

@@ -93,3 +93,49 @@ class TestAdminSocket:
                 assert "error" in unknown
 
         run(go())
+
+    def test_dump_chaos_surface(self, tmp_path):
+        """The chaos engine's observability plane: events applied by
+        the runner land in the process-wide ``chaos`` counters and
+        span ring, and every daemon's admin socket serves them via
+        ``dump_chaos`` (the thrash-forensics role)."""
+
+        async def go():
+            sock_dir = str(tmp_path)
+            conf = {"admin_socket": sock_dir + "/osd.$id.asok"}
+            async with Cluster(n_osds=3, osd_conf=conf) as c:
+                from ceph_tpu.chaos import chaos_counters, chaos_tracer
+                from ceph_tpu.chaos.netem import Netem
+
+                base = chaos_counters().dump().get(
+                    "netem_dropped_sends", 0)
+                # emit one traced chaos event + one netem verdict the
+                # way the runner does
+                with chaos_tracer().span(
+                    "chaos_event", kind="osd_kill", osd="2",
+                ):
+                    chaos_counters().inc("events", kind="osd_kill")
+                netem = Netem()
+                netem.attach(c.osds[0].messenger)
+                netem.drop_oneway(("osd", 0), ("osd", 1))
+                conn = await c.osds[0]._osd_conn(1)
+                from ceph_tpu.msg.messages import MOSDPing, PING
+
+                await conn.send_message(MOSDPing(op=PING, from_osd=0))
+                netem.detach(c.osds[0].messenger)
+
+                helptext = await admin_command(
+                    sock_dir + "/osd.0.asok", "help")
+                assert "dump_chaos" in helptext
+                d = await admin_command(sock_dir + "/osd.0.asok",
+                                        "dump_chaos")
+                assert d["counters"].get("events", 0) >= 1
+                assert d["counters"].get("events_kindosd_kill", 0) >= 1
+                assert d["counters"].get(
+                    "netem_dropped_sends", 0) >= base + 1
+                assert any(
+                    sp["tags"].get("kind") == "osd_kill"
+                    for sp in d["recent_events"]
+                )
+
+        run(go())

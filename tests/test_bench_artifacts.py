@@ -13,8 +13,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _readme_artifacts() -> set[str]:
     with open(os.path.join(REPO, "README.md")) as f:
         text = f.read()
-    return set(re.findall(r"\b((?:BENCH|MULTICHIP)_[A-Za-z0-9_.]*\.json)\b",
-                          text))
+    return set(re.findall(
+        r"\b((?:BENCH|MULTICHIP|CHAOS)_[A-Za-z0-9_.]*\.json)\b", text))
 
 
 def test_readme_cites_at_least_one_artifact():
@@ -82,8 +82,9 @@ def test_scrub_verify_citation_is_backed_by_artifact():
 def test_committed_artifacts_parse():
     """Every artifact in the tree is (line-delimited or plain) JSON."""
     for name in sorted(os.listdir(REPO)):
-        if not re.fullmatch(r"(?:BENCH|MULTICHIP)_[A-Za-z0-9_.]*\.json",
-                            name):
+        if not re.fullmatch(
+            r"(?:BENCH|MULTICHIP|CHAOS)_[A-Za-z0-9_.]*\.json", name
+        ):
             continue
         with open(os.path.join(REPO, name)) as f:
             body = f.read().strip()
@@ -93,3 +94,47 @@ def test_committed_artifacts_parse():
             for line in body.splitlines():
                 if line.strip():
                     json.loads(line)
+
+
+def _chaos_artifacts() -> list[str]:
+    return sorted(
+        n for n in _readme_artifacts() if n.startswith("CHAOS_")
+    )
+
+
+def test_chaos_artifact_cited_and_green():
+    """The chaos engine's honesty contract: the README must cite a
+    committed CHAOS artifact; the artifact must cover >= 3 scenarios x
+    >= 8 seeds with EVERY invariant green and a trace hash per run."""
+    cited = _chaos_artifacts()
+    assert cited, "README must cite the committed CHAOS artifact"
+    for name in cited:
+        path = os.path.join(REPO, name)
+        assert os.path.exists(path), f"cited artifact {name} not committed"
+        with open(path) as f:
+            doc = json.load(f)
+        runs = doc["runs"]
+        assert len(doc["scenarios"]) >= 3, doc["scenarios"]
+        assert len(doc["seeds"]) >= 8, doc["seeds"]
+        assert doc["summary"]["all_green"], doc["summary"]
+        assert all(r["ok"] for r in runs)
+        assert all(r.get("trace_hash") for r in runs)
+
+
+def test_chaos_artifact_traces_replay():
+    """Determinism guard: regenerating every artifact run's schedule
+    from (scenario, seed) must reproduce its recorded trace hash
+    bit-identically — scenario-config drift without a regenerated
+    artifact fails here."""
+    from ceph_tpu.chaos.runner import SCENARIOS
+    from ceph_tpu.chaos.schedule import generate_schedule, trace_hash
+
+    for name in _chaos_artifacts():
+        with open(os.path.join(REPO, name)) as f:
+            doc = json.load(f)
+        for run in doc["runs"]:
+            sc = SCENARIOS.get(run["scenario"])
+            assert sc is not None, run["scenario"]
+            assert run["trace_hash"] == trace_hash(
+                generate_schedule(run["seed"], sc)
+            ), (name, run["scenario"], run["seed"])

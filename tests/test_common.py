@@ -116,8 +116,15 @@ class TestCompressor:
     def test_zlib_and_zstd_registered(self):
         avail = compressor.available()
         assert "zlib" in avail
-        assert "zstd" in avail  # zstandard ships in this environment
         assert "none" in avail
+        try:
+            import zstandard  # noqa: F401
+        except ImportError:
+            # no zstandard wheel: the registry must degrade cleanly —
+            # stdlib codecs stay available, zstd simply unregistered
+            assert "zstd" not in avail
+        else:
+            assert "zstd" in avail
 
     def test_unknown_name_lists_available(self):
         with pytest.raises(KeyError) as e:

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""chaos_run — drive chaos scenarios over seed sweeps, emit the artifact.
+
+The teuthology-suite entry point of the chaos engine
+(ceph_tpu/chaos/): each (scenario, seed) run boots a fresh
+mini-cluster, replays the seed's deterministic event trace under a
+recording workload, and judges every durability invariant; the
+aggregate lands as a committed JSON artifact (CHAOS_rNN.json) that CI
+guards (tests/test_bench_artifacts.py).
+
+    python tools/chaos_run.py --scenarios osd_thrash,netem_storm,quorum_thrash \
+        --seeds 8 --out CHAOS_r08.json
+
+Replay a single failing seed with full logging:
+
+    python tools/chaos_run.py --scenarios netem_storm --seed 5 -v
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    from ceph_tpu.chaos.runner import SCENARIOS, run_sweep
+    from ceph_tpu.chaos.schedule import generate_schedule, trace_hash
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument(
+        "--scenarios", default="all",
+        help="comma-separated scenario names, or 'all' "
+        f"(known: {','.join(sorted(SCENARIOS))})")
+    ap.add_argument(
+        "--seeds", type=int, default=8,
+        help="sweep seeds 0..N-1 per scenario (default 8)")
+    ap.add_argument(
+        "--seed", type=int, default=None,
+        help="run exactly ONE seed instead of a sweep (replay mode)")
+    ap.add_argument(
+        "--time-scale", type=float, default=1.0,
+        help="stretch/compress the virtual event timeline")
+    ap.add_argument(
+        "--out", default=None,
+        help="write the aggregate artifact JSON here")
+    ap.add_argument(
+        "--trace-only", action="store_true",
+        help="print each (scenario, seed) trace hash and event list "
+        "without touching a cluster (pure replay check)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    names = (
+        sorted(SCENARIOS) if args.scenarios == "all"
+        else [s for s in args.scenarios.split(",") if s]
+    )
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        ap.error(f"unknown scenarios {unknown}; known: {sorted(SCENARIOS)}")
+    seeds = [args.seed] if args.seed is not None else list(range(args.seeds))
+
+    if args.trace_only:
+        for name in names:
+            for seed in seeds:
+                ev = generate_schedule(seed, SCENARIOS[name])
+                print(f"{name} seed={seed} events={len(ev)} "
+                      f"trace={trace_hash(ev)}")
+                if args.verbose:
+                    for e in ev:
+                        print(f"  t={e.t:<7} {e.kind} {e.args}")
+        return 0
+
+    artifact = run_sweep(names, seeds, time_scale=args.time_scale)
+    for run in artifact["runs"]:
+        status = "green" if run.get("ok") else "RED"
+        print(f"{run['scenario']:<16} seed={run['seed']:<3} {status:<6} "
+              f"events={run.get('events_applied', '?')} "
+              f"trace={str(run.get('trace_hash', ''))[:12]} "
+              f"wall={run.get('wall_s', '?')}s")
+        if not run.get("ok"):
+            bad = run.get("crash") or {
+                k: v["violations"]
+                for k, v in run.get("invariants", {}).items()
+                if not v["ok"]
+            }
+            print(f"  -> {json.dumps(bad, default=str)[:500]}")
+    s = artifact["summary"]
+    print(f"\n{s['green']}/{s['total']} runs green")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=1, sort_keys=True, default=str)
+            f.write("\n")
+        print(f"wrote {args.out}")
+    return 0 if s["all_green"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
