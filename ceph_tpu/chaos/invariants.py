@@ -18,6 +18,9 @@ hand-built violating histories without booting a cluster (the
   map epoch (split-brain detector — the seed-66 bug class);
 - :func:`check_scrub_reports` — zero deep-scrub inconsistencies after
   the thrash;
+- :func:`check_disk_faults` — at-rest fsck sweeps report zero bad
+  blobs: every injected disk fault (EIO / bit rot / torn commit) was
+  healed by the repair chain or its OSD re-placed;
 - :func:`check_cold_launches` — the decode/scrub batchers minted ZERO
   cold XLA launches during chaos (recovery under failure must run on
   prewarmed shapes; a compile in the I/O path is a perf regression
@@ -223,7 +226,24 @@ def check_cold_launches(before: dict, after: dict) -> list[dict]:
     return out
 
 
+def check_disk_faults(fsck_reports: list[dict]) -> list[dict]:
+    """``fsck_reports``: per-OSD at-rest verification sweeps
+    ({"osd": id, "bad": [...]}).  Any blob still failing its checksum
+    after the run settled is injected damage the fault-tolerance chain
+    (EIO-as-erasure decode-around, quarantine + background repair, pg
+    repair) failed to heal."""
+    out: list[dict] = []
+    for rep in fsck_reports or []:
+        if rep.get("bad"):
+            out.append({
+                "invariant": "unhealed_disk_damage", "osd": rep.get("osd"),
+                "detail": rep["bad"],
+            })
+    return out
+
+
 #: checker registry: name -> callable, for reporting
 ALL_INVARIANTS = (
-    "history", "final_reads", "converged", "quorum", "scrub", "cold_launches",
+    "history", "final_reads", "converged", "quorum", "scrub",
+    "disk_faults", "cold_launches",
 )

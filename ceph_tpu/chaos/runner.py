@@ -12,7 +12,9 @@ settles the cluster and judges every durability invariant
 3. cluster converges back to active+clean within the bound,
 4. every monitor agrees on one leader and one map epoch,
 5. post-thrash deep scrub over every PG reports zero inconsistencies,
-6. the decode/scrub batchers minted ZERO cold XLA launches — chaos
+6. (disk-fault scenarios) every store's at-rest fsck sweep is clean —
+   injected rot was healed or its OSD re-placed,
+7. the decode/scrub batchers minted ZERO cold XLA launches — chaos
    must exercise the prewarmed recovery path, not compile mid-flight.
 
 Every applied event opens a ``chaos`` tracer span and counts into the
@@ -71,6 +73,33 @@ SCENARIOS: dict[str, dict] = {
         ],
         "workload": {"objects": 3, "rounds": 3, "object_size": 8192},
     },
+    # disk-fault chaos: the store layer lies — one-shot EIOs, at-rest
+    # bit flips, torn commits and a sticky-dead disk, against OSDs on
+    # REAL BlockStore devices (checksum-at-rest + BlueFS-lite), so
+    # injected rot surfaces exactly as production media errors do.
+    # Exercises EIO-as-erasure decode-around, replicated read
+    # failover, the read-error ledger's self-markdown escalation, and
+    # quarantine + background repair; self_heal runs a repair sweep
+    # before the deep-scrub verdict and fsck proves the platters are
+    # clean at rest.
+    "disk-fault": {
+        "name": "disk-fault",
+        "n_osds": 5, "n_mons": 1,
+        "store": "blockstore",
+        "self_heal": True,
+        "duration": 3.0, "n_events": 10,
+        "mix": {"eio": 2.5, "bitflip": 2.0, "torn_write": 1.5,
+                "disk_dead": 0.5, "osd_kill": 0.5,
+                "deep_scrub": 0.5, "repair": 0.5},
+        "max_dead": 1,
+        "pools": [
+            {"name": "rep", "type": "replicated", "pg_num": 4,
+             "size": 2, "snaps": True},
+            {"name": "ec", "type": "erasure", "pg_num": 2,
+             "k": 2, "m": 1},
+        ],
+        "workload": {"objects": 3, "rounds": 3, "object_size": 8192},
+    },
     # monitor-plane chaos: restarts + osd kills over a 3-mon quorum,
     # plus pg_num splitting mid-storm
     "quorum_thrash": {
@@ -121,6 +150,27 @@ class ChaosCluster:
         self._heal_tasks: set = set()
         self.event_errors: list[dict] = []
         self.events_applied = 0
+        self._store_dir: str | None = None
+        self._stores: dict[int, object] = {}  # osd id -> mounted store
+
+    def _make_store(self, osd_id: int):
+        """Per-scenario store engine: 'blockstore' puts each OSD on a
+        real BlockStore device (checksum-at-rest + BlueFS-lite KV) in
+        a run-scoped tempdir — the disk-fault scenario needs a store
+        whose bit rot surfaces as EIO, like production media."""
+        if self.scenario.get("store") != "blockstore":
+            return None
+        import os
+        import tempfile
+
+        from ceph_tpu.store.blockstore import BlockStore
+
+        if self._store_dir is None:
+            self._store_dir = tempfile.mkdtemp(prefix="chaos-disk-")
+        store = BlockStore(os.path.join(self._store_dir, f"osd{osd_id}"))
+        store.mount()
+        self._stores[osd_id] = store
+        return store
 
     # -- lifecycle -----------------------------------------------------
 
@@ -151,7 +201,7 @@ class ChaosCluster:
                 await m.wait_stable()
         self.osds = []
         for i in range(sc["n_osds"]):
-            osd = OSDDaemon(i, list(self.monmap))
+            osd = OSDDaemon(i, list(self.monmap), store=self._make_store(i))
             self.netem.attach(osd.messenger)
             await osd.start()
             self.osds.append(osd)
@@ -187,6 +237,12 @@ class ChaosCluster:
             await asyncio.sleep(0.05)
 
     async def stop(self) -> None:
+        from ceph_tpu.common.fault_injector import FAULTS
+
+        # disarm every store fault before teardown: umount/checkpoint
+        # must not trip a leftover injection, and the next seed's run
+        # must start clean (points are process-global)
+        FAULTS.clear()
         for t in list(self._heal_tasks):
             t.cancel()
         if self.client is not None:
@@ -197,6 +253,15 @@ class ChaosCluster:
         for m in self.mons:
             if m is not None:
                 await m.stop()
+        for store in self._stores.values():
+            try:
+                store.umount()
+            except OSError:
+                log.exception("chaos: store umount failed")
+        if self._store_dir is not None:
+            import shutil
+
+            shutil.rmtree(self._store_dir, ignore_errors=True)
 
     # -- event application ---------------------------------------------
 
@@ -238,6 +303,14 @@ class ChaosCluster:
                 await osd.stop()
                 self.osds[a["osd"]] = None
         elif kind == "osd_revive":
+            cur = self.osds[a["osd"]]
+            if cur is not None and cur.stopping:
+                # the daemon died on its own (read-error-ledger disk
+                # escalation): stash its store and treat it as killed
+                # so the revive below restarts it
+                self._stashed_stores = getattr(self, "_stashed_stores", {})
+                self._stashed_stores[a["osd"]] = cur.store
+                self.osds[a["osd"]] = None
             if self.osds[a["osd"]] is None:
                 from ceph_tpu.osd.daemon import OSDDaemon
 
@@ -320,8 +393,42 @@ class ChaosCluster:
                     tuple(a["src"]), tuple(a["dst"])))
         elif kind == "netem_clear":
             self.netem.clear()
+        elif kind in ("eio", "bitflip", "torn_write", "disk_dead",
+                      "disk_heal"):
+            self._apply_disk_fault(kind, a["osd"])
         else:
             raise ValueError(f"unknown chaos event kind {kind!r}")
+
+    #: FAULTS keys a disk-fault event may arm on one osd's store
+    _DISK_FAULT_OPS = ("read", "write", "commit", "mount")
+
+    def _apply_disk_fault(self, kind: str, osd_id: int) -> None:
+        """Arm (or clear) store-level FAULTS points for one OSD's
+        disk.  One key per (op, osd); a later event on the same osd
+        re-arms the key (latest fault wins — a disk does not queue its
+        lies)."""
+        import errno as _errno
+
+        from ceph_tpu.common.fault_injector import FAULTS
+
+        if kind == "eio":
+            FAULTS.inject(
+                f"store.read.osd.{osd_id}", error=_errno.EIO, count=1)
+        elif kind == "bitflip":
+            FAULTS.inject(f"store.read.osd.{osd_id}", bitflip=True, count=1)
+        elif kind == "torn_write":
+            FAULTS.inject(f"store.write.osd.{osd_id}", torn=True, count=1)
+        elif kind == "disk_dead":
+            # the dying-disk mode: EVERY read and commit fails until
+            # healed; the victim's read-error ledger escalates it to
+            # self-markdown and peering re-places its data
+            FAULTS.inject(
+                f"store.read.osd.{osd_id}", error=_errno.EIO, count=None)
+            FAULTS.inject(
+                f"store.write.osd.{osd_id}", error=_errno.EIO, count=None)
+        elif kind == "disk_heal":
+            for op in self._DISK_FAULT_OPS:
+                FAULTS.clear(f"store.{op}.osd.{osd_id}")
 
     def _schedule_heal(self, ttl, heal) -> None:
         if not ttl:
@@ -410,6 +517,52 @@ class ChaosCluster:
                 })
         return reports
 
+    async def repair_sweep(self, retries: int = 6) -> None:
+        """`pg repair` over every PG of every scenario pool — the
+        disk-fault scenario's heal pass: scrub-detected damage (rotten
+        shards quarantined to holes, divergent members of torn
+        commits) is rebuilt from the authoritative copies before the
+        deep-scrub verdict."""
+        om = self.client.osdmap
+        for pool in self.scenario.get("pools", []):
+            pid = om.lookup_pg_pool_name(pool["name"])
+            if pid < 0:
+                continue
+            for ps in range(om.pools[pid].pg_num):
+                for attempt in range(retries):
+                    code, _rs, _data = await self.client.command({
+                        "prefix": "pg repair", "pgid": f"{pid}.{ps}",
+                    })
+                    if code == 0:
+                        break
+                    await asyncio.sleep(0.3 * (attempt + 1))
+
+    def fsck_sweep(self) -> list[dict]:
+        """At-rest verification of every OSD's store (live daemons and
+        stashed stores of dead ones): any blob whose checksum no
+        longer verifies is damage the run failed to heal.  Stores
+        without an fsck (MemStore) contribute nothing."""
+        out: list[dict] = []
+        seen: set[int] = set()
+        stores: list[tuple[int, object]] = []
+        for osd in self.osds:
+            if osd is not None:
+                stores.append((osd.id, osd.store))
+                seen.add(osd.id)
+        for osd_id, store in getattr(self, "_stashed_stores", {}).items():
+            if osd_id not in seen:
+                stores.append((osd_id, store))
+        for osd_id, store in stores:
+            fsck = getattr(store, "fsck", None)
+            if not callable(fsck):
+                continue
+            try:
+                bad = fsck()
+            except (OSError, ValueError) as e:
+                bad = [{"error": f"{type(e).__name__}: {e}"}]
+            out.append({"osd": osd_id, "bad": bad})
+        return out
+
 
 async def run_scenario(
     scenario: dict | str, seed: int, *, time_scale: float = 1.0,
@@ -432,6 +585,9 @@ async def run_scenario(
     try:
         await cluster.start()
         cold_before = _cold_launch_snapshot()
+        from ceph_tpu.common.fault_injector import disk_fault_counters
+
+        df_before = dict(disk_fault_counters().dump())
         wl_conf = scenario.get("workload", {})
         workload = Workload(
             cluster.client, scenario.get("pools", []),
@@ -450,6 +606,13 @@ async def run_scenario(
             await cluster.apply_event(ev)
         history = await wl_task
 
+        if scenario.get("self_heal"):
+            # drain in-flight disk-fault escalations before capturing
+            # the settle epoch: a self-markdown landing just AFTER the
+            # capture would let pre-death active+clean reports satisfy
+            # the convergence wait while re-peering is still running
+            await asyncio.sleep(1.5 * time_scale)
+
         # settle: converge back to active+clean under the final map
         violations: dict[str, list] = {}
         settle_epoch = cluster.client.osdmap.epoch
@@ -465,7 +628,34 @@ async def run_scenario(
         final = await workload.final_reads()
         violations["final_reads"] = inv.check_final_reads(history, final)
         reports = await cluster.deep_scrub_sweep()
+        if scenario.get("self_heal") and inv.check_scrub_reports(reports):
+            # disk-fault mode: injected rot the run hasn't absorbed yet
+            # (e.g. a flipped shard nothing read) is healed by `pg
+            # repair` — the same authoritative-copy machinery operators
+            # invoke — then deep scrub must come back clean.  Bounded
+            # retries give in-flight quarantine/repair tasks time.
+            for _round in range(4):
+                await cluster.repair_sweep()
+                await asyncio.sleep(0.5 * time_scale)
+                reports = await cluster.deep_scrub_sweep()
+                if not inv.check_scrub_reports(reports):
+                    break
         violations["scrub"] = inv.check_scrub_reports(reports)
+        fsck_reports = []
+        if scenario.get("store") == "blockstore":
+            for _round in range(4):
+                fsck_reports = cluster.fsck_sweep()
+                if not inv.check_disk_faults(fsck_reports):
+                    break
+                # damage still referenced at rest: background repairs
+                # may be in flight, or a clone needs one more pass
+                await cluster.repair_sweep()
+                await workload.final_reads()
+                await asyncio.sleep(0.5 * time_scale)
+                fsck_reports = cluster.fsck_sweep()
+                if not inv.check_disk_faults(fsck_reports):
+                    break
+        violations["disk_faults"] = inv.check_disk_faults(fsck_reports)
         violations["cold_launches"] = inv.check_cold_launches(
             cold_before, _cold_launch_snapshot())
 
@@ -474,12 +664,18 @@ async def run_scenario(
         for name, vs in violations.items():
             if vs:
                 counters.inc("violations", invariant=name, by=len(vs))
+        df_after = disk_fault_counters().dump()
         result.update({
             "ok": ok,
             "events_applied": cluster.events_applied,
             "event_errors": len(cluster.event_errors),
             "workload": history.summary(),
             "netem": dict(cluster.netem.stats),
+            "disk_faults": {
+                k: v - df_before.get(k, 0)
+                for k, v in df_after.items()
+                if v - df_before.get(k, 0)
+            },
             "invariants": {
                 name: {"ok": not vs, "violations": vs}
                 for name, vs in violations.items()

@@ -47,6 +47,13 @@ EVENT_KINDS = (
     "delay",          # netem: fixed per-send latency on a link
     "reorder",        # netem: bounded reordering on a link
     "netem_clear",    # netem: drop every active rule
+    # disk-fault verbs (armed through common/fault_injector FAULTS
+    # store points, keyed store.<op>.osd.<id>)
+    "eio",            # one-shot EIO on an osd's next store read
+    "bitflip",        # flip one stored bit at rest on the next read
+    "torn_write",     # tear the osd's next transaction commit
+    "disk_dead",      # sticky EIO on every read+write (dying disk)
+    "disk_heal",      # clear every armed store fault on an osd
 )
 
 
@@ -85,6 +92,9 @@ class _TraceState:
         self.oneways: list[tuple] = []      # active one-way drops
         self.n_mons = n_mons
         self.splits = 0
+        self.disk_dead: set[int] = set()    # osds with a sticky-dead disk
+        self.disk_faulted: set[int] = set()  # osds with ANY store fault
+        self.last_damage = -1e9  # t of the last AT-REST damage event
 
 
 def _entity_pool(rng: random.Random, scenario: dict) -> list[tuple]:
@@ -174,6 +184,36 @@ def generate_schedule(seed: int, scenario: dict) -> list[ChaosEvent]:
             emit(t, "pg_split", pool=rng.choice(pg_pools))
         elif kind in ("scrub", "deep_scrub", "repair"):
             emit(t, kind, pool=rng.choice(pg_pools))
+        elif kind in ("eio", "bitflip", "torn_write", "disk_dead"):
+            # store faults against a LIVE osd (arming a dead daemon's
+            # store exercises nothing).  AT-REST damage (bitflip,
+            # disk_dead) respects a redundancy budget the way kills
+            # respect max_dead: at most ONE outstanding dying disk,
+            # and consecutive damage events at least damage_gap apart
+            # so quarantine + background repair can restore
+            # reconstructibility between hits — two unhealed rotten
+            # copies of the same object is operator data loss
+            # (exceeding m), not a cluster bug.  Over-budget draws
+            # DOWNGRADE to a transient one-shot eio.
+            victims = sorted(st.alive - st.disk_dead)
+            if not victims:
+                continue
+            victim = rng.choice(victims)
+            gap = float(scenario.get("damage_gap", 1.0))
+            damaging = kind in ("bitflip", "disk_dead")
+            if damaging and (
+                st.disk_dead or t - st.last_damage < gap
+            ):
+                kind = "eio"
+            elif kind == "disk_dead" and down_ish >= max_dead:
+                kind = "eio"  # the victim will suicide: kill budget
+            if kind == "disk_dead":
+                st.alive.discard(victim)
+                st.disk_dead.add(victim)
+            if kind in ("bitflip", "disk_dead"):
+                st.last_damage = t
+            st.disk_faulted.add(victim)
+            emit(t, kind, osd=victim)
         elif kind == "balance":
             emit(t, "balance", max_swaps=8)
         elif kind == "partition":
@@ -222,6 +262,13 @@ def generate_schedule(seed: int, scenario: dict) -> list[ChaosEvent]:
     for link in st.oneways:
         emit(t_end, "heal_oneway", src=list(link[0]), dst=list(link[1]))
     emit(t_end, "netem_clear")
+    for osd in sorted(st.disk_faulted):
+        # every fault-touched disk heals at trace end: sticky-dead
+        # disks must heal BEFORE the revive below (a restarted daemon
+        # must not boot onto a store still returning EIO), and an
+        # armed-but-unfired one-shot fault must not fire later, inside
+        # the runner's post-thrash verification sweeps
+        emit(t_end, "disk_heal", osd=osd)
     for osd in sorted(set(range(n_osds)) - st.alive):
         emit(t_end, "osd_revive", osd=osd)
     for osd in sorted(set(range(n_osds)) - st.in_set):

@@ -64,6 +64,11 @@ OP_TIMEOUT = 30.0
 # here but generous — under heavy co-tenant CPU contention a recovering
 # cluster can legitimately answer EAGAIN for a while
 MAX_RETRIES = 25
+# resend backoff: exponential with full jitter, bounded (the
+# objecter_retry/backoff discipline — fixed sleeps synchronize every
+# blocked client into retry storms against a recovering primary)
+BACKOFF_BASE = 0.05
+BACKOFF_MAX = 1.0
 
 
 class RadosError(OSError):
@@ -74,8 +79,13 @@ class RadosClient:
     """The cluster handle (librados::Rados)."""
 
     def __init__(self, client_id: int | None = None, auth=None,
-                 handshake_timeout: float | None = None):
+                 handshake_timeout: float | None = None,
+                 op_timeout: float = 120.0):
         self.id = client_id if client_id is not None else (os.getpid() << 8) | 1
+        # per-op wall-clock budget across ALL resends (librados
+        # rados_osd_op_timeout role): an op that can't complete within
+        # it raises ETIMEDOUT instead of spinning through retries
+        self.op_timeout = op_timeout
         _mkw = {}
         if handshake_timeout is not None:
             _mkw["handshake_timeout"] = handshake_timeout
@@ -346,6 +356,16 @@ class RadosClient:
 
     # -- op engine (Objecter) ------------------------------------------
 
+    async def _backoff(self, attempt: int) -> None:
+        """Bounded exponential backoff with full jitter before a
+        resend.  Jitter decorrelates the resend times of many clients
+        whose ops all failed against the same dead/busy primary —
+        without it every retry round lands as one synchronized burst."""
+        import random
+
+        cap = min(BACKOFF_BASE * (2 ** attempt), BACKOFF_MAX)
+        await asyncio.sleep(cap * (0.5 + random.random() / 2))
+
     async def _submit(self, pool_id: int, op: MOSDOp) -> MOSDOpReply:
         """op_submit/_calc_target/resend loop."""
         last_err = errno.EIO
@@ -354,7 +374,14 @@ class RadosClient:
             # a retried non-idempotent op (append, compound vector) by
             # this id instead of re-applying it
             op.reqid = f"client.{self.id}:{next(self._tids)}"
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.op_timeout
         for _try in range(MAX_RETRIES):
+            if loop.time() >= deadline:
+                raise RadosError(
+                    errno.ETIMEDOUT,
+                    f"op {op.oid!r} timed out after {self.op_timeout}s"
+                    f" ({_try} sends)")
             om = self.osdmap
             pool = om.get_pg_pool(pool_id)
             if pool is None:
@@ -388,10 +415,16 @@ class RadosClient:
             try:
                 conn = await self.messenger.connect_to(("osd", primary), *addr)
                 await conn.send_message(op)
-                reply: MOSDOpReply = await asyncio.wait_for(fut, OP_TIMEOUT)
+                reply: MOSDOpReply = await asyncio.wait_for(
+                    fut, min(OP_TIMEOUT, max(0.5, deadline - loop.time())))
             except (ConnectionError, OSError, asyncio.TimeoutError) as e:
                 log.debug("client: op to osd.%d failed (%r), waiting for map", primary, e)
                 await self._wait_new_map(om.epoch)
+                if self.osdmap is not None and self.osdmap.epoch <= om.epoch:
+                    # no newer map either (e.g. primary dead but not
+                    # yet reported): back off instead of hammering the
+                    # same dead address in a tight loop
+                    await self._backoff(_try)
                 last_err = errno.EIO
                 continue
             finally:
@@ -400,11 +433,11 @@ class RadosClient:
                 # peer had a different map — or a transiently busy
                 # object (recovery/reconcile in flight).  When the map
                 # is NOT newer the wait returns immediately, so back
-                # off a little or 12 retries burn in milliseconds
-                # while the cluster converges.
+                # off (with jitter) or the retry budget burns in
+                # milliseconds while the cluster converges.
                 await self._wait_new_map(min(om.epoch, reply.epoch - 1))
                 if self.osdmap.epoch <= om.epoch:
-                    await asyncio.sleep(min(0.05 * (_try + 1), 0.5))
+                    await self._backoff(_try)
                 last_err = errno.EAGAIN
                 continue
             return reply

@@ -229,6 +229,18 @@ declare(
            "compile the fixed-bucket batched encode/decode shapes of "
            "each EC profile at map-install time so no XLA compile "
            "happens inside the I/O path", enum=("on", "off")),
+    Option("osd_max_object_read_errors", int, 3, LEVEL_ADVANCED,
+           "distinct objects with local medium errors (checksum-at-rest "
+           "EIO) before the osd marks ITSELF failed so peering "
+           "re-places its data — the reference's "
+           "osd_max_object_read_errors / EIO-suicide escalation "
+           "(BlueStore 'osd failure on EIO'); 0 disables escalation",
+           min=0),
+    Option("osd_read_error_repair", bool, True, LEVEL_ADVANCED,
+           "quarantine a shard whose local read returned a medium "
+           "error and requeue a background repair so the damage is "
+           "rebuilt from the surviving members (the reference's "
+           "rep_repair_primary_object read-error repair path)"),
     Option("debug_osd", int, 1, LEVEL_DEV, "osd log verbosity", min=0, max=5),
     Option("debug_mon", int, 1, LEVEL_DEV, "mon log verbosity", min=0, max=5),
 )

@@ -177,6 +177,16 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         self.mon_addr = self.mon_addrs[0]
         self.conf = conf if conf is not None else ConfigProxy()
         self.store = store or MemStore()
+        # scope this store's fault-injection points to this daemon
+        # (store.read.osd.<id> etc — see common/fault_injector.py)
+        self.store.fault_domain = f"osd.{osd_id}"
+        # read-error ledger (the reference's osd_max_object_read_errors
+        # escalation): oid -> local medium-error count.  Enough DISTINCT
+        # damaged objects means the medium, not the object, is dying —
+        # the osd marks itself failed so peering re-places its data.
+        self._read_error_ledger: dict[str, int] = {}
+        self._disk_escalated = False
+        self._death_task: asyncio.Task | None = None
         # multi-device encode farm (production ECSubWrite-fan-out seam,
         # SURVEY.md §2.9); resolved lazily so single-device processes
         # never touch jax at boot
@@ -426,6 +436,12 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                 "ceph_tpu.chaos", fromlist=["dump_chaos"]).dump_chaos(),
         )
         sock.register(
+            "dump_faults", "armed fault-injection points + fired "
+            "counters, this osd's read-error ledger, and the "
+            "process-wide disk-fault counters/spans",
+            lambda cmd: self._dump_faults(),
+        )
+        sock.register(
             "config show", "effective configuration",
             lambda cmd: self.conf.show(),
         )
@@ -447,6 +463,10 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         )
 
     async def stop(self) -> None:
+        if getattr(self, "_stopped", False):
+            return  # a disk-escalated daemon stops itself; the
+            # harness's later stop() must be a no-op
+        self._stopped = True
         self.stopping = True
         if self._admin is not None:
             await self._admin.stop()
@@ -921,6 +941,274 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                     del self._obj_locks[k]
             lk = self._obj_locks[key] = asyncio.Lock()
         return lk
+
+    # -- disk-fault tolerance (read-error ledger + escalation) ---------
+
+    def _dump_faults(self) -> dict:
+        """`dump_faults` admin command: the disk-fault observability
+        plane (armed injection points are process-global; the ledger
+        and escalation flag are this daemon's)."""
+        from ceph_tpu.common.fault_injector import (
+            FAULTS,
+            disk_fault_counters,
+            disk_fault_tracer,
+        )
+
+        return {
+            "armed": FAULTS.dump(),
+            "read_error_ledger": dict(self._read_error_ledger),
+            "escalated": self._disk_escalated,
+            "counters": disk_fault_counters().dump(),
+            "recent": disk_fault_tracer().dump(limit=50),
+        }
+
+    def _note_medium_error(
+        self, pool, pg, shard, oid: str, *, op: str = "read",
+        snap: int = NOSNAP,
+    ) -> None:
+        """A LOCAL store access returned a medium error (checksum-at-
+        rest EIO, injected disk fault).  Responses mirror the
+        reference's chain: count it (perf + disk_fault span), and for
+        reads spawn the verify-quarantine-repair pass
+        (:meth:`_quarantine_shard`) whose CONFIRMED damage feeds the
+        read-error ledger and, past osd_max_object_read_errors
+        distinct objects, escalates to self-markdown.  Write errors
+        only count — clients retry them, and a disk that can no longer
+        write also fails the constant read traffic, which is where the
+        dying-disk verdict belongs."""
+        from ceph_tpu.common.fault_injector import (
+            disk_fault_counters,
+            disk_fault_tracer,
+        )
+
+        self.perf.inc(f"{op}_errors")
+        disk_fault_counters().inc("medium_errors", op=op)
+        with disk_fault_tracer().span(
+            "medium_error", osd=self.id, pg=str(pg), oid=oid, op=op,
+        ):
+            pass
+        log.warning(
+            "osd.%d: medium error (%s) on %s/%s", self.id, op, pg, oid)
+        if op == "read" and self.conf["osd_read_error_repair"]:
+            self._spawn_repair_task(
+                self._quarantine_shard(pool, pg, shard, oid, snap))
+
+    async def _quarantine_shard(self, pool, pg, shard, oid, snap) -> None:
+        """Verify-then-quarantine a shard whose read returned a medium
+        error.
+
+        1. RE-READ: a transient EIO (loose cabling, an injected
+           one-shot) must not cost a healthy shard — only damage that
+           reproduces counts (the bluestore_retry_disk_reads
+           discipline).  Confirmed damage enters the read-error ledger
+           and can escalate to self-markdown.
+        2. Require a HEALTHY ALTERNATIVE (replicated: another member
+           serving >= our version; EC: >= k other readable shards)
+           before dropping the local object — quarantine repairs
+           redundancy, it must never delete the last copy.  Bit rot
+           keeps the kv-side version attrs intact, so without the
+           removal every probe reports the shard healthy and no repair
+           would ever target it.  (Replicated omap is not restored by
+           a push — acceptable for a shard whose data plane already
+           returned EIO.)
+        3. Requeue the background repair when this OSD leads the pg; a
+           replica's hole is found by its primary's next
+           reconcile/scrub pass."""
+        from ceph_tpu.common.fault_injector import disk_fault_counters
+
+        try:
+            async with self._obj_lock(pool.id, oid):
+                c = self._shard_coll(pool, pg, shard)
+                o = (ghobject_t(oid, shard=shard) if snap == NOSNAP
+                     else ghobject_t(oid, snap=snap, shard=shard))
+                if not self.store.exists(c, o):
+                    return
+                try:
+                    if getattr(self.store, "blocking_commit", False):
+                        await asyncio.to_thread(self.store.read, c, o)
+                    else:
+                        self.store.read(c, o)
+                    return  # re-read clean: transient error, keep shard
+                except OSError as e:
+                    if (e.errno or errno.EIO) != errno.EIO:
+                        return
+                # persistent damage confirmed: ledger + escalation
+                ledger = self._read_error_ledger
+                ledger[oid] = ledger.get(oid, 0) + 1
+                disk_fault_counters().inc("persistent_damage")
+                log.warning(
+                    "osd.%d: persistent medium error on %s/%s (%d "
+                    "damaged objects on this disk)", self.id, pg, oid,
+                    len(ledger))
+                thresh = self.conf["osd_max_object_read_errors"]
+                if thresh > 0 and len(ledger) >= thresh:
+                    self._escalate_disk_failure()
+                if not await self._has_healthy_alternative(
+                        pool, pg, shard, oid, snap, c, o):
+                    log.warning(
+                        "osd.%d: NOT quarantining %s/%s: no healthy "
+                        "alternative copy reachable", self.id, pg, oid)
+                    return
+                t = Transaction()
+                t.remove(c, o)
+                if getattr(self.store, "blocking_commit", False):
+                    await asyncio.to_thread(self.store.queue_transaction, t)
+                else:
+                    self.store.queue_transaction(t)
+                disk_fault_counters().inc("quarantined")
+        except OSError:
+            # a dying disk can refuse the removal too; escalation is
+            # the backstop for that state
+            log.exception(
+                "osd.%d: quarantine of %s/%s failed", self.id, pg, oid)
+            return
+        if snap == NOSNAP or not pool.is_erasure():
+            self._queue_object_repair(pool, pg, oid)
+
+    async def _has_healthy_alternative(
+        self, pool, pg, shard, oid, snap, c, o
+    ) -> bool:
+        """True when the damaged shard is reconstructible without us:
+        replicated needs one other member serving >= our version; EC
+        needs >= k other shards answering a data read.  (A 1-byte read
+        verifies the data plane answers, not every blob — the same
+        approximation authoritative-copy selection makes.)"""
+        local_v = self._object_version(c, o)
+        acting, _primary = self._acting(pool, pg)
+        ok = 0
+        need = (self._ec_for(pool).get_data_chunk_count()
+                if pool.is_erasure() else 1)
+        for s, osd in self._pg_members(pool, acting):
+            if osd == self.id and s == shard:
+                continue
+            if osd == CRUSH_ITEM_NONE or not self.osdmap.is_up(osd):
+                continue
+            payload, attrs, _e = await self._read_shard_quiet(
+                pool, pg, s, osd, oid, off=0, length=1, snap=snap)
+            if payload is None:
+                continue
+            if _v_parse((attrs or {}).get(VERSION_ATTR)) >= local_v:
+                ok += 1
+                if ok >= need:
+                    return True
+        return False
+
+    def _spawn_repair_task(self, coro) -> None:
+        t = asyncio.ensure_future(coro)
+        hold = getattr(self, "_repair_tasks", None)
+        if hold is None:
+            hold = self._repair_tasks = set()
+        hold.add(t)
+        t.add_done_callback(hold.discard)
+
+    def _escalate_disk_failure(self) -> None:
+        """Too many distinct objects with medium errors: the disk is
+        dying.  Self-report failure to the mon and stop — peering
+        re-replicates onto healthy OSDs (the reference OSD aborts on
+        repeated EIO and the mon's down/out machinery re-places it)."""
+        if self._disk_escalated:
+            return
+        self._disk_escalated = True
+        from ceph_tpu.common.fault_injector import disk_fault_counters
+
+        self.perf.inc("disk_fault_escalations")
+        disk_fault_counters().inc("escalations")
+        log.error(
+            "osd.%d: %d objects with medium errors >= "
+            "osd_max_object_read_errors; marking self failed and "
+            "shutting down", self.id, len(self._read_error_ledger),
+        )
+
+        async def _die() -> None:
+            try:
+                await self._mon_conn.send_message(MOSDFailure(
+                    reporter=self.id, failed=self.id, epoch=self.epoch,
+                ))
+            except (ConnectionError, OSError, AttributeError):
+                pass  # peers' connection resets will report us instead
+            await self.stop()
+
+        # held OUTSIDE _repair_tasks: stop() cancels those, and the
+        # death task must survive to run stop() itself
+        self._death_task = asyncio.ensure_future(_die())
+
+    async def _rep_degraded_read(
+        self, pool, pg, acting, msg, snap: int
+    ) -> "MOSDOpReply | None":
+        """Serve a read-class vector from the first replica holding the
+        object (primary-local copy quarantined away): READ/STAT/xattr
+        ops answer from the replica's payload+attrs; vectors needing
+        more (omap, class calls) fall back to the caller's ENOENT.
+        Requeues the background repair that restores the local copy."""
+        for osd in acting:
+            if osd in (self.id, CRUSH_ITEM_NONE) or not self.osdmap.is_up(osd):
+                continue
+            payload, attrs, _e = await self._read_shard_quiet(
+                pool, pg, NO_SHARD, osd, msg.oid, snap=snap)
+            if payload is None or (attrs or {}).get(WHITEOUT_ATTR) == b"1":
+                continue
+            attrs = attrs or {}
+            size = int(attrs.get(SIZE_ATTR, len(payload)) or len(payload))
+            outs: list[tuple[int, bytes, dict[str, bytes]]] = []
+            first_read: bytes | None = None
+            for op in msg.ops:
+                r, d, kv = 0, b"", {}
+                if op.op == OP_READ:
+                    end = size if not op.length else min(
+                        op.off + op.length, size)
+                    d = payload[op.off:end]
+                    if first_read is None:
+                        first_read = d
+                elif op.op == OP_STAT:
+                    pass
+                elif op.op == OP_GETXATTR:
+                    v = attrs.get(USER_XATTR_PREFIX + op.name)
+                    if v is None:
+                        r = -errno.ENODATA
+                    else:
+                        d = v
+                elif op.op == OP_GETXATTRS:
+                    kv = {
+                        n[len(USER_XATTR_PREFIX):]: v
+                        for n, v in attrs.items()
+                        if n.startswith(USER_XATTR_PREFIX)
+                    }
+                else:
+                    return None  # vector needs local state we lack
+                outs.append((r, d, kv))
+            self.perf.inc("rep_degraded_read")
+            self._queue_object_repair(pool, pg, msg.oid)
+            result = next((r for r, _d, _kv in outs if r != 0), 0)
+            return MOSDOpReply(
+                tid=msg.tid, result=result, epoch=self.epoch, size=size,
+                data=first_read or b"", outs=outs,
+            )
+        return None
+
+    async def _rep_read_failover(
+        self, pool, pg, acting, o: ghobject_t, off: int, length: int
+    ) -> bytes | None:
+        """Primary-local medium error on a replicated read: serve the
+        bytes from a healthy replica instead of bouncing EIO to the
+        client (the reference primary reads a replica copy and repairs
+        in the background on read errors)."""
+        snap = o.snap if o.snap >= 0 else NOSNAP
+        for osd in acting:
+            if osd in (self.id, CRUSH_ITEM_NONE) or not self.osdmap.is_up(osd):
+                continue
+            payload, _attrs, _e = await self._read_shard_quiet(
+                pool, pg, NO_SHARD, osd, o.name, off=off, length=length,
+                snap=snap,
+            )
+            if payload is not None:
+                self.perf.inc("rep_read_failover")
+                from ceph_tpu.common.fault_injector import (
+                    disk_fault_counters,
+                )
+
+                disk_fault_counters().inc("rep_read_failover")
+                return payload
+        return None
 
     # -- dispatch ------------------------------------------------------
 
@@ -1747,6 +2035,22 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                 tid=msg.tid, result=0, epoch=self.epoch, data=ss.to_bytes())
         resolved = self._resolve_read_object(c, msg.oid, msg.snapid)
         if isinstance(resolved, int):
+            if resolved == errno.ENOENT and msg.oid in self._read_error_ledger:
+                # the hole is OURS: a medium-error quarantine removed
+                # the local copy and its repair hasn't landed yet —
+                # serve the read degraded from a replica instead of
+                # returning ENOENT for an object the cluster still has
+                snap = NOSNAP
+                serve = msg.snapid == NOSNAP
+                if not serve:
+                    tgt = self._load_snapset(c, msg.oid).resolve(msg.snapid)
+                    if tgt is not None and tgt != NOSNAP:
+                        snap, serve = tgt, True
+                if serve:
+                    reply = await self._rep_degraded_read(
+                        pool, pg, acting, msg, snap)
+                    if reply is not None:
+                        return reply
             return MOSDOpReply(
                 tid=msg.tid, result=-resolved, epoch=self.epoch)
         o, _ = resolved
@@ -1756,7 +2060,22 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         for op in msg.ops:
             r, d, kv = 0, b"", {}
             if op.op == OP_READ:
-                d = self.store.read(c, o, op.off, op.length or None)
+                try:
+                    d = self.store.read(c, o, op.off, op.length or None)
+                except OSError as e:
+                    if (e.errno or errno.EIO) != errno.EIO:
+                        raise
+                    # local medium error: fail over to a healthy
+                    # replica instead of returning EIO to the client;
+                    # the ledger/quarantine machinery repairs the local
+                    # copy in the background
+                    self._note_medium_error(
+                        pool, pg, NO_SHARD, msg.oid,
+                        snap=o.snap if o.snap >= 0 else NOSNAP)
+                    d = await self._rep_read_failover(
+                        pool, pg, acting, o, op.off, op.length or 0)
+                    if d is None:
+                        r, d = -errno.EIO, b""
                 if first_read is None:
                     first_read = d
             elif op.op == OP_STAT:

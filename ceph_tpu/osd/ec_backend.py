@@ -581,10 +581,18 @@ class ECBackendMixin:
             pool, pg, shard, oid, payload, attrs, delete, version,
             off, truncate, rmattrs, reqid, clone_snap, clone_snaps,
         )
-        if getattr(self.store, "blocking_commit", False):
-            await asyncio.to_thread(self.store.queue_transaction, t)
-        else:
-            self.store.queue_transaction(t)
+        try:
+            if getattr(self.store, "blocking_commit", False):
+                await asyncio.to_thread(self.store.queue_transaction, t)
+            else:
+                self.store.queue_transaction(t)
+        except OSError as e:
+            # a failed/torn commit is a medium error too: it feeds the
+            # same ledger so a disk that can no longer write escalates
+            # to self-markdown like one that can no longer read
+            if (e.errno or errno.EIO) == errno.EIO:
+                self._note_medium_error(pool, pg, shard, oid, op="write")
+            raise
 
     def _shard_write_txn(
         self, pool, pg, shard, oid, payload, attrs, delete, version,
@@ -731,12 +739,15 @@ class ECBackendMixin:
         ]
         got: dict[int, tuple] = {}
         enoent = 0
+        saw_eio = False
         try:
             for fut in asyncio.as_completed(tasks):
                 shard, (payload, attrs, eno) = await fut
                 if payload is None:
                     if eno == errno.ENOENT:
                         enoent += 1
+                    elif eno == errno.EIO:
+                        saw_eio = True
                     continue
                 got[shard] = (payload, attrs or {})
                 # complete as soon as k shards agree on the newest
@@ -761,6 +772,11 @@ class ECBackendMixin:
             for t in tasks:
                 if not t.done():
                     t.cancel()
+            if saw_eio:
+                # fast read completed (or failed) past a medium-error
+                # shard: background-repair it (EIO-as-erasure)
+                self.perf.inc("ec_eio_decode_around")
+                self._queue_object_repair(pool, pg, oid)
         if enoent and enoent == len(tasks) - len(got):
             raise ECFetchError(errno.ENOENT)
         raise ECFetchError(errno.EIO)
@@ -855,9 +871,18 @@ class ECBackendMixin:
             attrs = next(iter(shard_attrs.values()), {})
             if not attrs or SIZE_ATTR not in attrs:
                 raise ECFetchError(errno.ENOENT)
+            if any(e == errno.EIO for e in excluded.values()):
+                # the read completed by decoding AROUND a medium-error
+                # shard: background-repair the bad shard now so the
+                # degraded window closes (the reference requeues the
+                # object for recovery on shard EIO the same way)
+                self.perf.inc("ec_eio_decode_around")
+                self._queue_object_repair(pool, pg, oid)
             return int(attrs[SIZE_ATTR]), attrs, (chunks if want_data else {})
         if excluded and all(e == errno.ENOENT for e in excluded.values()):
             raise ECFetchError(errno.ENOENT)
+        if any(e == errno.EIO for e in excluded.values()):
+            self._queue_object_repair(pool, pg, oid)
         raise ECFetchError(errno.EIO)
 
     async def _ec_read_vector(
@@ -975,13 +1000,27 @@ class ECBackendMixin:
                  else ghobject_t(oid, snap=snap, shard=shard))
             if not self.store.exists(c, o):
                 return None, None, errno.ENOENT
-            if extents:
-                data = _read_extents(self.store, c, o, extents)
-            else:
-                data = self.store.read(
-                    c, o, off, None if length == 0 else length
-                )
-            return data, self.store.getattrs(c, o), 0
+            try:
+                if extents:
+                    data = _read_extents(self.store, c, o, extents)
+                else:
+                    data = self.store.read(
+                        c, o, off, None if length == 0 else length
+                    )
+                return data, self.store.getattrs(c, o), 0
+            except FileNotFoundError:
+                return None, None, errno.ENOENT
+            except OSError as e:
+                # local medium error (checksum-at-rest EIO): this shard
+                # becomes an ERASURE for the caller — _ec_fetch decodes
+                # around it — while the ledger/quarantine machinery
+                # repairs it in the background (EIO-as-erasure, the
+                # reference's ECBackend shard-EIO handling)
+                eno = e.errno or errno.EIO
+                if eno == errno.EIO:
+                    self._note_medium_error(
+                        pool, pg, shard, oid, snap=snap)
+                return None, None, eno
         tid = next(self._tids)
         rep = await self._traced_sub_op(
             "ec_sub_read", self._op_span.get(), shard, osd,
@@ -1156,7 +1195,12 @@ class ECBackendMixin:
                 # e.g. a checksum-at-rest failure (BlockStore EIO): the
                 # primary excludes this shard and reconstructs from the
                 # others (the reference's shard-EIO path,
-                # ECBackend::handle_sub_read error handling)
+                # ECBackend::handle_sub_read error handling).  Locally
+                # the error feeds the read-error ledger: quarantine +
+                # escalation run on the osd that OWNS the dying disk.
+                if (e.errno or errno.EIO) == errno.EIO:
+                    self._note_medium_error(
+                        pool, msg.pg, msg.shard, msg.oid, snap=msg.snap)
                 rep = MOSDECSubOpReadReply(
                     tid=msg.tid, pg=msg.pg, shard=msg.shard,
                     from_osd=self.id, result=-(e.errno or 5),

@@ -39,6 +39,11 @@ import os
 import struct
 import threading
 
+from ceph_tpu.common.fault_injector import (
+    InjectedError,
+    store_data_fault,
+    store_fault_check,
+)
 from ceph_tpu.kv import FileDB, MemDB, WriteBatch
 from ceph_tpu.native import crc32c
 from ceph_tpu.store.kstore import (
@@ -261,6 +266,7 @@ class BlockStore(ObjectStore):
     def mount(self) -> None:
         from ceph_tpu.store.bluefs import BlueFSLite
 
+        store_fault_check("mount", self.fault_domain)
         self._fd = os.open(
             self._block_path, os.O_RDWR | os.O_CREAT, 0o644)
         bluefs = isinstance(self.db, BlueFSLite)
@@ -340,6 +346,9 @@ class BlockStore(ObjectStore):
     # -- reads ---------------------------------------------------------
 
     def read(self, c, o, off=0, length=None):
+        store_fault_check("read", self.fault_domain)
+        if store_data_fault("read", self.fault_domain, peek=True):
+            self._maybe_flip_bit(c, o)
         # writers commit on a worker thread and may free+reuse a blob's
         # units between our meta load and the pread; a checksum failure
         # with a CHANGED meta is that benign race — reload and retry.
@@ -354,6 +363,24 @@ class BlockStore(ObjectStore):
             except BlobError:
                 last = meta
         raise BlobError(5, f"checksum mismatch in {c}/{o}")
+
+    def _maybe_flip_bit(self, c, o) -> None:
+        """Armed bitflip data fault: corrupt one stored byte of this
+        object's first blob AT REST, so the normal read path's
+        checksum-at-rest verification surfaces it as EIO (the
+        BlueStore bit-rot model).  Objects with no blob (inline-only,
+        absent) leave the fault armed for the next eligible read."""
+        meta = self._meta(c, o)
+        if not meta or not meta.get("extents"):
+            return
+        spec = store_data_fault("read", self.fault_domain)
+        if spec is None or not spec.get("bitflip"):
+            return
+        unit = _parse_blob(meta["extents"][0][1])[0]
+        pos = unit * MIN_ALLOC
+        byte = os.pread(self._fd, 1, pos)
+        if byte:
+            os.pwrite(self._fd, bytes([byte[0] ^ 0x40]), pos)
 
     def _read_with_meta(self, c, o, meta, off=0, length=None):
         size = meta["size"]
@@ -447,6 +474,7 @@ class BlockStore(ObjectStore):
     # -- transactions --------------------------------------------------
 
     def queue_transaction(self, txn: Transaction) -> None:
+        store_fault_check("write", self.fault_domain)
         with self._txn_lock:
             self._validate(txn)
             batch = WriteBatch()
@@ -459,6 +487,16 @@ class BlockStore(ObjectStore):
                 # ordering invariant: blob data durable BEFORE the kv
                 # commit that references it
                 os.fsync(self._fd)
+            tear = store_data_fault("write", self.fault_domain)
+            if tear is not None and tear.get("torn"):
+                # torn write: blob data hit the platter but the kv
+                # batch — the commit point — never lands.  This is
+                # BlockStore's REAL crash shape: the object keeps its
+                # old committed state and the orphaned blobs are
+                # reclaimed by the next mount's fsck-lite sweep.
+                raise InjectedError(
+                    5, "injected torn write (kv commit dropped)")
+            store_fault_check("commit", self.fault_domain)
             self.db.submit(batch)
             for blob in freed:
                 self._deref_blob(blob)

@@ -39,6 +39,7 @@ import json
 import os
 import struct
 
+from ceph_tpu.common.fault_injector import store_fault_check
 from ceph_tpu.kv import MemDB, WriteBatch
 from ceph_tpu.native import crc32c
 
@@ -160,6 +161,7 @@ class BlueFSLite(MemDB):
     def mount(self) -> None:
         """Load the live superblock generation, the checkpoint, and
         replay the WAL chain (the BlueFS mount + rocksdb recovery)."""
+        store_fault_check("mount", "bluefs")
         assert self._fd is not None, "attach() first"
         sb = self._read_super()
         if sb is None:
@@ -260,6 +262,7 @@ class BlueFSLite(MemDB):
     # -- writes --------------------------------------------------------
 
     def submit(self, batch: WriteBatch, sync: bool = True) -> None:
+        store_fault_check("commit", "bluefs")
         body = batch.encode()
         rec = _REC_HDR.pack(_MAGIC, len(body), crc32c(body),
                             self._next_seq) + body

@@ -16,6 +16,10 @@ from __future__ import annotations
 
 import threading
 
+from ceph_tpu.common.fault_injector import (
+    store_data_fault,
+    store_fault_check,
+)
 from ceph_tpu.store.objectstore import (
     ObjectStore,
     Transaction,
@@ -62,13 +66,31 @@ class MemStore(ObjectStore):
             "available": max(0, self.quota_bytes - used),
         }
 
+    def mount(self) -> None:
+        store_fault_check("mount", self.fault_domain)
+
     # -- transactions --------------------------------------------------
 
     def queue_transaction(self, txn: Transaction) -> None:
+        store_fault_check("write", self.fault_domain)
         with self._lock:
             self._validate(txn)
+            tear = store_data_fault("write", self.fault_domain)
+            if tear is not None and tear.get("torn"):
+                # torn write: a prefix of the transaction lands, then
+                # the "disk" dies mid-commit — deliberately violating
+                # the all-or-nothing contract the OSD relies on, which
+                # is exactly what scrub/recovery must then absorb
+                for op in txn.ops[: len(txn.ops) // 2]:
+                    self._apply(op)
+                from ceph_tpu.common.fault_injector import InjectedError
+
+                raise InjectedError(5, "injected torn write (memstore)")
             for op in txn.ops:
                 self._apply(op)
+        # commit point: an error here means state applied but the
+        # caller never learns (the lost-ack flavor of a dying disk)
+        store_fault_check("commit", self.fault_domain)
         for cb in txn.on_applied:
             cb()
         for cb in txn.on_commit:
@@ -202,8 +224,18 @@ class MemStore(ObjectStore):
     # -- reads ---------------------------------------------------------
 
     def read(self, c, o, off=0, length=None):
+        store_fault_check("read", self.fault_domain)
         with self._lock:
             data = self._obj(c, o).data
+            if data and store_data_fault(
+                    "read", self.fault_domain, peek=True):
+                spec = store_data_fault("read", self.fault_domain)
+                if spec is not None and spec.get("bitflip"):
+                    # silent bit rot AT REST: MemStore has no checksums
+                    # (the no-csum store class), so the corruption rides
+                    # out to the caller — only deep scrub's cross-member
+                    # crc comparison can catch it (and repair heal it)
+                    data[len(data) // 2] ^= 0x40
             end = len(data) if length is None else min(off + length, len(data))
             return bytes(data[off:end])
 

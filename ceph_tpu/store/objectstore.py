@@ -157,6 +157,11 @@ class ObjectStore:
     """Abstract store (reference src/os/ObjectStore.h:793 surface, the
     slice the OSD uses)."""
 
+    #: fault-injection scope for this store's FAULTS points
+    #: (``store.<op>.<fault_domain>``); the owning OSD daemon sets it
+    #: to ``osd.<id>`` so tests and the chaos engine can fail ONE disk
+    fault_domain: str = ""
+
     def mount(self) -> None: ...
     def umount(self) -> None: ...
 

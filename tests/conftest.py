@@ -29,3 +29,18 @@ def pytest_configure(config):
         "markers",
         "slow: long-running sweeps excluded from tier-1 (-m 'not slow')",
     )
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_injector():
+    """FAULTS points are process-global: a test that arms one and
+    fails (or forgets) must not leak an armed fault into every later
+    test in the session."""
+    from ceph_tpu.common.fault_injector import FAULTS
+
+    FAULTS.clear()
+    yield
+    FAULTS.clear()

@@ -94,6 +94,58 @@ class TestAdminSocket:
 
         run(go())
 
+    def test_dump_faults_surface(self, tmp_path):
+        """The disk-fault observability plane: armed FAULTS points,
+        fired counters, the per-OSD read-error ledger and the
+        process-wide disk_fault counters/spans, all served over the
+        admin socket's ``dump_faults``."""
+
+        async def go():
+            import errno
+
+            from ceph_tpu.common.fault_injector import FAULTS
+            from ceph_tpu.osd.daemon import object_to_pg
+
+            sock_dir = str(tmp_path)
+            conf = {"admin_socket": sock_dir + "/osd.$id.asok"}
+            async with Cluster(n_osds=3, osd_conf=conf) as c:
+                await c.client.pool_create("df", pg_num=4, size=2)
+                io = c.client.ioctx("df")
+                await io.write_full("df-obj", b"z" * 4096)
+                om = c.client.osdmap
+                pool = om.get_pg_pool(io.pool_id)
+                pg = object_to_pg(pool, "df-obj")
+                _u, _up, _a, primary = om.pg_to_up_acting_osds(pg)
+
+                helptext = await admin_command(
+                    sock_dir + f"/osd.{primary}.asok", "help")
+                assert "dump_faults" in helptext
+                d = await admin_command(
+                    sock_dir + f"/osd.{primary}.asok", "dump_faults")
+                assert d["armed"] == {} and d["read_error_ledger"] == {}
+                assert not d["escalated"]
+
+                # a transient medium error on the primary: armed point
+                # shows fired, the failover counter moves, and the
+                # disk_fault span ring records the event
+                FAULTS.inject(
+                    f"store.read.osd.{primary}", error=errno.EIO, count=1)
+                assert await io.read("df-obj") == b"z" * 4096
+                d = await admin_command(
+                    sock_dir + f"/osd.{primary}.asok", "dump_faults")
+                key = f"store.read.osd.{primary}"
+                assert d["armed"][key]["fired"] == 1
+                assert d["counters"].get("medium_errors", 0) >= 1
+                assert d["counters"].get("medium_errors_opread", 0) >= 1
+                assert any(
+                    sp["tags"].get("oid") == "df-obj"
+                    for sp in d["recent"]
+                )
+                # transient: verification passed, ledger stays empty
+                assert d["read_error_ledger"] == {}
+
+        run(go())
+
     def test_dump_chaos_surface(self, tmp_path):
         """The chaos engine's observability plane: events applied by
         the runner land in the process-wide ``chaos`` counters and
