@@ -226,6 +226,40 @@ def check_cold_launches(before: dict, after: dict) -> list[dict]:
     return out
 
 
+def check_mgr(mgr_stat: dict, expected_daemons: list[str]) -> list[dict]:
+    """``mgr_stat``: the mon's `mgr stat` blob after the cluster
+    settled.  The mgr is never in the data path, so the only mgr
+    invariants are (a) an ACTIVE mgr exists again after the thrash,
+    (b) its report streams RESUMED — every expected live daemon shows
+    in the digest's reporting set with a fresh digest — and (c) its
+    analytics engine minted no cold XLA launches mid-chaos (checked
+    separately via check_cold_launches over the mgr_analytics
+    counters)."""
+    out: list[dict] = []
+    if not mgr_stat.get("active"):
+        out.append({
+            "invariant": "no_active_mgr",
+            "detail": f"MgrMap has no active mgr: {mgr_stat!r}",
+        })
+        return out
+    age = mgr_stat.get("digest_age")
+    if age is None or age > 10.0:
+        out.append({
+            "invariant": "mgr_digest_stale",
+            "detail": f"last digest {age!r}s old — report stream "
+            "never resumed after failover",
+        })
+    reporting = set(mgr_stat.get("reporting") or [])
+    missing = sorted(set(expected_daemons) - reporting)
+    if missing:
+        out.append({
+            "invariant": "mgr_reports_missing",
+            "detail": f"daemons {missing} never re-registered with "
+            f"the active mgr (reporting: {sorted(reporting)})",
+        })
+    return out
+
+
 def check_disk_faults(fsck_reports: list[dict]) -> list[dict]:
     """``fsck_reports``: per-OSD at-rest verification sweeps
     ({"osd": id, "bad": [...]}).  Any blob still failing its checksum
@@ -245,5 +279,5 @@ def check_disk_faults(fsck_reports: list[dict]) -> list[dict]:
 #: checker registry: name -> callable, for reporting
 ALL_INVARIANTS = (
     "history", "final_reads", "converged", "quorum", "scrub",
-    "disk_faults", "cold_launches",
+    "disk_faults", "cold_launches", "mgr",
 )

@@ -100,6 +100,26 @@ SCENARIOS: dict[str, dict] = {
         ],
         "workload": {"objects": 3, "rounds": 3, "object_size": 8192},
     },
+    # mgr-plane chaos: kill/revive manager daemons (active AND
+    # standby) under client load.  Invariants: report streams resume
+    # after every failover (an active mgr exists, every live OSD
+    # re-registers, the digest is fresh), the analytics engine mints
+    # zero cold XLA launches, and — because the mgr is never in the
+    # data path — the client workload invariants are untouched.
+    "mgr-failover": {
+        "name": "mgr-failover",
+        "n_osds": 4, "n_mons": 1, "n_mgrs": 2,
+        "duration": 3.0, "n_events": 8,
+        "mix": {"mgr_kill": 3.0, "osd_kill": 1.0, "scrub": 0.5,
+                "balance": 0.5},
+        "pools": [
+            {"name": "rep", "type": "replicated", "pg_num": 4,
+             "size": 2, "snaps": True},
+            {"name": "ec", "type": "erasure", "pg_num": 2,
+             "k": 2, "m": 1},
+        ],
+        "workload": {"objects": 3, "rounds": 3, "object_size": 8192},
+    },
     # monitor-plane chaos: restarts + osd kills over a 3-mon quorum,
     # plus pg_num splitting mid-storm
     "quorum_thrash": {
@@ -123,7 +143,10 @@ SCENARIOS: dict[str, dict] = {
 def _cold_launch_snapshot() -> dict:
     """cold_launches on the process-wide batchers (delta-checked:
     the collections are process-global and other work may have warmed
-    them before this run)."""
+    them before this run).  The mgr analytics engine follows the same
+    discipline — its prewarm at mgr start cancels the counter, so any
+    growth here is a compile on the digest path."""
+    from ceph_tpu.common.metrics import get_perf_counters
     from ceph_tpu.parallel import decode_batcher, scrub_batcher
 
     return {
@@ -131,6 +154,8 @@ def _cold_launch_snapshot() -> dict:
             decode_batcher.shared().stats.get("cold_launches", 0)),
         "scrub_verify_batch": int(
             scrub_batcher.shared().stats.get("cold_launches", 0)),
+        "mgr_analytics": int(get_perf_counters(
+            "mgr_analytics").dump().get("cold_launches", 0)),
     }
 
 
@@ -145,6 +170,7 @@ class ChaosCluster:
         self.mons: list = []
         self.monmap: list[tuple[str, int]] = []
         self.osds: list = []
+        self.mgrs: list = []
         self.client = None
         self._crush_template = None
         self._heal_tasks: set = set()
@@ -199,6 +225,15 @@ class ChaosCluster:
                 await m.open_quorum(list(self.monmap))
             for m in self.mons:
                 await m.wait_stable()
+        self.mgrs = []
+        if sc.get("n_mgrs"):
+            from ceph_tpu.mgr.daemon import MgrDaemon
+
+            for i in range(sc["n_mgrs"]):
+                mgr = MgrDaemon(self._mgr_name(i), list(self.monmap))
+                self.netem.attach(mgr.messenger)
+                await mgr.start()
+                self.mgrs.append(mgr)
         self.osds = []
         for i in range(sc["n_osds"]):
             osd = OSDDaemon(i, list(self.monmap), store=self._make_store(i))
@@ -232,7 +267,9 @@ class ChaosCluster:
         launch."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            if all(not osd._warm_tasks for osd in self.osds if osd):
+            if all(not osd._warm_tasks for osd in self.osds if osd) \
+                    and all(m._warm_task is None or m._warm_task.done()
+                            for m in self.mgrs if m):
                 return
             await asyncio.sleep(0.05)
 
@@ -250,6 +287,9 @@ class ChaosCluster:
         for osd in self.osds:
             if osd is not None:
                 await osd.stop()
+        for g in self.mgrs:
+            if g is not None:
+                await g.stop()
         for m in self.mons:
             if m is not None:
                 await m.stop()
@@ -396,8 +436,26 @@ class ChaosCluster:
         elif kind in ("eio", "bitflip", "torn_write", "disk_dead",
                       "disk_heal"):
             self._apply_disk_fault(kind, a["osd"])
+        elif kind == "mgr_kill":
+            mgr = self.mgrs[a["mgr"]]
+            if mgr is not None:
+                await mgr.stop()
+                self.mgrs[a["mgr"]] = None
+        elif kind == "mgr_revive":
+            if self.mgrs[a["mgr"]] is None:
+                from ceph_tpu.mgr.daemon import MgrDaemon
+
+                mgr = MgrDaemon(self._mgr_name(a["mgr"]),
+                                list(self.monmap))
+                self.netem.attach(mgr.messenger)
+                await mgr.start()
+                self.mgrs[a["mgr"]] = mgr
         else:
             raise ValueError(f"unknown chaos event kind {kind!r}")
+
+    @staticmethod
+    def _mgr_name(i: int) -> str:
+        return chr(ord("x") + i)
 
     #: FAULTS keys a disk-fault event may arm on one osd's store
     _DISK_FAULT_OPS = ("read", "write", "commit", "mount")
@@ -489,6 +547,29 @@ class ChaosCluster:
                 return []
             await asyncio.sleep(0.2)
         return inv.check_quorum(views)
+
+    async def await_mgr_reports(self, timeout: float = 30.0) -> list:
+        """Poll `mgr stat` until the report plane has healed (an
+        active mgr, every OSD re-registered, fresh digest); returns
+        surviving check_mgr violations (empty = invariant holds).
+        Scenario-trace end revives every killed daemon, so EVERY osd
+        is expected to report."""
+        import json as _json
+
+        expected = [f"osd.{i}" for i in range(self.scenario["n_osds"])]
+        deadline = time.monotonic() + timeout
+        stat: dict = {}
+        while time.monotonic() < deadline:
+            try:
+                code, _rs, data = await self.client.command(
+                    {"prefix": "mgr stat"})
+                stat = _json.loads(data) if code == 0 and data else {}
+            except (OSError, ValueError):
+                stat = {}
+            if not inv.check_mgr(stat, expected):
+                return []
+            await asyncio.sleep(0.3)
+        return inv.check_mgr(stat, expected)
 
     async def deep_scrub_sweep(self, retries: int = 6) -> list[dict]:
         """Deep scrub every PG of every scenario pool; returns reports."""
@@ -656,6 +737,11 @@ async def run_scenario(
                 if not inv.check_disk_faults(fsck_reports):
                     break
         violations["disk_faults"] = inv.check_disk_faults(fsck_reports)
+        if scenario.get("n_mgrs"):
+            # report streams must RESUME after mgr failover (the mgr
+            # itself is never in the data path — every other invariant
+            # above already judged the client workload untouched)
+            violations["mgr"] = await cluster.await_mgr_reports()
         violations["cold_launches"] = inv.check_cold_launches(
             cold_before, _cold_launch_snapshot())
 

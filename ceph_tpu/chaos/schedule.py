@@ -54,6 +54,11 @@ EVENT_KINDS = (
     "torn_write",     # tear the osd's next transaction commit
     "disk_dead",      # sticky EIO on every read+write (dying disk)
     "disk_heal",      # clear every armed store fault on an osd
+    # mgr-plane verbs (the mgr is NEVER in the data path: killing it
+    # may only cost observability — the workload invariants must be
+    # untouched, and report streams must resume after failover)
+    "mgr_kill",       # stop a manager daemon (active or standby)
+    "mgr_revive",     # restart a killed manager (fresh gid)
 )
 
 
@@ -85,7 +90,7 @@ class _TraceState:
     """What the generator must remember about its own trace so every
     drawn event is applicable when replayed in order."""
 
-    def __init__(self, n_osds: int, n_mons: int):
+    def __init__(self, n_osds: int, n_mons: int, n_mgrs: int = 0):
         self.alive = set(range(n_osds))     # daemons running
         self.in_set = set(range(n_osds))    # marked in
         self.partitions: list[tuple] = []   # active symmetric cuts
@@ -95,6 +100,7 @@ class _TraceState:
         self.disk_dead: set[int] = set()    # osds with a sticky-dead disk
         self.disk_faulted: set[int] = set()  # osds with ANY store fault
         self.last_damage = -1e9  # t of the last AT-REST damage event
+        self.mgr_alive = set(range(n_mgrs))  # manager daemons running
 
 
 def _entity_pool(rng: random.Random, scenario: dict) -> list[tuple]:
@@ -133,7 +139,7 @@ def generate_schedule(seed: int, scenario: dict) -> list[ChaosEvent]:
     max_cuts = scenario.get("max_partitions", 1)
     pg_pools = [p["name"] for p in scenario.get("pools", [])] or ["rep"]
 
-    st = _TraceState(n_osds, n_mons)
+    st = _TraceState(n_osds, n_mons, scenario.get("n_mgrs", 0))
     kinds = sorted(mix)
     weights = [float(mix[k]) for k in kinds]
     times = sorted(round(rng.uniform(0.05, duration), 3)
@@ -214,6 +220,20 @@ def generate_schedule(seed: int, scenario: dict) -> list[ChaosEvent]:
                 st.last_damage = t
             st.disk_faulted.add(victim)
             emit(t, kind, osd=victim)
+        elif kind == "mgr_kill":
+            # no down-budget: losing EVERY mgr is legal (observability
+            # gap, not data loss) — but a dead set yields the revive
+            # instead so the trace keeps exercising failovers
+            if not st.mgr_alive:
+                dead_mgrs = sorted(
+                    set(range(scenario.get("n_mgrs", 0))) - st.mgr_alive)
+                if dead_mgrs:
+                    emit(t, "mgr_revive", mgr=dead_mgrs[0])
+                    st.mgr_alive.add(dead_mgrs[0])
+                continue
+            victim = rng.choice(sorted(st.mgr_alive))
+            st.mgr_alive.discard(victim)
+            emit(t, "mgr_kill", mgr=victim)
         elif kind == "balance":
             emit(t, "balance", max_swaps=8)
         elif kind == "partition":
@@ -273,4 +293,7 @@ def generate_schedule(seed: int, scenario: dict) -> list[ChaosEvent]:
         emit(t_end, "osd_revive", osd=osd)
     for osd in sorted(set(range(n_osds)) - st.in_set):
         emit(t_end, "osd_in", osd=osd)
+    for mgr in sorted(set(range(scenario.get("n_mgrs", 0)))
+                      - st.mgr_alive):
+        emit(t_end, "mgr_revive", mgr=mgr)
     return events

@@ -20,6 +20,7 @@ import os
 
 from ceph_tpu.msg.messages import (
     MConfig,
+    MMgrMap,
     MMonCommand,
     MMonCommandAck,
     MMonSubscribe,
@@ -197,6 +198,14 @@ class RadosClient:
                 fut.set_result(msg)
         elif isinstance(msg, MConfig):
             pass  # clients carry no daemon config to apply (yet)
+        elif isinstance(msg, MMgrMap):
+            # the mon broadcasts the MgrMap to every subscriber; hosts
+            # that embed an MgrClient over this session (MDS, the RGW
+            # frontend) register a listener for it
+            self.mgrmap_msg = msg
+            cb = getattr(self, "_mgr_map_cb", None)
+            if cb is not None:
+                cb(msg)
         elif isinstance(msg, MMonCommandAck):
             fut = self._cmd_waiters.get(msg.tid)
             if fut and not fut.done():
@@ -219,6 +228,14 @@ class RadosClient:
                 ))
             except ConnectionError:
                 pass
+
+    def set_mgr_map_listener(self, cb) -> None:
+        """Register a callback for MMgrMap broadcasts on this session
+        (late registration replays the latest map immediately)."""
+        self._mgr_map_cb = cb
+        msg = getattr(self, "mgrmap_msg", None)
+        if msg is not None:
+            cb(msg)
 
     async def _wait_new_map(self, than_epoch: int, timeout: float = 10.0) -> None:
         loop = asyncio.get_running_loop()

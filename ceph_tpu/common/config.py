@@ -243,6 +243,52 @@ declare(
            "rep_repair_primary_object read-error repair path)"),
     Option("debug_osd", int, 1, LEVEL_DEV, "osd log verbosity", min=0, max=5),
     Option("debug_mon", int, 1, LEVEL_DEV, "mon log verbosity", min=0, max=5),
+    # -- manager daemon (ceph_tpu/mgr/) --------------------------------
+    Option("mgr_beacon_interval", float, 0.5, LEVEL_ADVANCED,
+           "seconds between mgr -> mon beacons (reference "
+           "mgr_beacon_period; shorter here to match mini-cluster "
+           "timescales)", min=0.05),
+    Option("mon_mgr_beacon_grace", float, 3.0, LEVEL_ADVANCED,
+           "seconds without a beacon before the mon drops a mgr from "
+           "the MgrMap and promotes a standby (reference "
+           "mon_mgr_beacon_grace; 0 disables the sweep)", min=0.0),
+    Option("mgr_report_interval", float, 0.5, LEVEL_ADVANCED,
+           "seconds between each daemon's MgrClient MMgrReport sends "
+           "(reference mgr_stats_period)", min=0.05),
+    Option("mgr_digest_interval", float, 0.5, LEVEL_ADVANCED,
+           "seconds between the active mgr's analytics pass + "
+           "MMonMgrReport digests back to the mon (reference "
+           "mgr_digest_period role)", min=0.05),
+    Option("mgr_stats_window", int, 32, LEVEL_ADVANCED,
+           "ring-buffer window per (daemon, metric) series in the "
+           "mgr's fixed-shape time-series store; part of the "
+           "prewarmed analytics shape — changing it at runtime would "
+           "mint an in-path XLA compile, so it is read at mgr start",
+           min=4),
+    Option("mgr_stats_max_daemons", int, 16, LEVEL_ADVANCED,
+           "daemon slots in the mgr time-series store (LRU-evicted); "
+           "part of the prewarmed analytics shape", min=1),
+    Option("mgr_stats_max_metrics", int, 12, LEVEL_ADVANCED,
+           "metric slots in the mgr time-series store (overflow "
+           "metrics are counted + dropped, never resized mid-run); "
+           "part of the prewarmed analytics shape", min=1),
+    Option("mgr_analytics_backend", str, "jax", LEVEL_ADVANCED,
+           "cluster analytics engine: jax = one batched launch over "
+           "the whole (daemons x metrics x window) array (prewarmed, "
+           "cold_launches==0 discipline), numpy = host reference "
+           "(bit-identical results)", enum=("jax", "numpy")),
+    Option("mgr_module_tick_interval", float, 0.5, LEVEL_ADVANCED,
+           "seconds between enabled-module tick() calls on the active "
+           "mgr", min=0.05),
+    Option("mgr_balancer_interval", float, 2.0, LEVEL_ADVANCED,
+           "seconds between automated upmap balancer rounds when the "
+           "balancer module is enabled (reference balancer sleep "
+           "interval)", min=0.1),
+    Option("mgr_devicehealth_warn_errors", int, 1, LEVEL_ADVANCED,
+           "verified-damaged-object count at which the devicehealth "
+           "module raises a per-device warning (see "
+           "osd_max_object_read_errors for the osd's own suicide "
+           "threshold)", min=1),
 )
 
 

@@ -23,6 +23,9 @@ class PerfCounters:
         self.name = name
         self._counters: dict[str, float] = defaultdict(float)
         self._gauges: dict[str, float] = {}
+        # shared LatencyHistogram objects (common/optracker.py): the
+        # owner registers its live histogram and exposition renders it
+        self._histograms: dict[str, object] = {}
         self._lock = threading.Lock()
 
     def inc(self, key: str, by: float = 1.0) -> None:
@@ -33,10 +36,24 @@ class PerfCounters:
         with self._lock:
             self._gauges[key] = value
 
+    def register_histogram(self, key: str, hist) -> None:
+        """Attach a live LatencyHistogram (fixed log2 buckets) under
+        ``key`` — rendered by prometheus_text as a real histogram
+        (_bucket/_sum/_count)."""
+        with self._lock:
+            self._histograms[key] = hist
+
     def dump(self) -> dict[str, float]:
         """`perf dump` over the admin socket."""
         with self._lock:
             return {**self._counters, **self._gauges}
+
+    def dump_typed(self) -> tuple[dict[str, float], dict[str, float], dict]:
+        """(counters, gauges, histograms) — the split prometheus
+        exposition needs for its ``# TYPE`` lines."""
+        with self._lock:
+            return (dict(self._counters), dict(self._gauges),
+                    dict(self._histograms))
 
 
 class BucketCounters:
@@ -97,14 +114,42 @@ def _sanitize(s: str) -> str:
     return "".join(ch if (ch.isalnum() or ch == "_") else "_" for ch in s)
 
 
+def histogram_text(metric: str, counts: list[int], sum_us: int,
+                   total: int) -> list[str]:
+    """Proper prometheus histogram exposition for one fixed-shape
+    log2-µs histogram: cumulative ``_bucket`` lines with ``le`` upper
+    bounds in SECONDS, then ``_sum`` (seconds) and ``_count``."""
+    out = [f"# TYPE {metric} histogram"]
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += int(c)
+        le = (1 << (i + 1)) / 1e6  # bucket upper bound, seconds
+        out.append(f'{metric}_bucket{{le="{le:g}"}} {cum}')
+    out.append(f'{metric}_bucket{{le="+Inf"}} {int(total)}')
+    out.append(f"{metric}_sum {sum_us / 1e6:g}")
+    out.append(f"{metric}_count {int(total)}")
+    return out
+
+
 def prometheus_text(collections: dict[str, PerfCounters] | None = None) -> str:
     """Prometheus exposition format over every collection (the
-    mgr/prometheus + ceph-exporter output shape)."""
+    mgr/prometheus + ceph-exporter output shape).  Emits ``# TYPE``
+    lines (counter vs gauge vs histogram); metric NAMES are unchanged
+    from the untyped exposition so scrapers keep their queries."""
     out = []
     for cname, pc in sorted((collections or all_collections()).items()):
-        for key, val in sorted(pc.dump().items()):
+        counters, gauges, hists = pc.dump_typed()
+        typed = {**{k: "counter" for k in counters},
+                 **{k: "gauge" for k in gauges}}
+        merged = {**counters, **gauges}
+        for key in sorted(merged):
             metric = f"ceph_tpu_{_sanitize(cname)}_{_sanitize(key)}"
-            out.append(f"{metric} {val}")
+            out.append(f"# TYPE {metric} {typed[key]}")
+            out.append(f"{metric} {merged[key]}")
+        for key, hist in sorted(hists.items()):
+            metric = f"ceph_tpu_{_sanitize(cname)}_{_sanitize(key)}"
+            out.extend(histogram_text(
+                metric, hist.counts, hist.sum_us, hist.total))
     return "\n".join(out) + "\n"
 
 

@@ -115,6 +115,15 @@ class MDSDaemon:
         # snapshots (SnapRealm-lite): dir ino -> {name: {"id", "t"}}
         self._realms: dict[int, dict] = {}
         self._snap_seq = 0
+        # mgr report stream (MgrMap rides the rados session's mon
+        # subscription; reports go out over our own messenger)
+        from ceph_tpu.common import ConfigProxy, get_perf_counters
+        from ceph_tpu.mgr.client import MgrClient
+
+        self.perf = get_perf_counters(f"mds.{rank}")
+        self.mgr_client = MgrClient(
+            f"mds.{rank}", self.messenger, ConfigProxy(),
+            self._mgr_collect)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -132,11 +141,14 @@ class MDSDaemon:
         for ev in events:
             await self._apply(ev, replay=True)
         self.addr = await self.messenger.bind()
+        self.rados.set_mgr_map_listener(self.mgr_client.handle_mgr_map)
+        self.mgr_client.start()
         log.info("mds.%d: up at %s, replayed %d events",
                  self.rank, self.addr, len(events))
 
     async def stop(self) -> None:
         """Clean shutdown: flush + trim, then drop sessions."""
+        await self.mgr_client.stop()
         async with self._mutation_lock:
             await self._flush()
         await self.messenger.shutdown()
@@ -144,8 +156,20 @@ class MDSDaemon:
 
     async def crash(self) -> None:
         """Test hook: die WITHOUT flushing — restart must replay."""
+        await self.mgr_client.stop()
         await self.messenger.shutdown()
         await self.rados.shutdown()
+
+    def _mgr_collect(self) -> dict:
+        return {
+            "counters": self.perf.dump(),
+            "gauges": {
+                "cached_dirs": float(len(self._dirs)),
+                "sessions": float(len(self._sessions)),
+            },
+            "status": {"rank": self.rank,
+                       "snap_seq": self._snap_seq},
+        }
 
     # -- dirfrag cache (MDCache/CDir) ----------------------------------
 

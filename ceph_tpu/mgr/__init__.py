@@ -1,6 +1,33 @@
-"""Manager-module services (the src/pybind/mgr/ role).
+"""Manager daemon + module services (the ceph-mgr / src/pybind/mgr
+role).
 
-The always-on mgr functions — PG-stat aggregation, health, balancer,
-pg_autoscaler, prometheus text — live in the monitor process
-(ceph_tpu/mon/monitor.py, ceph_tpu/common/metrics.py); this package
-holds the optional module services: the dashboard (dashboard.py)."""
+- :mod:`daemon` — the MgrDaemon process: beacons into the mon
+  (active/standby is the MgrMonitor's call), hosts the DaemonServer
+  report plane (every daemon's MgrClient streams MMgrReport
+  telemetry into fixed-shape ring buffers) and the batched analytics
+  engine, and digests back to the mon (MMonMgrReport — `ceph osd
+  perf`, dashboard views, health checks);
+- :mod:`analytics` — cluster-wide p50/p95/p99, EWMA trends and
+  outlier-OSD detection as ONE jitted reduction over the whole
+  (daemons x metrics x window) array, prewarmed at mgr start
+  (cold_launches == 0), with a bit-identical numpy fallback;
+- :mod:`client` — MgrClient, embedded in OSD/mon/MDS/RGW daemons:
+  watches the MgrMap, re-opens its session after failover, ships
+  perf-counter deltas + log2 latency histograms + status;
+- :mod:`modules` — the module framework (`ceph mgr module
+  ls/enable/disable`) hosting prometheus (cluster-aggregated
+  exposition), devicehealth (read-error-ledger -> device life
+  expectancy + warnings) and balancer (periodic automated upmap
+  rounds, off by default);
+- :mod:`dashboard` — the read-only web UI (serves the mgr's
+  aggregated series when a mgr is active).
+"""
+
+from ceph_tpu.mgr.analytics import AnalyticsEngine, analyze_numpy  # noqa: F401
+from ceph_tpu.mgr.client import MgrClient  # noqa: F401
+from ceph_tpu.mgr.daemon import MgrDaemon, TimeSeriesStore  # noqa: F401
+from ceph_tpu.mgr.modules import (  # noqa: F401
+    DEFAULT_MODULES,
+    MODULE_REGISTRY,
+    MgrModule,
+)

@@ -54,10 +54,12 @@ _PAGE = """<!doctype html>
 <h2>cluster</h2>
 <table>
 <tr><th>mons</th><td>{mons}</td></tr>
+<tr><th>mgr</th><td>{mgr}</td></tr>
 <tr><th>osds</th><td>{osds_up} up / {osds_in} in / {osds_total} total</td></tr>
 <tr><th>map epoch</th><td>{epoch}</td></tr>
 <tr><th>pg states</th><td>{pgs}</td></tr>
 <tr><th>objects</th><td>{objects}</td></tr>
+<tr><th>slowest osds</th><td>{top_slow}</td></tr>
 </table>
 <h2>pools</h2>
 <table><tr><th>id</th><th>name</th><th>type</th><th>pg_num</th>
@@ -94,6 +96,15 @@ class Dashboard:
     async def _api(self, path: str) -> tuple[bytes, bytes]:
         """(body, content_type) for one endpoint."""
         if path == "/metrics":
+            # a live mgr's digest carries the CLUSTER-aggregated
+            # exposition (every daemon's series, rendered by the
+            # prometheus module); fall back to this process's local
+            # collections when no mgr is active
+            digest = getattr(self.mon, "_mgr_digest", None) or {}
+            mgr_map = getattr(self.mon, "_mgr_map", None) or {}
+            if mgr_map.get("active") and digest.get("prometheus"):
+                return (digest["prometheus"].encode(),
+                        b"text/plain; version=0.0.4")
             return prometheus_text().encode(), b"text/plain; version=0.0.4"
         if path == "/api/health":
             return json.dumps(self.mon._health_checks()).encode(), \
@@ -161,8 +172,21 @@ class Dashboard:
         detail = html.escape("; ".join(
             f"{k}: {v.get('summary', '')}"
             for k, v in h.get("checks", {}).items()))
+        mgr_map = getattr(self.mon, "_mgr_map", None) or {}
+        act = mgr_map.get("active")
+        standbys = [sb["name"] for sb in mgr_map.get("standbys", [])]
+        mgr_line = "no daemons" if not act else (
+            f"{act['name']}(active)"
+            + (f", standbys: {', '.join(standbys)}" if standbys else ""))
+        digest = getattr(self.mon, "_mgr_digest", None) or {}
+        top = digest.get("top_slow_osds") or []
+        top_slow = ", ".join(
+            f"{name} ({lat_us:g} &micro;s)" for name, lat_us in top
+        ) or "&mdash;"
         return _PAGE.format(
             hcls=cls, hstatus=status, hdetail=detail,
+            mgr=html.escape(mgr_line),
+            top_slow=top_slow,
             mons=st.get("monmap", {}).get("num_mons",
                                           getattr(self.mon, "n_mons", 1)),
             osds_up=sum(1 for o in range(om.max_osd)

@@ -1,0 +1,239 @@
+"""Mgr module framework + the three initial modules.
+
+The src/pybind/mgr role: the active mgr hosts pluggable modules whose
+enable/disable set lives in the MgrMap (mon-replicated, so it survives
+failover — ``ceph mgr module ls/enable/disable``).  Modules run ONLY
+on the active mgr; a promoted standby reconciles its running set
+against the map within one module tick.
+
+- :class:`PrometheusModule` — cluster-aggregated exposition over HTTP:
+  every reporting daemon's counters/gauges/histograms plus the
+  analytics engine's cluster percentiles, replacing per-process-only
+  scraping (reference src/pybind/mgr/prometheus);
+- :class:`DeviceHealthModule` — consumes the OSDs' read-error-ledger
+  and self-markdown telemetry into per-device health states + life
+  expectancy buckets and health warnings (reference
+  src/pybind/mgr/devicehealth);
+- :class:`BalancerModule` — periodic automated upmap rounds through
+  the mon's ``osd balance`` verb (wrapping osd/balancer.py's
+  UpmapBalancer); **off by default** like any rebalancer that moves
+  data without being asked.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+log = logging.getLogger("ceph_tpu.mgr")
+
+#: name -> module class (the available-modules registry)
+MODULE_REGISTRY: dict[str, type] = {}
+
+#: modules enabled in a fresh MgrMap (balancer is opt-in)
+DEFAULT_MODULES = ("devicehealth", "prometheus")
+
+
+def register(cls):
+    MODULE_REGISTRY[cls.NAME] = cls
+    return cls
+
+
+class MgrModule:
+    """Base module: subclass, set NAME, override start/stop/tick/
+    health as needed.  ``tick`` runs every mgr_module_tick_interval
+    while the module is enabled on the active mgr."""
+
+    NAME = ""
+
+    def __init__(self, mgr):
+        self.mgr = mgr
+        self.running = False
+
+    async def start(self) -> None:
+        self.running = True
+
+    async def stop(self) -> None:
+        self.running = False
+
+    async def tick(self) -> None:
+        pass
+
+    def health(self) -> dict:
+        """Health checks this module contributes to the mgr digest
+        ({CHECK_NAME: {"severity", "summary", "detail"}})."""
+        return {}
+
+
+@register
+class PrometheusModule(MgrModule):
+    """Cluster-aggregated /metrics endpoint on the active mgr."""
+
+    NAME = "prometheus"
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self._server = None
+        self.addr: tuple[str, int] | None = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, "127.0.0.1", 0)
+        self.addr = self._server.sockets[0].getsockname()[:2]
+        await super().start()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        self.addr = None
+        await super().stop()
+
+    def text(self) -> str:
+        """The cluster exposition: per-daemon series under
+        ``ceph_tpu_<daemon>_*`` (typed), per-daemon histograms with
+        proper ``le`` buckets, and the analytics summary under
+        ``ceph_tpu_cluster_*``."""
+        from ceph_tpu.common.metrics import _sanitize, histogram_text
+
+        out: list[str] = []
+        for daemon, sess in sorted(self.mgr.sessions.items()):
+            base = f"ceph_tpu_{_sanitize(daemon)}"
+            for key, val in sorted(sess.get("counters", {}).items()):
+                metric = f"{base}_{_sanitize(key)}"
+                out.append(f"# TYPE {metric} counter")
+                out.append(f"{metric} {val}")
+            for key, val in sorted(sess.get("gauges", {}).items()):
+                metric = f"{base}_{_sanitize(key)}"
+                out.append(f"# TYPE {metric} gauge")
+                out.append(f"{metric} {val}")
+            for cls, h in sorted(sess.get("histograms", {}).items()):
+                counts = list(h)
+                # cumulative sum/count are not on the wire per bucket;
+                # derive count, approximate sum from bucket mids is
+                # dishonest — use the daemon's reported mean gauge
+                total = int(sum(counts))
+                mean = sess.get("gauges", {}).get(f"{cls}_lat_us", 0.0)
+                out.extend(histogram_text(
+                    f"{base}_{_sanitize(cls)}_latency", counts,
+                    int(mean * total), total))
+        for line in self.mgr.cluster_metric_lines():
+            out.append(line)
+        return "\n".join(out) + "\n"
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            req = await asyncio.wait_for(reader.readline(), 5)
+            while True:
+                line = await asyncio.wait_for(reader.readline(), 5)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            path = req.split(b" ")[1].decode() if b" " in req else "/"
+            if path == "/metrics":
+                body, status = self.text().encode(), b"200 OK"
+            else:
+                body, status = b"see /metrics\n", b"404 Not Found"
+            writer.write(
+                b"HTTP/1.1 " + status + b"\r\n"
+                b"Content-Type: text/plain; version=0.0.4\r\n"
+                b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                b"Connection: close\r\n\r\n" + body
+            )
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError, IndexError):
+            pass
+        finally:
+            writer.close()
+
+
+@register
+class DeviceHealthModule(MgrModule):
+    """Per-device health from real error telemetry: each OSD's report
+    status carries its read-error ledger size and self-markdown flag;
+    the module folds them into device states + a health warning."""
+
+    NAME = "devicehealth"
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        #: daemon -> {"errors", "state", "life_expectancy"}
+        self.devices: dict[str, dict] = {}
+
+    async def tick(self) -> None:
+        warn_at = self.mgr.conf["mgr_devicehealth_warn_errors"]
+        max_err = max(warn_at, 1)
+        for daemon, sess in self.mgr.sessions.items():
+            if not daemon.startswith("osd."):
+                continue
+            st = sess.get("status") or {}
+            errors = int(st.get("read_errors", 0))
+            escalated = bool(st.get("disk_escalated", False))
+            if escalated:
+                state, life = "failed", "expired"
+            elif errors >= max_err * 2:
+                state, life = "failing", "imminent"
+            elif errors >= warn_at:
+                state, life = "warning", "reduced"
+            else:
+                state, life = "good", "normal"
+            self.devices[daemon] = {
+                "errors": errors,
+                "escalated": escalated,
+                "state": state,
+                "life_expectancy": life,
+            }
+
+    def health(self) -> dict:
+        bad = {d: v for d, v in self.devices.items()
+               if v["state"] != "good"}
+        if not bad:
+            return {}
+        return {
+            "DEVICE_HEALTH": {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(bad)} device(s) with degraded health",
+                "detail": [
+                    f"{d}: {v['state']} ({v['errors']} verified read "
+                    f"errors, life expectancy {v['life_expectancy']})"
+                    for d, v in sorted(bad.items())
+                ],
+            }
+        }
+
+
+@register
+class BalancerModule(MgrModule):
+    """Automated upmap rounds (off by default): every
+    mgr_balancer_interval the module asks the mon to run one
+    ``osd balance`` pass (UpmapBalancer under the hood)."""
+
+    NAME = "balancer"
+    DEFAULT_OFF = True
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self._last_run = 0.0
+        self.rounds = 0
+        self.last_swaps = -1
+
+    async def tick(self) -> None:
+        interval = self.mgr.conf["mgr_balancer_interval"]
+        now = time.monotonic()
+        if now - self._last_run < interval:
+            return
+        self._last_run = now
+        try:
+            code, _rs, data = await self.mgr.mon_command({
+                "prefix": "osd balance", "max_swaps": "16"})
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            return
+        if code == 0:
+            import json
+
+            self.rounds += 1
+            try:
+                self.last_swaps = json.loads(data).get("swaps", -1)
+            except ValueError:
+                self.last_swaps = -1

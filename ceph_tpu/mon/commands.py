@@ -40,10 +40,11 @@ class CommandMixin:
             if not capable(caps, "mon", need):
                 return -errno.EACCES, "access denied", b""
         mutating = prefix in self.WRITE_PREFIXES or prefix in (
-            # not mutations, but only the leader ingests pg stats and
-            # knows the live quorum: redirect so peons don't serve an
-            # empty status plane
+            # not mutations, but only the leader ingests pg stats /
+            # mgr digests and knows the live quorum: redirect so peons
+            # don't serve an empty status plane
             "status", "health", "pg stat", "df", "osd df",
+            "osd perf", "mgr stat",
         )
         if mutating and not self.is_leader:
             leader = self.paxos.leader if self.paxos.leader is not None else -1
@@ -247,6 +248,9 @@ class CommandMixin:
                     },
                     "pgs": pgsum,
                     "health": self._health_checks(pgsum),
+                    # the `ceph status` mgr line (reference mgrmap
+                    # summary: "mgr: x(active), standbys: y")
+                    "mgr": self._mgr_status_block(),
                 }).encode()
                 return 0, "", data
             if prefix == "config set":
@@ -416,8 +420,69 @@ class CommandMixin:
                 # here; pools with pg_autoscale_mode=on get the advice
                 # APPLIED by _autoscale_tick (pg splitting exists now)
                 return 0, "", json.dumps(self._autoscale_rows()).encode()
+            if prefix == "mgr dump":
+                return 0, "", json.dumps(self._mgr_map).encode()
+            if prefix == "mgr stat":
+                return 0, "", json.dumps(self._mgr_stat()).encode()
+            if prefix == "mgr module ls":
+                from ceph_tpu.mgr.modules import MODULE_REGISTRY
+
+                return 0, "", json.dumps({
+                    "enabled_modules": list(self._mgr_map["modules"]),
+                    "available_modules": sorted(MODULE_REGISTRY),
+                }).encode()
+            if prefix in ("mgr module enable", "mgr module disable"):
+                from ceph_tpu.mgr.modules import MODULE_REGISTRY
+
+                module = cmd["module"]
+                if module not in MODULE_REGISTRY:
+                    return -errno.ENOENT, f"no module {module!r}", b""
+                enable = prefix.endswith("enable")
+                await self._propose({
+                    "op": "mgr_module", "module": module,
+                    "enable": enable,
+                })
+                verb = "enabled" if enable else "disabled"
+                return 0, f"module {module!r} {verb}", b""
+            if prefix == "mgr fail":
+                # drop the named (or active) mgr from the map NOW —
+                # the operator's manual failover lever
+                name = cmd.get("who", "")
+                act = self._mgr_map.get("active")
+                if not name and act is not None:
+                    name = act["name"]
+                known = [r["name"] for r in
+                         [act, *self._mgr_map["standbys"]] if r]
+                if name not in known:
+                    return -errno.ENOENT, f"no mgr {name!r}", b""
+                await self._propose({"op": "mgr_down", "name": name})
+                return 0, f"mgr.{name} failed", b""
+            if prefix == "osd perf":
+                # per-OSD commit/apply latency from the mgr's
+                # time-series store (reference `ceph osd perf`, served
+                # by the mgr digest plane)
+                d = self._mgr_digest or {}
+                return 0, "", json.dumps({
+                    "osd_perf_infos": [
+                        {"id": int(osd), **row}
+                        for osd, row in sorted(
+                            d.get("osd_perf", {}).items(),
+                            key=lambda kv: int(kv[0]))
+                    ],
+                    "source_mgr": d.get("active"),
+                }).encode()
             if prefix == "health":
                 h = self._health_checks()
+                # module health checks ride the mgr digest (reference
+                # MMonMgrReport carrying the mgr's health_checks)
+                for name, chk in ((self._mgr_digest or {}).get(
+                        "health", {}) or {}).items():
+                    h["checks"][name] = chk
+                    if (chk.get("severity") == "HEALTH_ERR"
+                            or h["status"] == "HEALTH_ERR"):
+                        h["status"] = "HEALTH_ERR"
+                    elif h["status"] == "HEALTH_OK":
+                        h["status"] = "HEALTH_WARN"
                 return 0, h["status"], json.dumps(h).encode()
             if prefix == "pg stat":
                 book = getattr(self, "_pg_stats", {}) or {}

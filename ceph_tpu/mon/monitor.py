@@ -34,8 +34,10 @@ import time
 from ceph_tpu.crush.types import CrushMap
 from ceph_tpu.msg.messages import (
     MConfig,
+    MMgrBeacon,
     MMonCommand,
     MMonCommandAck,
+    MMonMgrReport,
     MMonSubscribe,
     MOSDBeacon,
     MOSDBoot,
@@ -52,12 +54,13 @@ log = logging.getLogger("ceph_tpu.mon")
 from ceph_tpu.mon.auth_service import AuthServiceMixin  # noqa: E402
 from ceph_tpu.mon.commands import CommandMixin  # noqa: E402
 from ceph_tpu.mon.config_service import ConfigServiceMixin  # noqa: E402
+from ceph_tpu.mon.mgr_service import MgrServiceMixin  # noqa: E402
 from ceph_tpu.mon.osd_service import OSDMonitorMixin  # noqa: E402
 from ceph_tpu.mon.stats_service import StatsServiceMixin  # noqa: E402
 
 
-class Monitor(OSDMonitorMixin, StatsServiceMixin, AuthServiceMixin,
-              ConfigServiceMixin, CommandMixin):
+class Monitor(OSDMonitorMixin, StatsServiceMixin, MgrServiceMixin,
+              AuthServiceMixin, ConfigServiceMixin, CommandMixin):
     def __init__(
         self,
         crush: CrushMap | None = None,
@@ -154,6 +157,17 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, AuthServiceMixin,
             set(auth.keyring) if auth is not None else set()
         )
         self._next_pool = 1
+        # MgrMap state (mon/mgr_service.py) — must predate replay
+        self._init_mgr_service()
+        # the mon's own report stream to the active mgr (every daemon
+        # carries one); fed the map directly on publish — the mon is
+        # its own MgrMap source
+        from ceph_tpu.common import get_perf_counters
+        from ceph_tpu.mgr.client import MgrClient
+
+        self.perf = get_perf_counters(f"mon.{rank}")
+        self.mgr_client = MgrClient(
+            f"mon.{rank}", self.messenger, conf0, self._mgr_collect)
         self._tids = itertools.count(1)
         self._scrub_waiters: dict[int, asyncio.Future] = {}
         self._tick_task: asyncio.Task | None = None
@@ -203,6 +217,8 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, AuthServiceMixin,
             )
             await self._admin.start()
         await self._replay()
+        self._start_mgr_tick()
+        self.mgr_client.start()
         if self.beacon_grace > 0:
             self._tick_task = asyncio.ensure_future(self._tick())
         if self.conf["mon_pg_autoscale_interval"] > 0:
@@ -249,6 +265,7 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, AuthServiceMixin,
             "up_from": {str(k): v for k, v in self._up_from.items()},
             "config_db": self._config_db,
             "auth_db": self._auth_db,
+            "mgr_map": self._mgr_map,
         }))
         return self._state_version, enc.bytes()
 
@@ -270,6 +287,8 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, AuthServiceMixin,
         }
         self._config_db = dict(aux.get("config_db", {}))
         self._auth_db = dict(aux.get("auth_db", {}))
+        if aux.get("mgr_map"):
+            self._mgr_map = dict(aux["mgr_map"])
         self._sync_auth_keyring()
         self._apply_config_locally()
         self._up_from = {
@@ -331,10 +350,13 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, AuthServiceMixin,
         await asyncio.wait_for(self.paxos.stable.wait(), timeout)
 
     async def stop(self) -> None:
+        await self.mgr_client.stop()
         if self._admin is not None:
             await self._admin.stop()
         if self._tick_task:
             self._tick_task.cancel()
+        if self._mgr_tick_task:
+            self._mgr_tick_task.cancel()
         if self._probe_task:
             self._probe_task.cancel()
         if getattr(self, "_autoscale_task", None):
@@ -431,12 +453,35 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, AuthServiceMixin,
         if kind in ("auth_upsert", "auth_del"):
             await self._apply_auth_op(op)
             return  # auth changes don't mint osdmap epochs
+        if kind in ("mgr_beacon", "mgr_down", "mgr_module"):
+            await self._apply_mgr_op(op)
+            return  # MgrMap has its own epoch sequence
         if await self._apply_osd_op(op):
             await self._new_epoch()
 
     @property
     def is_leader(self) -> bool:
         return self.paxos.is_leader
+
+    def _mgr_collect(self) -> dict:
+        """This monitor's MMgrReport raw material."""
+        self.perf.set_gauge("osdmap_epoch", float(self.osdmap.epoch))
+        self.perf.set_gauge(
+            "paxos_last_committed", float(self.paxos.last_committed))
+        return {
+            "counters": {
+                k: v for k, v in self.perf.dump().items()
+                if k not in ("osdmap_epoch", "paxos_last_committed")
+            },
+            "gauges": {
+                "osdmap_epoch": float(self.osdmap.epoch),
+                "quorum_size": float(len(self.paxos.quorum)),
+            },
+            "status": {
+                "leader": self.paxos.leader,
+                "is_leader": self.is_leader,
+            },
+        }
 
     # -- map publication ----------------------------------------------
 
@@ -466,9 +511,14 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, AuthServiceMixin,
                 await self._forward_to_leader(msg)
         elif isinstance(msg, MOSDFailure):
             await self._handle_failure(msg)
+        elif isinstance(msg, MMgrBeacon):
+            await self._handle_mgr_beacon(msg)
+        elif isinstance(msg, MMonMgrReport):
+            await self._handle_mgr_report(msg)
         elif isinstance(msg, MMonSubscribe):
             self._subscribers[msg.src] = msg.conn
             await msg.conn.send_message(self._maps_since(msg.start_epoch))
+            await msg.conn.send_message(self._mgr_map_msg())
             secs = self._config_sections_for(msg.src)
             if secs:
                 await msg.conn.send_message(MConfig(sections=secs))
@@ -533,6 +583,7 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, AuthServiceMixin,
         "osd pool set", "osd pool rm", "osd in",
         "osd tier add", "osd tier remove", "osd tier cache-mode",
         "osd tier set-overlay", "osd tier remove-overlay",
+        "mgr module enable", "mgr module disable", "mgr fail",
     })
 
 

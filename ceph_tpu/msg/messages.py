@@ -1130,6 +1130,172 @@ class MBackfillReserve(Message):
                    dec.i32())
 
 
+# -- mgr plane (src/messages/MMgrBeacon.h, MMgrMap.h, MMgrOpen.h,
+# MMgrReport.h, MMgrConfigure.h, MMonMgrReport.h) ---------------------------
+
+def _enc_map_str_f64(enc: Encoder, d: dict[str, float]) -> None:
+    """Float maps ride as repr strings (the denc layer is int/bytes
+    only; repr round-trips doubles exactly)."""
+    enc.u32(len(d))
+    for k in sorted(d):
+        enc.str_(k)
+        enc.str_(repr(float(d[k])))
+
+
+def _dec_map_str_f64(dec: Decoder) -> dict[str, float]:
+    return {dec.str_(): float(dec.str_()) for _ in range(dec.u32())}
+
+
+class MMgrBeacon(Message):
+    """mgr -> mon: I exist (active or standby is the MON's call —
+    reference MMgrBeacon / MgrMonitor::prepare_beacon).  ``gid`` is
+    fresh per daemon start, so the mon can tell a restarted mgr from a
+    paxos replay of the same beacon."""
+
+    TYPE = 120
+
+    def __init__(self, name: str = "", gid: int = 0, host: str = "",
+                 port: int = 0):
+        self.name, self.gid, self.host, self.port = name, gid, host, port
+
+    def encode_payload(self, enc):
+        enc.str_(self.name)
+        enc.u64(self.gid)
+        enc.str_(self.host)
+        enc.u32(self.port)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        return cls(dec.str_(), dec.u64(), dec.str_(), dec.u32())
+
+
+class MMgrMap(Message):
+    """mon -> subscribers: the MgrMap (reference MMgrMap) — who is the
+    active mgr, the standbys, and the enabled-module set.  ``blob`` is
+    the json map; ``epoch`` is the MgrMap's own epoch (NOT an osdmap
+    epoch)."""
+
+    TYPE = 121
+
+    def __init__(self, epoch: int = 0, blob: bytes = b""):
+        self.epoch, self.blob = epoch, blob
+
+    def encode_payload(self, enc):
+        enc.u32(self.epoch)
+        enc.bytes_(self.blob)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        return cls(dec.u32(), dec.bytes_())
+
+
+class MMgrOpen(Message):
+    """daemon -> active mgr: open a report session (reference
+    MMgrOpen).  The mgr answers with MMgrConfigure."""
+
+    TYPE = 122
+
+    def __init__(self, daemon: str = "", metadata: bytes = b""):
+        self.daemon = daemon  # "osd.0", "mon.1", "mds.0", "rgw.main"
+        self.metadata = metadata  # json daemon metadata
+
+    def encode_payload(self, enc):
+        enc.str_(self.daemon)
+        enc.bytes_(self.metadata)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        return cls(dec.str_(), dec.bytes_())
+
+
+class MMgrConfigure(Message):
+    """active mgr -> daemon: report-stream tuning (reference
+    MMgrConfigure: stats_period)."""
+
+    TYPE = 123
+
+    def __init__(self, period: float = 1.0):
+        self.period = period
+
+    def encode_payload(self, enc):
+        enc.str_(repr(float(self.period)))
+
+    @classmethod
+    def decode_payload(cls, dec):
+        return cls(float(dec.str_()))
+
+
+class MMgrReport(Message):
+    """daemon -> active mgr: one telemetry report (reference
+    MMgrReport carrying packed PerfCounterInstances).
+
+    - ``counters``: perf-counter DELTAS since the previous report
+      (the mgr accumulates them back into cumulative series);
+    - ``gauges``: instantaneous values (also the per-interval latency
+      means the time-series ring buffers ingest);
+    - ``histograms``: cumulative fixed-bucket log2 latency histograms
+      (common/optracker.py LatencyHistogram), mergeable as arrays;
+    - ``status``: json side-channel (pg-state summary, the disk
+      read-error ledger, daemon health bits).
+    """
+
+    TYPE = 124
+
+    def __init__(self, daemon: str = "", counters: dict | None = None,
+                 gauges: dict | None = None,
+                 histograms: dict[str, list[int]] | None = None,
+                 status: bytes = b""):
+        self.daemon = daemon
+        self.counters = counters or {}
+        self.gauges = gauges or {}
+        self.histograms = histograms or {}
+        self.status = status
+
+    def encode_payload(self, enc):
+        enc.str_(self.daemon)
+        _enc_map_str_f64(enc, self.counters)
+        _enc_map_str_f64(enc, self.gauges)
+        enc.u32(len(self.histograms))
+        for k in sorted(self.histograms):
+            enc.str_(k)
+            buckets = self.histograms[k]
+            enc.u32(len(buckets))
+            for b in buckets:
+                enc.u64(int(b))
+        enc.bytes_(self.status)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        daemon = dec.str_()
+        counters = _dec_map_str_f64(dec)
+        gauges = _dec_map_str_f64(dec)
+        histograms = {
+            dec.str_(): [dec.u64() for _ in range(dec.u32())]
+            for _ in range(dec.u32())
+        }
+        return cls(daemon, counters, gauges, histograms, dec.bytes_())
+
+
+class MMonMgrReport(Message):
+    """active mgr -> mon: the cluster digest (reference MMonMgrReport:
+    health + service digest).  ``blob`` is json — per-OSD perf rows
+    for `ceph osd perf`, the analytics summary (percentiles, outlier
+    OSDs, top-slow list), module health checks, and optionally the
+    rendered prometheus exposition the dashboard serves."""
+
+    TYPE = 125
+
+    def __init__(self, blob: bytes = b""):
+        self.blob = blob
+
+    def encode_payload(self, enc):
+        enc.bytes_(self.blob)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        return cls(dec.bytes_())
+
+
 # -- cephfs client <-> mds (src/messages/MClientRequest.h) ------------------
 
 class MClientRequest(Message):

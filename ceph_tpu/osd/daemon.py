@@ -46,6 +46,7 @@ from ceph_tpu.ec import registry as ec_registry
 from ceph_tpu.msg.messages import (
     PING,
     PING_REPLY,
+    MMgrMap,
     MMonSubscribe,
     MConfig,
     MOSDBeacon,
@@ -230,6 +231,21 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             history_size=self.conf["osd_op_history_size"],
             slow_threshold=self.conf["osd_op_complaint_time"],
         )
+        # eager per-class latency histograms, shared with the local
+        # prometheus exposition (proper _bucket/_sum/_count rendering)
+        from ceph_tpu.common.optracker import LatencyHistogram
+
+        for cls_ in ("read", "write", "subop_w"):
+            h = self.op_tracker.histograms[cls_] = LatencyHistogram()
+            self.perf.register_histogram(f"{cls_}_latency", h)
+        # mgr report stream (ceph_tpu/mgr/client.py): watches the
+        # MgrMap from the mon, streams perf deltas + log2 latency
+        # histograms + pg/ledger status to the active mgr
+        from ceph_tpu.mgr.client import MgrClient
+
+        self.mgr_client = MgrClient(
+            f"osd.{osd_id}", self.messenger, self.conf,
+            self._mgr_collect)
         self.dlog = DoutLogger("osd", self.conf, name_suffix=str(osd_id))
         self._admin: object | None = None
         self._log_keep = self.conf["osd_min_pg_log_entries"]
@@ -364,6 +380,7 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             self._register_admin_commands(self._admin)
             await self._admin.start()
         await self._mon_hunt()
+        self.mgr_client.start()
         if self.beacon_interval > 0:
             self._beacon_task = asyncio.ensure_future(self._beacon())
         if self.conf["osd_heartbeat_interval"] > 0:
@@ -413,6 +430,11 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         sock.register(
             "dump_historic_slow_ops", "ops over the complaint threshold",
             lambda cmd: self.op_tracker.dump_historic_slow_ops(),
+        )
+        sock.register(
+            "perf histogram dump", "per-op-class log2 latency "
+            "histograms (fixed bucket count; the MMgrReport payload)",
+            lambda cmd: self.op_tracker.dump_histograms(),
         )
         sock.register(
             "dump_traces", "recent spans (blkin/otel role)",
@@ -468,6 +490,7 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             # harness's later stop() must be a no-op
         self._stopped = True
         self.stopping = True
+        await self.mgr_client.stop()
         if self._admin is not None:
             await self._admin.stop()
         for t in (
@@ -574,6 +597,35 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                 out[f"{pid}.{ps}"] = {
                     "state": state, "objects": n_obj, "bytes": n_bytes}
         return _json.dumps(out).encode()
+
+    def _mgr_collect(self) -> dict:
+        """Raw material for this OSD's MMgrReport (mgr/client.py
+        derives counter deltas + interval latency means from it)."""
+        import json as _json
+
+        pg_states: dict[str, int] = {}
+        try:
+            for st in _json.loads(
+                    self._collect_pg_stats() or b"{}").values():
+                s = st.get("state", "unknown")
+                pg_states[s] = pg_states.get(s, 0) + 1
+        except ValueError:
+            pass
+        return {
+            "counters": self.perf.dump(),
+            "gauges": {
+                "num_pgs": float(len(self._pg_logs)),
+                "inflight_ops": float(len(self.op_tracker.inflight)),
+            },
+            "histograms": dict(self.op_tracker.histograms),
+            "status": {
+                "pg_states": pg_states,
+                # the disk-fault telemetry devicehealth consumes
+                "read_errors": len(self._read_error_ledger),
+                "disk_escalated": self._disk_escalated,
+                "slow_ops": self.op_tracker.complaints,
+            },
+        }
 
     @property
     def epoch(self) -> int:
@@ -1216,6 +1268,8 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         try:
             if isinstance(msg, MOSDMap):
                 await self._handle_map(msg)
+            elif isinstance(msg, MMgrMap):
+                self.mgr_client.handle_mgr_map(msg)
             elif isinstance(msg, MConfig):
                 self._apply_mon_config(msg)
             elif isinstance(msg, MOSDPing):
@@ -1225,11 +1279,20 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             elif isinstance(msg, MOSDOp):
                 asyncio.ensure_future(self._handle_client_op(msg))
             elif isinstance(msg, MOSDECSubOpWrite):
+                t0 = time.monotonic()
                 await self._handle_sub_write(msg)
+                # shard apply latency — the `ceph osd perf`
+                # apply_latency source (never a TrackedOp: sub-op
+                # service must stay admission-free)
+                self.op_tracker.record_latency(
+                    "subop_w", time.monotonic() - t0)
             elif isinstance(msg, MOSDECSubOpRead):
                 await self._handle_sub_read(msg)
             elif isinstance(msg, MOSDRepOp):
+                t0 = time.monotonic()
                 await self._handle_rep_op(msg)
+                self.op_tracker.record_latency(
+                    "subop_w", time.monotonic() - t0)
             elif isinstance(msg, MOSDPGPush):
                 await self._handle_push(msg)
             elif isinstance(msg, MOSDPGQuery):
@@ -1740,7 +1803,8 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
     async def _handle_client_op(self, msg: MOSDOp) -> None:
         tracked = self.op_tracker.create(
             f"osd_op({msg.oid} pool={msg.pool} "
-            f"ops={[o.op for o in msg.ops]} tid={msg.tid})"
+            f"ops={[o.op for o in msg.ops]} tid={msg.tid})",
+            op_class="write" if msg.is_write() else "read",
         )
         try:
             self.perf.inc("op")

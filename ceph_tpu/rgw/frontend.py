@@ -82,6 +82,24 @@ class S3Frontend:
         self.store = store
         self.host, self.port = host, port
         self._server: asyncio.AbstractServer | None = None
+        # mgr report stream: the MgrMap rides the store's rados
+        # session (mon subscription); reports dial out over the same
+        # client messenger — rgw has no daemon messenger of its own
+        from ceph_tpu.common import ConfigProxy, get_perf_counters
+        from ceph_tpu.mgr.client import MgrClient
+
+        self.perf = get_perf_counters("rgw.main")
+        rados = store.meta.client
+        self.mgr_client = MgrClient(
+            "rgw.main", rados.messenger, ConfigProxy(),
+            self._mgr_collect)
+        self._rados = rados
+
+    def _mgr_collect(self) -> dict:
+        return {
+            "counters": self.perf.dump(),
+            "status": {"frontend": f"{self.host}:{self.port}"},
+        }
 
     @property
     def addr(self) -> tuple[str, int]:
@@ -91,9 +109,12 @@ class S3Frontend:
         self._server = await asyncio.start_server(
             self._serve, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+        self._rados.set_mgr_map_listener(self.mgr_client.handle_mgr_map)
+        self.mgr_client.start()
         log.info("rgw: listening on %s:%d", self.host, self.port)
 
     async def stop(self) -> None:
+        await self.mgr_client.stop()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -108,6 +129,9 @@ class S3Frontend:
                 if req is None:
                     break
                 status, headers, body = await self._handle(req)
+                self.perf.inc("req")
+                if status >= 400:
+                    self.perf.inc("req_err")
                 await self._respond(writer, status, headers, body,
                                     head_only=req.method == "HEAD")
         except (ConnectionError, asyncio.IncompleteReadError):

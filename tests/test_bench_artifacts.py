@@ -105,11 +105,12 @@ def _chaos_artifacts() -> list[str]:
 def test_chaos_artifact_cited_and_green():
     """The chaos engine's honesty contract: the README must cite a
     committed CHAOS artifact; each artifact must cover >= 2 scenarios
-    x >= 8 seeds (r08 carries 3; r09 adds disk-fault + a regression
-    column) with EVERY invariant green and a trace hash per run."""
+    x >= 8 seeds (r08 carries 3; r09 adds disk-fault; r10 adds
+    mgr-failover + a regression column) with EVERY invariant green
+    and a trace hash per run."""
     cited = _chaos_artifacts()
     assert cited, "README must cite the committed CHAOS artifact"
-    assert len(cited) >= 2, "both CHAOS_r08 and CHAOS_r09 stay cited"
+    assert len(cited) >= 3, "CHAOS_r08/r09/r10 stay cited"
     scenarios_covered: set[str] = set()
     for name in cited:
         path = os.path.join(REPO, name)
@@ -125,6 +126,15 @@ def test_chaos_artifact_cited_and_green():
         scenarios_covered.update(doc["scenarios"])
     assert "disk-fault" in scenarios_covered, (
         "the disk-fault scenario must stay artifact-proven")
+    assert "mgr-failover" in scenarios_covered, (
+        "the mgr-failover scenario must stay artifact-proven")
+    # the mgr-failover runs must have judged the mgr invariant
+    for name in cited:
+        with open(os.path.join(REPO, name)) as f:
+            doc = json.load(f)
+        for r in doc["runs"]:
+            if r["scenario"] == "mgr-failover":
+                assert r["invariants"]["mgr"]["ok"], r
 
 
 def test_chaos_artifact_traces_replay():

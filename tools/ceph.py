@@ -10,8 +10,12 @@ mini-cluster's command surface:
   ceph.py -m HOST:PORT osd erasure-code-profile set NAME k=K m=M plugin=jax
   ceph.py -m HOST:PORT osd down ID | osd out ID
   ceph.py -m HOST:PORT osd balance [--max-swaps N]
+  ceph.py -m HOST:PORT osd perf
   ceph.py -m HOST:PORT pg scrub PGID | pg deep-scrub PGID
   ceph.py -m HOST:PORT df
+  ceph.py -m HOST:PORT mgr dump | mgr stat | mgr fail [NAME]
+  ceph.py -m HOST:PORT mgr module ls | mgr module enable NAME
+          | mgr module disable NAME
 
 Multiple monitors: -m accepts a comma-separated monmap.
 """
@@ -59,6 +63,24 @@ async def amain(args, extra: list[str]) -> int:
                 },
             }).encode()
             code, rs = 0, ""
+        elif verb == "osd" and extra[:1] == ["perf"]:
+            code, rs, data = await client.command({"prefix": "osd perf"})
+        elif verb == "mgr" and extra[:1] == ["dump"]:
+            code, rs, data = await client.command({"prefix": "mgr dump"})
+        elif verb == "mgr" and extra[:1] == ["stat"]:
+            code, rs, data = await client.command({"prefix": "mgr stat"})
+        elif verb == "mgr" and extra[:1] == ["fail"]:
+            cmd = {"prefix": "mgr fail"}
+            if len(extra) > 1:
+                cmd["who"] = extra[1]
+            code, rs, data = await client.command(cmd)
+        elif verb == "mgr" and extra[:2] == ["module", "ls"]:
+            code, rs, data = await client.command(
+                {"prefix": "mgr module ls"})
+        elif verb == "mgr" and extra[:2] in (
+                ["module", "enable"], ["module", "disable"]):
+            code, rs, data = await client.command({
+                "prefix": f"mgr module {extra[1]}", "module": extra[2]})
         elif verb == "osd" and extra[:1] == ["balance"]:
             cmd = {"prefix": "osd balance"}
             if args.max_swaps:

@@ -1,11 +1,11 @@
 #!/usr/bin/env python
 """vstart: boot a dev mini-cluster (mons + OSDs) in one process.
 
-The src/vstart.sh analogue: starts a monitor quorum and N OSDs on
-localhost, prints the monmap for `ceph.py -m`, and runs until
-interrupted.
+The src/vstart.sh analogue: starts a monitor quorum, N OSDs and M
+manager daemons on localhost, prints the monmap for `ceph.py -m`, and
+runs until interrupted.
 
-  vstart.py [--mons 1] [--osds 8] [--beacon 1.0]
+  vstart.py [--mons 1] [--osds 8] [--mgrs 1] [--beacon 1.0]
 """
 
 from __future__ import annotations
@@ -67,6 +67,14 @@ async def amain(args) -> int:
         await m.open_quorum(monmap)
     for m in mons:
         await m.wait_stable()
+    mgrs = []
+    if args.mgrs:
+        from ceph_tpu.mgr.daemon import MgrDaemon
+
+        for i in range(args.mgrs):
+            mgr = MgrDaemon(chr(ord("x") + i), monmap)
+            await mgr.start()
+            mgrs.append(mgr)
     osds = []
     for i in range(args.osds):
         osd = OSDDaemon(
@@ -77,6 +85,10 @@ async def amain(args) -> int:
         osds.append(osd)
     spec = ",".join(f"{h}:{p}" for h, p in monmap)
     print(f"vstart: cluster up — mons at {spec}", flush=True)
+    if mgrs:
+        print(f"vstart: mgrs {', '.join(m.name for m in mgrs)} "
+              f"(active is the mon's call — `ceph.py mgr stat`)",
+              flush=True)
     print(f"vstart: try  python tools/ceph.py -m {spec} status", flush=True)
     dash = None
     if args.dashboard:
@@ -95,6 +107,8 @@ async def amain(args) -> int:
             await dash.stop()
         for o in osds:
             await o.stop()
+        for g in mgrs:
+            await g.stop()
         for m in mons:
             await m.stop()
     return 0
@@ -104,6 +118,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--mons", type=int, default=1)
     ap.add_argument("--osds", type=int, default=8)
+    ap.add_argument("--mgrs", type=int, default=1,
+                    help="manager daemons (first to beacon goes "
+                         "active, the rest stand by)")
     ap.add_argument("--osds-per-host", type=int, default=1)
     ap.add_argument("--beacon", type=float, default=1.0)
     ap.add_argument("--out-interval", type=float, default=0.0)
