@@ -260,6 +260,60 @@ def check_mgr(mgr_stat: dict, expected_daemons: list[str]) -> list[dict]:
     return out
 
 
+def check_slow_osd(obs: dict) -> list[dict]:
+    """``obs``: the degraded-disk watcher's observations —
+    {"targets": [osd ids], "slow_ops_raised", "outlier_flagged",
+    "scrub_deprioritized", "scrub_deferred", "slow_ops_cleared"}.
+
+    The detection/feedback loop must have CLOSED end to end: slow
+    commits raised the mon-visible SLOW_OPS warning, the mgr's
+    analytics flagged the slowed OSD as an outlier, the OSD learned
+    the verdict (MMgrConfigure scrub_deprioritize) and deferred at
+    least one background scrub, and after the heal the warning
+    CLEARED (a stuck warning is as bad as none)."""
+    out: list[dict] = []
+    if not obs.get("targets"):
+        out.append({
+            "invariant": "no_slow_disk_scheduled",
+            "detail": "scenario expected a slow_disk event, trace has "
+                      "none",
+        })
+        return out
+    if not obs.get("slow_ops_raised"):
+        out.append({
+            "invariant": "slow_ops_never_raised",
+            "detail": "SLOW_OPS never appeared in `ceph health` while "
+                      f"osd(s) {obs['targets']} were slowed",
+        })
+    if not obs.get("outlier_flagged"):
+        out.append({
+            "invariant": "outlier_never_flagged",
+            "detail": "mgr analytics never flagged the slowed osd as "
+                      "a latency outlier",
+        })
+    if not obs.get("scrub_deprioritized"):
+        out.append({
+            "invariant": "scrub_never_deprioritized",
+            "detail": "the slowed osd never received the mgr's "
+                      "scrub_deprioritize verdict",
+        })
+    if not obs.get("scrub_deferred") and obs.get("target_leads_pg"):
+        # only judged when the victim LED a pg (the scheduler only
+        # schedules pgs this osd leads — no pg, nothing to defer)
+        out.append({
+            "invariant": "scrub_never_deferred",
+            "detail": "the slowed osd led pgs but its scrub scheduler "
+                      "never deferred a due scrub while flagged",
+        })
+    if not obs.get("slow_ops_cleared"):
+        out.append({
+            "invariant": "slow_ops_never_cleared",
+            "detail": "SLOW_OPS still raised after the disk healed "
+                      "and the cluster settled",
+        })
+    return out
+
+
 def check_disk_faults(fsck_reports: list[dict]) -> list[dict]:
     """``fsck_reports``: per-OSD at-rest verification sweeps
     ({"osd": id, "bad": [...]}).  Any blob still failing its checksum
@@ -279,5 +333,5 @@ def check_disk_faults(fsck_reports: list[dict]) -> list[dict]:
 #: checker registry: name -> callable, for reporting
 ALL_INVARIANTS = (
     "history", "final_reads", "converged", "quorum", "scrub",
-    "disk_faults", "cold_launches", "mgr",
+    "disk_faults", "cold_launches", "mgr", "slow_osd",
 )

@@ -120,6 +120,47 @@ SCENARIOS: dict[str, dict] = {
         ],
         "workload": {"objects": 3, "rounds": 3, "object_size": 8192},
     },
+    # degraded-disk chaos: one OSD's store goes SLOW (sticky injected
+    # commit latency — the disk still answers, late), under client
+    # load with a live mgr.  The detection/feedback chain under test:
+    # slow commits -> op-tracker complaints -> SLOW_OPS health warning
+    # (mgr digest -> `ceph health`); slow subop_w latency -> mgr
+    # analytics outlier detection -> MMgrConfigure scrub_deprioritize
+    # -> the victim's scrub scheduler defers background scrubs.  The
+    # slow_osd invariant requires all of it observed AND the warning
+    # CLEARED after the disk heals (the ROADMAP item-(e) loop).
+    "degraded-disk": {
+        "name": "degraded-disk",
+        "n_osds": 5, "n_mons": 1, "n_mgrs": 1,
+        "duration": 6.0, "n_events": 6,
+        "slow_disk_at": 0.3, "slow_disk_delay": 0.5,
+        "watch_slow_osd": True,
+        "mix": {"scrub": 1.0, "deep_scrub": 0.5, "reweight": 0.5},
+        "conf": {
+            # complaint threshold under the injected delay so slow
+            # writes COUNT, and short windows so raise/clear both fit
+            # the run
+            "osd_op_complaint_time": 0.25,
+            "mgr_slow_ops_warn_window": 3.0,
+            # frequent background scrubs so the deprioritization has
+            # scheduling decisions to defer inside the run
+            "osd_scrub_interval": 1.0,
+            "osd_deep_scrub_interval": 3600.0,
+            "osd_scrub_deprioritize_factor": 8.0,
+        },
+        "pools": [
+            {"name": "rep", "type": "replicated", "pg_num": 4,
+             "size": 2, "snaps": True},
+            {"name": "ec", "type": "erasure", "pg_num": 2,
+             "k": 2, "m": 1},
+        ],
+        # paced writers (write_gap) so the write stream SPANS the
+        # slow window: complaints and latency samples must keep
+        # flowing while the mgr's report/analytics/digest pipeline
+        # observes the slow disk
+        "workload": {"objects": 4, "rounds": 6, "object_size": 8192,
+                     "write_gap": 0.7},
+    },
     # monitor-plane chaos: restarts + osd kills over a 3-mon quorum,
     # plus pg_num splitting mid-storm
     "quorum_thrash": {
@@ -179,6 +220,16 @@ class ChaosCluster:
         self._store_dir: str | None = None
         self._stores: dict[int, object] = {}  # osd id -> mounted store
 
+    def _conf(self):
+        """Per-daemon ConfigProxy carrying the scenario's overrides
+        (fresh per daemon: config observers must not cross daemons)."""
+        sc_conf = self.scenario.get("conf")
+        if not sc_conf:
+            return None
+        from ceph_tpu.common import ConfigProxy
+
+        return ConfigProxy(dict(sc_conf))
+
     def _make_store(self, osd_id: int):
         """Per-scenario store engine: 'blockstore' puts each OSD on a
         real BlockStore device (checksum-at-rest + BlueFS-lite KV) in
@@ -213,7 +264,8 @@ class ChaosCluster:
         self._crush_template = crush
         n_mons = sc.get("n_mons", 1)
         self.mons = [
-            Monitor(crush=crush.copy(), rank=r, n_mons=n_mons)
+            Monitor(crush=crush.copy(), rank=r, n_mons=n_mons,
+                    conf=self._conf())
             for r in range(n_mons)
         ]
         for m in self.mons:
@@ -230,13 +282,15 @@ class ChaosCluster:
             from ceph_tpu.mgr.daemon import MgrDaemon
 
             for i in range(sc["n_mgrs"]):
-                mgr = MgrDaemon(self._mgr_name(i), list(self.monmap))
+                mgr = MgrDaemon(self._mgr_name(i), list(self.monmap),
+                                conf=self._conf())
                 self.netem.attach(mgr.messenger)
                 await mgr.start()
                 self.mgrs.append(mgr)
         self.osds = []
         for i in range(sc["n_osds"]):
-            osd = OSDDaemon(i, list(self.monmap), store=self._make_store(i))
+            osd = OSDDaemon(i, list(self.monmap),
+                            store=self._make_store(i), conf=self._conf())
             self.netem.attach(osd.messenger)
             await osd.start()
             self.osds.append(osd)
@@ -356,7 +410,8 @@ class ChaosCluster:
 
                 store = getattr(self, "_stashed_stores", {}).pop(
                     a["osd"], None)
-                osd = OSDDaemon(a["osd"], list(self.monmap), store=store)
+                osd = OSDDaemon(a["osd"], list(self.monmap), store=store,
+                                conf=self._conf())
                 self.netem.attach(osd.messenger)
                 await osd.start()
                 self.osds[a["osd"]] = osd
@@ -434,8 +489,9 @@ class ChaosCluster:
         elif kind == "netem_clear":
             self.netem.clear()
         elif kind in ("eio", "bitflip", "torn_write", "disk_dead",
-                      "disk_heal"):
-            self._apply_disk_fault(kind, a["osd"])
+                      "slow_disk", "disk_heal"):
+            self._apply_disk_fault(kind, a["osd"],
+                                   delay=a.get("delay"))
         elif kind == "mgr_kill":
             mgr = self.mgrs[a["mgr"]]
             if mgr is not None:
@@ -458,9 +514,10 @@ class ChaosCluster:
         return chr(ord("x") + i)
 
     #: FAULTS keys a disk-fault event may arm on one osd's store
-    _DISK_FAULT_OPS = ("read", "write", "commit", "mount")
+    _DISK_FAULT_OPS = ("read", "write", "commit", "mount", "latency")
 
-    def _apply_disk_fault(self, kind: str, osd_id: int) -> None:
+    def _apply_disk_fault(self, kind: str, osd_id: int,
+                          delay: float | None = None) -> None:
         """Arm (or clear) store-level FAULTS points for one OSD's
         disk.  One key per (op, osd); a later event on the same osd
         re-arms the key (latest fault wins — a disk does not queue its
@@ -484,6 +541,14 @@ class ChaosCluster:
                 f"store.read.osd.{osd_id}", error=_errno.EIO, count=None)
             FAULTS.inject(
                 f"store.write.osd.{osd_id}", error=_errno.EIO, count=None)
+        elif kind == "slow_disk":
+            # a disk that still works but has gone SLOW: sticky async
+            # latency on every store commit of this osd (the OSD's
+            # _store_latency_gate — an event-loop sleep, so ONE slow
+            # disk slows only its own commits in-process)
+            FAULTS.inject(
+                f"store.latency.osd.{osd_id}",
+                delay=float(delay or 0.5), count=None)
         elif kind == "disk_heal":
             for op in self._DISK_FAULT_OPS:
                 FAULTS.clear(f"store.{op}.osd.{osd_id}")
@@ -645,6 +710,55 @@ class ChaosCluster:
         return out
 
 
+async def _watch_slow_osd(cluster, targets, obs, perf_base) -> None:
+    """Degraded-disk observer: while the thrash runs, record whether
+    the SLOW_OPS warning surfaced in `ceph health`, whether the mgr's
+    outlier detection flagged a slowed osd, and whether the victim's
+    scrub scheduler learned + acted on the deprioritization verdict."""
+    import json as _json
+
+    tnames = {f"osd.{t}" for t in targets}
+    while True:
+        try:
+            code, _rs, data = await cluster.client.command(
+                {"prefix": "health"})
+            if code == 0 and data:
+                h = _json.loads(data)
+                if "SLOW_OPS" in (h.get("checks") or {}):
+                    obs["slow_ops_raised"] = True
+        except (OSError, ValueError, ConnectionError,
+                asyncio.TimeoutError):
+            pass
+        for g in cluster.mgrs:
+            if g is not None and g.active \
+                    and tnames & g._outlier_daemons():
+                obs["outlier_flagged"] = True
+        om = cluster.client.osdmap
+        for t in targets:
+            osd = cluster.osds[t]
+            if osd is None:
+                continue
+            if osd.mgr_client.scrub_deprioritized:
+                obs["scrub_deprioritized"] = True
+            deferred = (osd.perf.dump().get("scrub_deferred_slow", 0.0)
+                        - perf_base.get(t, 0.0))
+            if deferred > 0:
+                obs["scrub_deferred"] = deferred
+            if om is not None and not obs.get("target_leads_pg"):
+                from ceph_tpu.osd.types import pg_t as _pg_t
+
+                for pid, pool in om.pools.items():
+                    for ps in range(pool.pg_num):
+                        _u, _up, _a, pri = om.pg_to_up_acting_osds(
+                            _pg_t(pid, ps), folded=True)
+                        if pri == t:
+                            obs["target_leads_pg"] = True
+                            break
+                    if obs.get("target_leads_pg"):
+                        break
+        await asyncio.sleep(0.25)
+
+
 async def run_scenario(
     scenario: dict | str, seed: int, *, time_scale: float = 1.0,
     settle_timeout: float = 90.0,
@@ -663,6 +777,7 @@ async def run_scenario(
         "scenario": scenario["name"], "seed": seed,
         "trace_hash": th, "n_events": len(events),
     }
+    watch_task: asyncio.Task | None = None
     try:
         await cluster.start()
         cold_before = _cold_launch_snapshot()
@@ -675,8 +790,26 @@ async def run_scenario(
             objects=wl_conf.get("objects", 3),
             rounds=wl_conf.get("rounds", 3),
             object_size=wl_conf.get("object_size", 8192),
+            write_gap=wl_conf.get("write_gap", 0.0) * time_scale,
         )
         wl_task = asyncio.ensure_future(workload.run())
+
+        slow_obs: dict | None = None
+        if scenario.get("watch_slow_osd"):
+            targets = [
+                e.args["osd"] for e in events if e.kind == "slow_disk"]
+            slow_obs = {
+                "targets": targets, "slow_ops_raised": False,
+                "outlier_flagged": False, "scrub_deprioritized": False,
+                "scrub_deferred": 0.0, "slow_ops_cleared": False,
+            }
+            perf_base = {
+                t: cluster.osds[t].perf.dump().get(
+                    "scrub_deferred_slow", 0.0)
+                for t in targets if cluster.osds[t] is not None
+            }
+            watch_task = asyncio.ensure_future(
+                _watch_slow_osd(cluster, targets, slow_obs, perf_base))
 
         loop = asyncio.get_running_loop()
         t0 = loop.time()
@@ -742,6 +875,30 @@ async def run_scenario(
             # itself is never in the data path — every other invariant
             # above already judged the client workload untouched)
             violations["mgr"] = await cluster.await_mgr_reports()
+        if slow_obs is not None:
+            # the warning must CLEAR after the heal: poll `ceph
+            # health` until the mgr's quiet window elapses and the
+            # digest drops SLOW_OPS
+            import json as _json
+
+            deadline = time.monotonic() + 25.0
+            while time.monotonic() < deadline:
+                try:
+                    code, _rs, data = await cluster.client.command(
+                        {"prefix": "health"})
+                    if code == 0 and data:
+                        h = _json.loads(data)
+                        if "SLOW_OPS" not in (h.get("checks") or {}):
+                            slow_obs["slow_ops_cleared"] = True
+                            break
+                except (OSError, ValueError, ConnectionError,
+                        asyncio.TimeoutError):
+                    pass
+                await asyncio.sleep(0.4)
+            if watch_task is not None:
+                watch_task.cancel()
+            violations["slow_osd"] = inv.check_slow_osd(slow_obs)
+            result["slow_osd_obs"] = dict(slow_obs)
         violations["cold_launches"] = inv.check_cold_launches(
             cold_before, _cold_launch_snapshot())
 
@@ -770,6 +927,8 @@ async def run_scenario(
         })
         return result
     finally:
+        if watch_task is not None:
+            watch_task.cancel()
         await cluster.stop()
 
 

@@ -53,6 +53,10 @@ EVENT_KINDS = (
     "bitflip",        # flip one stored bit at rest on the next read
     "torn_write",     # tear the osd's next transaction commit
     "disk_dead",      # sticky EIO on every read+write (dying disk)
+    "slow_disk",      # sticky injected store-commit latency (a disk
+                      # that still works but has gone SLOW — the
+                      # degraded-disk scenario's beat: SLOW_OPS health,
+                      # mgr outlier detection, scrub deprioritization)
     "disk_heal",      # clear every armed store fault on an osd
     # mgr-plane verbs (the mgr is NEVER in the data path: killing it
     # may only cost observability — the workload invariants must be
@@ -98,6 +102,7 @@ class _TraceState:
         self.n_mons = n_mons
         self.splits = 0
         self.disk_dead: set[int] = set()    # osds with a sticky-dead disk
+        self.slow_disks: set[int] = set()   # osds with injected latency
         self.disk_faulted: set[int] = set()  # osds with ANY store fault
         self.last_damage = -1e9  # t of the last AT-REST damage event
         self.mgr_alive = set(range(n_mgrs))  # manager daemons running
@@ -148,6 +153,18 @@ def generate_schedule(seed: int, scenario: dict) -> list[ChaosEvent]:
 
     def emit(t: float, kind: str, **args) -> None:
         events.append(ChaosEvent(t=t, kind=kind, args=args))
+
+    # degraded-disk scenarios pin ONE guaranteed early slow_disk so
+    # the mgr pipeline (reports -> analytics -> outlier -> SLOW_OPS)
+    # always has a full observation window; the victim still derives
+    # from the seed (pure in (seed, scenario) like every other draw)
+    lead_at = scenario.get("slow_disk_at")
+    if lead_at is not None:
+        victim = rng.randrange(n_osds)
+        st.slow_disks.add(victim)
+        st.disk_faulted.add(victim)
+        emit(round(float(lead_at), 3), "slow_disk", osd=victim,
+             delay=float(scenario.get("slow_disk_delay", 0.5)))
 
     for t in times:
         kind = rng.choices(kinds, weights=weights)[0]
@@ -220,6 +237,18 @@ def generate_schedule(seed: int, scenario: dict) -> list[ChaosEvent]:
                 st.last_damage = t
             st.disk_faulted.add(victim)
             emit(t, kind, osd=victim)
+        elif kind == "slow_disk":
+            # one slow disk at a time: two simultaneously-slow members
+            # of a size-2/k+1 pool is an availability study, not the
+            # degraded-disk scenario's detection beat
+            victims = sorted(st.alive - st.disk_dead - st.slow_disks)
+            if st.slow_disks or not victims:
+                continue
+            victim = rng.choice(victims)
+            st.slow_disks.add(victim)
+            st.disk_faulted.add(victim)
+            emit(t, "slow_disk", osd=victim,
+                 delay=float(scenario.get("slow_disk_delay", 0.5)))
         elif kind == "mgr_kill":
             # no down-budget: losing EVERY mgr is legal (observability
             # gap, not data loss) — but a dead set yields the revive

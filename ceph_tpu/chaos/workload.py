@@ -111,7 +111,7 @@ class Workload:
     def __init__(
         self, client, pools: list[dict], *, objects: int = 4,
         rounds: int = 3, object_size: int = 8192,
-        read_loops: int = 4,
+        read_loops: int = 4, write_gap: float = 0.0,
     ):
         self.client = client
         self.pools = pools
@@ -119,6 +119,11 @@ class Workload:
         self.rounds = rounds
         self.object_size = object_size
         self.read_loops = read_loops
+        # pause between one writer's rounds: scenarios that need the
+        # write stream to SPAN the whole thrash window (degraded-disk:
+        # the mgr's detection pipeline observes live traffic) pace
+        # their writers instead of bursting every round up front
+        self.write_gap = write_gap
         self.history = History()
         self._done = asyncio.Event()
 
@@ -152,7 +157,7 @@ class Workload:
                     h.record_snap(pool["name"], oid, snapid, last_acked)
                 except OSError as e:
                     log.debug("chaos workload: snap failed: %s", e)
-            await asyncio.sleep(0)
+            await asyncio.sleep(self.write_gap)
 
     async def _reader(self, pool: dict) -> None:
         h = self.history

@@ -81,7 +81,8 @@ class RadosClient:
 
     def __init__(self, client_id: int | None = None, auth=None,
                  handshake_timeout: float | None = None,
-                 op_timeout: float = 120.0):
+                 op_timeout: float = 120.0,
+                 trace_sample_rate: float = 1.0):
         self.id = client_id if client_id is not None else (os.getpid() << 8) | 1
         # per-op wall-clock budget across ALL resends (librados
         # rados_osd_op_timeout role): an op that can't complete within
@@ -94,6 +95,14 @@ class RadosClient:
             ("client", self.id), self._dispatch, on_reset=self._on_reset,
             auth=auth, **_mkw,
         )
+        # cluster-wide tracing root: every submitted op opens a
+        # client_op span whose context rides the MOSDOp frame — the
+        # Objecter-side jaeger root of the reference's trace chain
+        from ceph_tpu.common.tracing import get_tracer
+
+        self.tracer = get_tracer(f"client.{self.id}")
+        self.tracer.sample_rate = trace_sample_rate
+        self.messenger.tracer = self.tracer
         self.osdmap: OSDMap | None = None
         self._mon_conn: Connection | None = None
         self._tids = itertools.count(1)
@@ -384,13 +393,25 @@ class RadosClient:
         await asyncio.sleep(cap * (0.5 + random.random() / 2))
 
     async def _submit(self, pool_id: int, op: MOSDOp) -> MOSDOpReply:
-        """op_submit/_calc_target/resend loop."""
-        last_err = errno.EIO
+        """op_submit/_calc_target/resend loop, under a client_op root
+        span whose TraceContext rides every (re)send — one client op,
+        one cluster-wide trace."""
         if op.is_write() and not op.reqid:
             # stable across resends (osd_reqid_t): the OSD deduplicates
             # a retried non-idempotent op (append, compound vector) by
             # this id instead of re-applying it
             op.reqid = f"client.{self.id}:{next(self._tids)}"
+        with self.tracer.span(
+            "client_op", oid=op.oid, pool=pool_id,
+            write=op.is_write(), reqid=op.reqid or f"tid:{op.tid}",
+        ) as root:
+            op.trace = self.tracer.ctx_for(root)
+            reply = await self._submit_inner(pool_id, op)
+            root.tag(result=reply.result)
+            return reply
+
+    async def _submit_inner(self, pool_id: int, op: MOSDOp) -> MOSDOpReply:
+        last_err = errno.EIO
         loop = asyncio.get_running_loop()
         deadline = loop.time() + self.op_timeout
         for _try in range(MAX_RETRIES):

@@ -243,6 +243,37 @@ declare(
            "rep_repair_primary_object read-error repair path)"),
     Option("debug_osd", int, 1, LEVEL_DEV, "osd log verbosity", min=0, max=5),
     Option("debug_mon", int, 1, LEVEL_DEV, "mon log verbosity", min=0, max=5),
+    # -- distributed tracing (common/tracing.py + mgr/tracer.py) --------
+    Option("trace_sample_rate", float, 1.0, LEVEL_ADVANCED,
+           "head-sampling probability for new traces started at this "
+           "daemon (the reference's jaeger sampler rate); joined "
+           "traces inherit the root's verdict; slow spans export "
+           "regardless (tail capture, see trace_tail_slow_s)",
+           min=0.0, max=1.0),
+    Option("trace_ring_max", int, 2048, LEVEL_ADVANCED,
+           "finished spans kept in each daemon's dump_traces ring "
+           "(was a hardcoded 2048)", min=16),
+    Option("trace_tail_slow_s", float, 1.0, LEVEL_ADVANCED,
+           "tail capture: spans slower than this export to the mgr "
+           "trace collector even when their trace lost the head-"
+           "sampling draw (0 disables tail capture)", min=0.0),
+    Option("mgr_trace_max_traces", int, 256, LEVEL_ADVANCED,
+           "distinct trace_ids the mgr trace collector keeps "
+           "(LRU-evicted)", min=8),
+    Option("mgr_trace_slow_history", int, 32, LEVEL_ADVANCED,
+           "assembled slow traces kept in the collector's bounded "
+           "history (the dump_historic_slow_ops analogue, but "
+           "cluster-wide)", min=1),
+    Option("mgr_slow_ops_warn_window", float, 30.0, LEVEL_ADVANCED,
+           "SLOW_OPS health: a daemon whose slow-op complaint counter "
+           "grew within this many seconds keeps the warning raised; "
+           "no growth for a full window clears it (the reference's "
+           "mon-aggregated SLOW_OPS behavior)", min=0.5),
+    Option("osd_scrub_deprioritize_factor", float, 4.0, LEVEL_ADVANCED,
+           "slow-OSD-aware scrub scheduling: while the mgr's outlier "
+           "detection flags this OSD slow, background scrubs wait "
+           "this multiple of the normal interval before scheduling "
+           "(1.0 disables the deferral)", min=1.0),
     # -- manager daemon (ceph_tpu/mgr/) --------------------------------
     Option("mgr_beacon_interval", float, 0.5, LEVEL_ADVANCED,
            "seconds between mgr -> mon beacons (reference "

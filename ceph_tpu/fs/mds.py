@@ -83,7 +83,7 @@ class MDSDaemon:
     def __init__(self, rank: int, mon_addr: tuple[str, int],
                  meta_pool: str = "cephfs.meta",
                  data_pool: str = "cephfs.data",
-                 flush_every: int = 128):
+                 flush_every: int = 128, conf=None):
         self.rank = rank
         self.mon_addr = mon_addr
         self.meta_pool = meta_pool
@@ -118,12 +118,22 @@ class MDSDaemon:
         # mgr report stream (MgrMap rides the rados session's mon
         # subscription; reports go out over our own messenger)
         from ceph_tpu.common import ConfigProxy, get_perf_counters
+        from ceph_tpu.common.tracing import Tracer
         from ceph_tpu.mgr.client import MgrClient
 
+        self.conf = conf if conf is not None else ConfigProxy()
         self.perf = get_perf_counters(f"mds.{rank}")
+        self.tracer = Tracer(
+            f"mds.{rank}",
+            ring_max=self.conf["trace_ring_max"],
+            sample_rate=self.conf["trace_sample_rate"],
+            tail_slow_s=(self.conf["trace_tail_slow_s"] or None),
+        )
+        self.messenger.tracer = self.tracer
+        self._admin = None
         self.mgr_client = MgrClient(
-            f"mds.{rank}", self.messenger, ConfigProxy(),
-            self._mgr_collect)
+            f"mds.{rank}", self.messenger, self.conf,
+            self._mgr_collect, tracers=(self.tracer,))
 
     # -- lifecycle -----------------------------------------------------
 
@@ -141,6 +151,29 @@ class MDSDaemon:
         for ev in events:
             await self._apply(ev, replay=True)
         self.addr = await self.messenger.bind()
+        sock_path = self.conf["admin_socket"]
+        if sock_path:
+            from ceph_tpu.common import AdminSocket
+
+            self._admin = AdminSocket(
+                sock_path.replace("$id", f"mds.{self.rank}"))
+            self._admin.register(
+                "dump_traces", "recent spans (blkin/otel role)",
+                lambda cmd: self.tracer.dump(),
+            )
+            self._admin.register(
+                "perf dump", "dump perf counters",
+                lambda cmd: self.perf.dump(),
+            )
+            self._admin.register(
+                "status", "daemon status",
+                lambda cmd: {
+                    "mds": self.rank,
+                    "cached_dirs": len(self._dirs),
+                    "sessions": len(self._sessions),
+                },
+            )
+            await self._admin.start()
         self.rados.set_mgr_map_listener(self.mgr_client.handle_mgr_map)
         self.mgr_client.start()
         log.info("mds.%d: up at %s, replayed %d events",
@@ -149,6 +182,8 @@ class MDSDaemon:
     async def stop(self) -> None:
         """Clean shutdown: flush + trim, then drop sessions."""
         await self.mgr_client.stop()
+        if self._admin is not None:
+            await self._admin.stop()
         async with self._mutation_lock:
             await self._flush()
         await self.messenger.shutdown()
@@ -157,6 +192,8 @@ class MDSDaemon:
     async def crash(self) -> None:
         """Test hook: die WITHOUT flushing — restart must replay."""
         await self.mgr_client.stop()
+        if self._admin is not None:
+            await self._admin.stop()
         await self.messenger.shutdown()
         await self.rados.shutdown()
 
@@ -431,8 +468,6 @@ class MDSDaemon:
     # -- request dispatch (src/mds/Server.cc) --------------------------
 
     async def _dispatch(self, msg) -> None:
-        import inspect
-
         if isinstance(msg, MClientCaps):
             await self._handle_caps(msg)
             return
@@ -441,6 +476,15 @@ class MDSDaemon:
         self._sessions.add(msg.conn)
         args = dict(msg.args)
         reqid = args.pop("_reqid", None)
+        with self.tracer.span(
+            "mds_req", ctx=msg.trace, op=msg.op,
+            reqid=str(reqid or msg.tid),
+        ):
+            await self._serve_request(msg, args, reqid)
+
+    async def _serve_request(self, msg, args: dict, reqid) -> None:
+        import inspect
+
         handler = getattr(self, f"_op_{msg.op}", None)
         if handler is None:
             reply = MClientReply(msg.tid, -errno.EOPNOTSUPP)

@@ -238,10 +238,23 @@ class AnalyticsEngine:
                             # even transiently read non-zero)
                             self.stats["cold_launches"] += 1
                             self.metrics.inc("cold_launches")
-            out = self._jit(values.astype(np.int64),
-                            valid.astype(bool),
-                            cursor.astype(np.int64))
-            out = [np.asarray(jax.block_until_ready(a)) for a in out]
+            import contextlib
+
+            from ceph_tpu.common.tracing import device_tracer
+
+            # device-launch profiling span on real digest passes only
+            # (prewarm's compile is intentional, not a launch to study)
+            span_cm = (
+                device_tracer().span(
+                    "xla_launch", stage="device", kind="mgr_analytics",
+                    shape=str(self.shape))
+                if count_cold else contextlib.nullcontext()
+            )
+            with span_cm:
+                out = self._jit(values.astype(np.int64),
+                                valid.astype(bool),
+                                cursor.astype(np.int64))
+                out = [np.asarray(jax.block_until_ready(a)) for a in out]
         pct, nsamples, ewma, mean_scaled, cnt, outlier = out
         return {
             "percentiles": pct, "n_samples": nsamples,

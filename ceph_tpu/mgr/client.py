@@ -49,11 +49,21 @@ class MgrClient:
     mean per histogram class) are derived automatically.
     """
 
-    def __init__(self, entity: str, messenger, conf, collect):
+    def __init__(self, entity: str, messenger, conf, collect,
+                 tracers=()):
         self.entity = entity
         self.messenger = messenger
         self.conf = conf
         self.collect = collect
+        # tracers whose export buffers this client drains into each
+        # report (the daemon's own + shared rings like the device-
+        # launch profiler); drained spans ride MMgrReport.spans to the
+        # mgr's TraceCollector
+        self.tracers = tuple(tracers)
+        # set by MMgrConfigure from the active mgr: outlier detection
+        # flagged this daemon slow — its scrub scheduler defers
+        # background scrubs while the flag holds
+        self.scrub_deprioritized = False
         self.mgrmap: dict | None = None
         self._conn = None
         self._opened_gid: int | None = None
@@ -94,6 +104,13 @@ class MgrClient:
         old_gid = ((old or {}).get("active") or {}).get("gid")
         if new_gid != old_gid:
             self._conn = None  # lazily re-dialed by the next tick
+
+    def handle_configure(self, msg) -> None:
+        """MMgrConfigure from the active mgr: report-period tuning +
+        the slow-OSD scrub-deprioritization flag (the analytics
+        feedback loop)."""
+        self.scrub_deprioritized = bool(
+            getattr(msg, "scrub_deprioritize", False))
 
     def _active_addr(self) -> tuple[int, tuple[str, int]] | None:
         act = (self.mgrmap or {}).get("active")
@@ -159,10 +176,16 @@ class MgrClient:
                 # mgr's ring buffers ingest for this class
                 gauges[f"{cls}_lat_us"] = dsum / dn
         status = raw.get("status")
+        spans: list[dict] = []
+        for t in self.tracers:
+            if len(spans) >= 512:
+                break
+            spans.extend(t.drain_export(limit=512 - len(spans)))
         return MMgrReport(
             daemon=self.entity,
             counters=deltas,
             gauges=gauges,
             histograms=wire_h,
             status=json.dumps(status).encode() if status else b"",
+            spans=json.dumps(spans).encode() if spans else b"",
         )

@@ -151,8 +151,10 @@ class MgrDaemon:
 
     def __init__(self, name: str, mon_addr, conf=None):
         from ceph_tpu.common import ConfigProxy, get_perf_counters
+        from ceph_tpu.common.tracing import Tracer
         from ceph_tpu.mgr.analytics import AnalyticsEngine
         from ceph_tpu.mgr.modules import MODULE_REGISTRY
+        from ceph_tpu.mgr.tracer import TraceCollector
 
         self.name = name
         self.mon_addrs: list[tuple[str, int]] = (
@@ -163,6 +165,26 @@ class MgrDaemon:
         self.gid = time.time_ns()
         self.messenger = Messenger(("mgr", self.gid), self._dispatch)
         self.perf = get_perf_counters(f"mgr.{name}")
+        self.tracer = Tracer(
+            f"mgr.{name}",
+            ring_max=self.conf["trace_ring_max"],
+            sample_rate=self.conf["trace_sample_rate"],
+            tail_slow_s=(self.conf["trace_tail_slow_s"] or None),
+        )
+        self.messenger.tracer = self.tracer
+        # the jaeger-collector role: spans shipped on MMgrReport land
+        # here; `ceph trace ls/show` serves from its assemblies
+        self.trace_collector = TraceCollector(
+            max_traces=self.conf["mgr_trace_max_traces"],
+            slow_history=self.conf["mgr_trace_slow_history"],
+            slow_s=self.conf["trace_tail_slow_s"] or 1.0,
+        )
+        # SLOW_OPS bookkeeping: daemon -> {"count", "grew_at",
+        # "inflight"} from each report's status side channel
+        self._slow_ops: dict[str, dict] = {}
+        # last scrub-deprioritize verdict pushed per daemon (the
+        # outlier -> MMgrConfigure feedback loop)
+        self._deprioritized: dict[str, bool] = {}
         self.store = TimeSeriesStore(
             self.conf["mgr_stats_max_daemons"],
             self.conf["mgr_stats_max_metrics"],
@@ -244,6 +266,30 @@ class MgrDaemon:
         sock.register(
             "perf dump", "dump perf counters",
             lambda cmd: self.perf.dump(),
+        )
+        sock.register(
+            "dump_traces", "recent spans of this mgr's tracer "
+            "(blkin/otel role)",
+            lambda cmd: self.tracer.dump(),
+        )
+        sock.register(
+            "dump_trace_collector", "cross-daemon trace collector: "
+            "summaries, slow-trace ids, ingest stats, recent "
+            "device-launch profiling spans",
+            lambda cmd: {
+                "ls": self.trace_collector.ls(32),
+                "device_recent":
+                    self.trace_collector.device_launches(32),
+                **self.trace_collector.dump(),
+            },
+        )
+        sock.register(
+            "trace show", "assemble one collected trace "
+            "({'trace_id': N})",
+            lambda cmd: (
+                self.trace_collector.assemble(int(cmd["trace_id"]))
+                or {"error": "unknown trace_id"}
+            ),
         )
         sock.register(
             "dump_analytics", "analytics engine stats (launches, "
@@ -351,6 +397,27 @@ class MgrDaemon:
         sess["reports"] += 1
         sess["last_report"] = time.monotonic()
         self.perf.inc("reports_rx")
+        if msg.spans:
+            try:
+                spans = json.loads(msg.spans)
+            except ValueError:
+                spans = []
+            if spans:
+                self.trace_collector.ingest(msg.daemon, spans)
+                self.perf.inc("trace_spans_rx", len(spans))
+        # SLOW_OPS bookkeeping: remember when each daemon's complaint
+        # counter last GREW — the health check clears once no daemon
+        # grew within mgr_slow_ops_warn_window and nothing slow is
+        # still in flight
+        st = sess.get("status") or {}
+        if "slow_ops" in st:
+            rec = self._slow_ops.setdefault(
+                msg.daemon, {"count": 0, "grew_at": 0.0, "inflight": 0})
+            count = int(st.get("slow_ops", 0))
+            if count > rec["count"]:
+                rec["grew_at"] = time.monotonic()
+            rec["count"] = count
+            rec["inflight"] = int(st.get("slow_ops_inflight", 0))
         # numeric gauges are the ring-buffer samples (latency means,
         # queue depths, ...) — one column per report
         self.store.ingest(msg.daemon, msg.gauges, time.monotonic())
@@ -381,6 +448,7 @@ class MgrDaemon:
         # launch must not stall report ingestion
         self.last_analytics = await asyncio.to_thread(
             self.engine.analyze, values, valid, cursor)
+        await self._push_scrub_flags()
         digest = self._build_digest()
         try:
             await self._mon_conn.send_message(MMonMgrReport(
@@ -444,6 +512,88 @@ class MgrDaemon:
                 out.append(f"{name} {v}")
         return out
 
+    def _outlier_daemons(self) -> set[str]:
+        """OSD daemons the analytics pass flags as latency outliers on
+        ANY metric (the slow-OSD detection feeding scrub scheduling)."""
+        out: set[str] = set()
+        for names in self._analytics_summary().get(
+                "outliers", {}).values():
+            out.update(n for n in names if n.startswith("osd."))
+        return out
+
+    async def _push_scrub_flags(self) -> None:
+        """Close the analytics loop: tell outlier OSDs to deprioritize
+        background scrubs (MMgrConfigure scrub_deprioritize), and
+        un-flag recovered ones.  Sent only on verdict CHANGES."""
+        outliers = self._outlier_daemons()
+        for daemon, sess in list(self.sessions.items()):
+            if not daemon.startswith("osd."):
+                continue
+            want = daemon in outliers
+            if self._deprioritized.get(daemon) == want:
+                continue
+            conn = sess.get("conn")
+            if conn is None:
+                continue
+            try:
+                await conn.send_message(MMgrConfigure(
+                    period=self.conf["mgr_report_interval"],
+                    scrub_deprioritize=want))
+                self._deprioritized[daemon] = want
+                self.perf.inc("scrub_deprioritize_pushes")
+            except (ConnectionError, OSError):
+                pass  # daemon gone; next session re-opens clean
+
+    def _slow_ops_health(self) -> dict:
+        """The SLOW_OPS health check (reference `ceph health` SLOW_OPS
+        raised by the mgr's DaemonServer): raised while any daemon has
+        slow ops IN FLIGHT or its complaint counter grew within
+        mgr_slow_ops_warn_window; clears a full quiet window after the
+        last slow op."""
+        window = self.conf["mgr_slow_ops_warn_window"]
+        now = time.monotonic()
+        noisy: dict[str, dict] = {}
+        for daemon, rec in self._slow_ops.items():
+            if rec["inflight"] > 0 or (
+                rec["grew_at"] and now - rec["grew_at"] < window
+            ):
+                noisy[daemon] = rec
+        if not noisy:
+            return {}
+        total = sum(r["count"] for r in noisy.values())
+        return {
+            "SLOW_OPS": {
+                "severity": "HEALTH_WARN",
+                "summary": (
+                    f"{total} slow ops, oldest daemons: "
+                    + ", ".join(sorted(noisy))
+                ),
+                "detail": [
+                    f"{d}: {r['count']} slow ops total, "
+                    f"{r['inflight']} in flight over the complaint "
+                    "threshold"
+                    for d, r in sorted(noisy.items())
+                ],
+            }
+        }
+
+    def _digest_traces(self) -> dict:
+        """The trace block of the digest: summaries for `ceph trace
+        ls` + assembled trees (recent + slow) for `ceph trace show` —
+        bounded so the digest stays small."""
+        col = self.trace_collector
+        ls = col.ls(16)
+        trees: dict[str, dict] = {}
+        want = [t["trace_id"] for t in ls[:6]]
+        want += [int(t) for t in list(col.slow)[-6:]]
+        for tid in want:
+            if str(tid) in trees:
+                continue
+            a = col.assemble(tid)
+            if a is not None:
+                trees[str(tid)] = a
+        return {"ls": ls, "trees": trees, "stats": col.dump()}
+
     def _top_slow_osds(self, metric: str = "write_lat_us",
                        n: int = 3) -> list[list]:
         summary = self._analytics_summary()
@@ -471,6 +621,7 @@ class MgrDaemon:
         for mod in self.modules.values():
             if mod.running:
                 health.update(mod.health())
+        health.update(self._slow_ops_health())
         digest = {
             "ts": time.time(),
             "active": self.name,
@@ -479,10 +630,12 @@ class MgrDaemon:
             "reports_rx": int(self.perf.dump().get("reports_rx", 0)),
             "osd_perf": osd_perf,
             "top_slow_osds": self._top_slow_osds(),
+            "slow_osds": sorted(self._outlier_daemons()),
             "analytics": {
                 "percentiles": summary.get("percentiles", {}),
                 "outliers": summary.get("outliers", {}),
             },
+            "traces": self._digest_traces(),
             "health": health,
             "engine": {
                 "cold_launches": int(

@@ -20,6 +20,9 @@ Endpoints:
   GET /api/pools        pool table incl. pg_num/size/type/autoscale
   GET /api/osds         per-osd up/in/weight + crush host
   GET /api/pg           aggregated PG states (by_state)
+  GET /api/traces       cross-daemon trace summaries + assembled
+                        trees from the active mgr's TraceCollector
+                        (rides the MMonMgrReport digest)
   GET /metrics          prometheus text (same as the exporter)
 
 Runs inside the monitor process and reads its in-memory state via the
@@ -69,6 +72,7 @@ _PAGE = """<!doctype html>
 <a href="/api/pools">pools</a> &middot;
 <a href="/api/osds">osds</a> &middot;
 <a href="/api/pg">pg</a> &middot;
+<a href="/api/traces">traces</a> &middot;
 <a href="/metrics">metrics</a></p>
 </body></html>
 """
@@ -115,6 +119,10 @@ class Dashboard:
         if path == "/api/pg":
             _c, _rs, data = await self.mon._command({"prefix": "pg stat"})
             return data, b"application/json"
+        if path == "/api/traces":
+            digest = getattr(self.mon, "_mgr_digest", None) or {}
+            return (json.dumps(digest.get("traces", {})).encode(),
+                    b"application/json")
         if path == "/api/pools":
             om = self.mon.osdmap
             rows = []

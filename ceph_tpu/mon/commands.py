@@ -44,7 +44,7 @@ class CommandMixin:
             # mgr digests and knows the live quorum: redirect so peons
             # don't serve an empty status plane
             "status", "health", "pg stat", "df", "osd df",
-            "osd perf", "mgr stat",
+            "osd perf", "mgr stat", "trace ls", "trace show",
         )
         if mutating and not self.is_leader:
             leader = self.paxos.leader if self.paxos.leader is not None else -1
@@ -471,6 +471,31 @@ class CommandMixin:
                     ],
                     "source_mgr": d.get("active"),
                 }).encode()
+            if prefix == "trace ls":
+                # cross-daemon trace summaries from the active mgr's
+                # collector (rides the MMonMgrReport digest)
+                d = self._mgr_digest or {}
+                traces = d.get("traces", {})
+                return 0, "", json.dumps({
+                    "traces": traces.get("ls", []),
+                    "source_mgr": d.get("active"),
+                    "stats": traces.get("stats", {}),
+                }).encode()
+            if prefix == "trace show":
+                d = self._mgr_digest or {}
+                trees = (d.get("traces", {}) or {}).get("trees", {})
+                tid = str(cmd["trace_id"])
+                a = trees.get(tid)
+                if a is None:
+                    return (-errno.ENOENT,
+                            f"trace {tid} not in the digest window "
+                            "(only recent + slow traces ride the "
+                            "digest; see `trace ls`)", b"")
+                from ceph_tpu.mgr.tracer import render_tree
+
+                a = dict(a)
+                a["rendered"] = render_tree(a["tree"])
+                return 0, "", json.dumps(a).encode()
             if prefix == "health":
                 h = self._health_checks()
                 # module health checks ride the mgr digest (reference

@@ -166,8 +166,18 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, MgrServiceMixin,
         from ceph_tpu.mgr.client import MgrClient
 
         self.perf = get_perf_counters(f"mon.{rank}")
+        from ceph_tpu.common.tracing import Tracer
+
+        self.tracer = Tracer(
+            f"mon.{rank}",
+            ring_max=conf0["trace_ring_max"],
+            sample_rate=conf0["trace_sample_rate"],
+            tail_slow_s=(conf0["trace_tail_slow_s"] or None),
+        )
+        self.messenger.tracer = self.tracer
         self.mgr_client = MgrClient(
-            f"mon.{rank}", self.messenger, conf0, self._mgr_collect)
+            f"mon.{rank}", self.messenger, conf0, self._mgr_collect,
+            tracers=(self.tracer,))
         self._tids = itertools.count(1)
         self._scrub_waiters: dict[int, asyncio.Future] = {}
         self._tick_task: asyncio.Task | None = None
@@ -214,6 +224,14 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, MgrServiceMixin,
                 lambda cmd: __import__(
                     "ceph_tpu.chaos", fromlist=["dump_chaos"]
                 ).dump_chaos(),
+            )
+            self._admin.register(
+                "dump_traces", "recent spans (blkin/otel role)",
+                lambda cmd: self.tracer.dump(),
+            )
+            self._admin.register(
+                "perf dump", "dump perf counters",
+                lambda cmd: self.perf.dump(),
             )
             await self._admin.start()
         await self._replay()

@@ -1210,19 +1210,25 @@ class MMgrOpen(Message):
 
 class MMgrConfigure(Message):
     """active mgr -> daemon: report-stream tuning (reference
-    MMgrConfigure: stats_period)."""
+    MMgrConfigure: stats_period).  ``scrub_deprioritize`` closes the
+    analytics loop: the active mgr's outlier detection flags a slow
+    OSD and tells it to defer background scrubs (the slow-OSD-aware
+    scrub scheduling hook)."""
 
     TYPE = 123
 
-    def __init__(self, period: float = 1.0):
+    def __init__(self, period: float = 1.0,
+                 scrub_deprioritize: bool = False):
         self.period = period
+        self.scrub_deprioritize = scrub_deprioritize
 
     def encode_payload(self, enc):
         enc.str_(repr(float(self.period)))
+        enc.bool_(self.scrub_deprioritize)
 
     @classmethod
     def decode_payload(cls, dec):
-        return cls(float(dec.str_()))
+        return cls(float(dec.str_()), dec.bool_())
 
 
 class MMgrReport(Message):
@@ -1236,7 +1242,10 @@ class MMgrReport(Message):
     - ``histograms``: cumulative fixed-bucket log2 latency histograms
       (common/optracker.py LatencyHistogram), mergeable as arrays;
     - ``status``: json side-channel (pg-state summary, the disk
-      read-error ledger, daemon health bits).
+      read-error ledger, daemon health bits);
+    - ``spans``: json list of finished trace spans drained from the
+      daemon's tracer export buffers — the side channel the mgr's
+      TraceCollector assembles cluster-wide traces from.
     """
 
     TYPE = 124
@@ -1244,12 +1253,13 @@ class MMgrReport(Message):
     def __init__(self, daemon: str = "", counters: dict | None = None,
                  gauges: dict | None = None,
                  histograms: dict[str, list[int]] | None = None,
-                 status: bytes = b""):
+                 status: bytes = b"", spans: bytes = b""):
         self.daemon = daemon
         self.counters = counters or {}
         self.gauges = gauges or {}
         self.histograms = histograms or {}
         self.status = status
+        self.spans = spans
 
     def encode_payload(self, enc):
         enc.str_(self.daemon)
@@ -1263,6 +1273,7 @@ class MMgrReport(Message):
             for b in buckets:
                 enc.u64(int(b))
         enc.bytes_(self.status)
+        enc.bytes_(self.spans)
 
     @classmethod
     def decode_payload(cls, dec):
@@ -1273,7 +1284,8 @@ class MMgrReport(Message):
             dec.str_(): [dec.u64() for _ in range(dec.u32())]
             for _ in range(dec.u32())
         }
-        return cls(daemon, counters, gauges, histograms, dec.bytes_())
+        return cls(daemon, counters, gauges, histograms, dec.bytes_(),
+                   dec.bytes_())
 
 
 class MMonMgrReport(Message):

@@ -17,6 +17,7 @@ reference's msgr2 message frames (header: type, source entity, seq).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
 from typing import Awaitable, Callable
 
@@ -48,6 +49,10 @@ class Message:
     # filled in on receive
     src: tuple[str, int] | None = None
     conn: "Connection | None" = None
+    # distributed-tracing context riding the frame header (the jaeger
+    # context-propagation role): set by the sender, decoded on receive.
+    # None = untraced message (zero wire cost beyond one bool).
+    trace = None
 
     def encode_payload(self, enc: Encoder) -> None:  # pragma: no cover
         raise NotImplementedError
@@ -66,6 +71,13 @@ def encode_message(msg: Message, src: tuple[str, int], seq: int) -> list[bytes]:
     head.str_(src[0])
     head.i64(src[1])
     head.u64(seq)
+    # trace context rides the header, not the payload: every message
+    # type propagates it without per-type encode changes (the msgr2
+    # frame-extension seam)
+    trace = getattr(msg, "trace", None)
+    head.bool_(trace is not None)
+    if trace is not None:
+        trace.encode(head)
     payload = Encoder()
     msg.encode_payload(payload)
     return [head.bytes(), payload.bytes()]
@@ -76,11 +88,17 @@ def decode_message(segments: list[bytes]) -> Message:
     mtype = dec.u32()
     src = (dec.str_(), dec.i64())
     _seq = dec.u64()
+    trace = None
+    if dec.bool_():
+        from ceph_tpu.common.tracing import TraceContext
+
+        trace = TraceContext.decode(dec)
     cls = _REGISTRY.get(mtype)
     if cls is None:
         raise frames.FrameError(f"unknown message type {mtype}")
     msg = cls.decode_payload(Decoder(segments[1]))
     msg.src = src
+    msg.trace = trace
     return msg
 
 
@@ -132,20 +150,32 @@ class Connection:
         delay = self.messenger.inject_delay
         if delay > 0:
             await asyncio.sleep(delay)
-        async with self._send_lock:
-            self._seq += 1
-            segs = encode_message(msg, self.messenger.entity, self._seq)
-            tag = frames.Tag.MESSAGE
-            if (
-                self.compressor is not None
-                and sum(len(s) for s in segs)
-                >= self.messenger.compress_min_size
-            ):
-                segs = [self.compressor.compress(s) for s in segs]
-                tag = frames.Tag.MESSAGE_COMPRESSED
-            await frames.write_frame(
-                self.writer, tag, segs, crypto=self.crypto
+        trace = getattr(msg, "trace", None)
+        tracer = self.messenger.tracer
+        span_cm = (
+            tracer.span(
+                "msg_send", ctx=trace, stage="net",
+                msg=type(msg).__name__,
+                peer=f"{self.peer[0]}.{self.peer[1]}" if self.peer else "?",
             )
+            if tracer is not None and trace is not None and trace.sampled
+            else contextlib.nullcontext()
+        )
+        with span_cm:
+            async with self._send_lock:
+                self._seq += 1
+                segs = encode_message(msg, self.messenger.entity, self._seq)
+                tag = frames.Tag.MESSAGE
+                if (
+                    self.compressor is not None
+                    and sum(len(s) for s in segs)
+                    >= self.messenger.compress_min_size
+                ):
+                    segs = [self.compressor.compress(s) for s in segs]
+                    tag = frames.Tag.MESSAGE_COMPRESSED
+                await frames.write_frame(
+                    self.writer, tag, segs, crypto=self.crypto
+                )
 
     async def _run(self) -> None:
         try:
@@ -188,6 +218,16 @@ class Connection:
                 ]
             msg = decode_message(segs)
             msg.conn = self
+            tracer = self.messenger.tracer
+            if (tracer is not None and msg.trace is not None
+                    and msg.trace.sampled):
+                # a zero-length arrival marker: the collector pairs it
+                # with the sender's msg_send span to bound wire time
+                with tracer.span(
+                    "msg_recv", ctx=msg.trace, stage="net",
+                    msg=type(msg).__name__,
+                ):
+                    pass
             await self.messenger._dispatch(msg)
         elif tag == frames.Tag.COMPRESSION_REQUEST:
             # inbound negotiation (compression_onwire.cc server
@@ -287,6 +327,11 @@ class Messenger:
         # deterministic chaos shim (ceph_tpu/chaos/netem.py Netem);
         # None = transparent
         self.netem = None
+        # the owning daemon's Tracer: messages carrying a SAMPLED
+        # trace context get msg_send/msg_recv spans (stage=net), the
+        # wire legs of the cluster-wide span tree; None = no messenger
+        # spans (clients of the raw messenger)
+        self.tracer = None
 
     async def _dispatch(self, msg: Message) -> None:
         if self.dispatcher is not None:

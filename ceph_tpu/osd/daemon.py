@@ -46,6 +46,7 @@ from ceph_tpu.ec import registry as ec_registry
 from ceph_tpu.msg.messages import (
     PING,
     PING_REPLY,
+    MMgrConfigure,
     MMgrMap,
     MMonSubscribe,
     MConfig,
@@ -223,8 +224,17 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         from ceph_tpu.common.tracing import Tracer
 
         # per-incarnation tracer: a restarted daemon must not inherit a
-        # dead daemon's span ring
-        self.tracer = Tracer(f"osd.{osd_id}")
+        # dead daemon's span ring.  Ring size, head-sampling rate and
+        # tail capture come from config (trace_* options); the
+        # messenger shares it so traced messages grow msg_send/recv
+        # net-stage spans
+        self.tracer = Tracer(
+            f"osd.{osd_id}",
+            ring_max=self.conf["trace_ring_max"],
+            sample_rate=self.conf["trace_sample_rate"],
+            tail_slow_s=(self.conf["trace_tail_slow_s"] or None),
+        )
+        self.messenger.tracer = self.tracer
 
         # slow-op forensics (TrackedOp.h:121) + per-subsystem dout
         self.op_tracker = OpTracker(
@@ -243,9 +253,12 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         # histograms + pg/ledger status to the active mgr
         from ceph_tpu.mgr.client import MgrClient
 
+        from ceph_tpu.common.tracing import device_tracer
+
         self.mgr_client = MgrClient(
             f"osd.{osd_id}", self.messenger, self.conf,
-            self._mgr_collect)
+            self._mgr_collect,
+            tracers=(self.tracer, device_tracer()))
         self.dlog = DoutLogger("osd", self.conf, name_suffix=str(osd_id))
         self._admin: object | None = None
         self._log_keep = self.conf["osd_min_pg_log_entries"]
@@ -611,11 +624,29 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                 pg_states[s] = pg_states.get(s, 0) + 1
         except ValueError:
             pass
+        # ops currently in flight past the complaint threshold: the
+        # live half of the SLOW_OPS signal (complaints only move when
+        # a slow op COMPLETES; a wedged op must still raise the warning)
+        thresh = self.op_tracker.slow_threshold
+        slow_inflight = sum(
+            1 for op in self.op_tracker.inflight.values()
+            if op.duration >= thresh
+        )
+        counters = dict(self.perf.dump())
+        # the tracing plane's own telemetry (prometheus module exports
+        # these as counters: spans recorded/dropped, sampler verdicts)
+        counters.update({
+            f"trace_{k}": float(v)
+            for k, v in self.tracer.counters.items()
+        })
+        counters["slow_ops_total"] = float(self.op_tracker.complaints)
         return {
-            "counters": self.perf.dump(),
+            "counters": counters,
             "gauges": {
                 "num_pgs": float(len(self._pg_logs)),
                 "inflight_ops": float(len(self.op_tracker.inflight)),
+                "slow_ops": float(self.op_tracker.complaints),
+                "slow_ops_inflight": float(slow_inflight),
             },
             "histograms": dict(self.op_tracker.histograms),
             "status": {
@@ -624,6 +655,9 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                 "read_errors": len(self._read_error_ledger),
                 "disk_escalated": self._disk_escalated,
                 "slow_ops": self.op_tracker.complaints,
+                "slow_ops_inflight": slow_inflight,
+                "scrub_deprioritized": bool(
+                    self.mgr_client.scrub_deprioritized),
             },
         }
 
@@ -933,13 +967,23 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             self._extent_cache_bytes -= len(old[2])
 
     async def _ecu_encode(self, sinfo, ec, logical):
-        """ecutil.encode via the farm (falls back inside)."""
-        return await ecutil.encode_async(
-            sinfo, ec, logical, service=self.encode_service)
+        """ecutil.encode via the farm (falls back inside).  Traced ops
+        get a device-stage span so the critical-path breakdown can
+        attribute encode time separately from net/queue/store."""
+        with self._maybe_span(
+            "ec_encode", parent=self._op_span.get(), stage="device",
+            nbytes=len(logical),
+        ):
+            return await ecutil.encode_async(
+                sinfo, ec, logical, service=self.encode_service)
 
     async def _ecu_decode_concat(self, sinfo, ec, chunks):
-        return await ecutil.decode_concat_async(
-            sinfo, ec, chunks, service=self.encode_service)
+        with self._maybe_span(
+            "ec_decode", parent=self._op_span.get(), stage="device",
+            shards=len(chunks),
+        ):
+            return await ecutil.decode_concat_async(
+                sinfo, ec, chunks, service=self.encode_service)
 
     def _pg_log(self, c: coll_t) -> PGLog:
         lg = self._pg_logs.get(c)
@@ -974,6 +1018,27 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             return _v_parse(self.store.getattr(c, o, VERSION_ATTR))
         except (FileNotFoundError, KeyError):
             return ZERO
+
+    def _maybe_span(self, name: str, parent=None, ctx=None, **tags):
+        """A tracer span joined to an existing trace, or a no-op when
+        there is none — background work (recovery, repair sweeps) must
+        not mint fresh root traces per shard write."""
+        import contextlib as _ctx
+
+        if parent is None and ctx is None:
+            return _ctx.nullcontext(None)
+        return self.tracer.span(name, parent=parent, ctx=ctx, **tags)
+
+    async def _store_latency_gate(self) -> None:
+        """Async injected-store-latency point (chaos degraded-disk
+        scenario: ``FAULTS.inject("store.latency.osd.<id>", delay=...,
+        count=None)``).  Unlike the sync store_fault_check delay this
+        sleeps on the event loop, so ONE slow disk slows only its own
+        commits — not every daemon co-hosted in the process."""
+        from ceph_tpu.common.fault_injector import FAULTS
+
+        if FAULTS._points:
+            await FAULTS.check(f"store.latency.osd.{self.id}")
 
     def _obj_lock(self, pool_id: int, oid: str) -> asyncio.Lock:
         key = (pool_id, oid)
@@ -1270,6 +1335,8 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                 await self._handle_map(msg)
             elif isinstance(msg, MMgrMap):
                 self.mgr_client.handle_mgr_map(msg)
+            elif isinstance(msg, MMgrConfigure):
+                self.mgr_client.handle_configure(msg)
             elif isinstance(msg, MConfig):
                 self._apply_mon_config(msg)
             elif isinstance(msg, MOSDPing):
@@ -1817,10 +1884,17 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                 self.perf.inc("op_r")
             self.dlog.dout(4, "osd.%d: op %s", self.id, tracked.description)
             tracked.mark_event("queued")
+            # the queue leg of the cluster trace (stage=queue): joined
+            # to the client's trace context when the op carries one, so
+            # mClock admission wait is attributable per op
+            q_sp = self.tracer.start_span(
+                "op_queue", ctx=msg.trace, stage="queue", oid=msg.oid)
             async with self.op_gate.admit("client"):
+                self.tracer.finish_span(q_sp)
                 tracked.mark_event("executing")
                 with self.tracer.span(
-                    "do_op", reqid=msg.reqid, oid=msg.oid, pool=msg.pool,
+                    "do_op", ctx=msg.trace,
+                    reqid=msg.reqid, oid=msg.oid, pool=msg.pool,
                     ops=len(msg.ops),
                 ) as _sp:
                     token = self._op_span.set(_sp)
@@ -2397,20 +2471,27 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             pool, pg, msg.oid, effects, attrs, version, delete,
             reqid=msg.reqid,
         )
-        if getattr(self.store, "blocking_commit", False):
-            await asyncio.to_thread(self.store.queue_transaction, t)
-        else:
-            self.store.queue_transaction(t)
+        parent_sp = self._op_span.get()
+        await self._store_latency_gate()
+        with self._maybe_span(
+            "store_commit", parent=parent_sp, stage="store", oid=msg.oid,
+        ):
+            if getattr(self.store, "blocking_commit", False):
+                await asyncio.to_thread(self.store.queue_transaction, t)
+            else:
+                self.store.queue_transaction(t)
         waits = []
         for osd in acting:
             if osd in (self.id, CRUSH_ITEM_NONE):
                 continue
             tid = next(self._tids)
-            waits.append(self._sub_op(osd, MOSDRepOp(
-                tid=tid, pg=pg, from_osd=self.id, oid=msg.oid,
-                attrs=attrs, delete=delete, epoch=self.epoch,
-                version=version, ops=effects, reqid=msg.reqid,
-            ), tid))
+            waits.append(self._traced_sub_op(
+                "rep_sub_op", parent_sp, NO_SHARD, osd, msg.reqid,
+                MOSDRepOp(
+                    tid=tid, pg=pg, from_osd=self.id, oid=msg.oid,
+                    attrs=attrs, delete=delete, epoch=self.epoch,
+                    version=version, ops=effects, reqid=msg.reqid,
+                ), tid))
         if waits:
             replies = await asyncio.gather(*waits, return_exceptions=True)
             lost = False
@@ -2468,10 +2549,16 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                     pool, msg.pg, msg.oid, msg.ops, msg.attrs, msg.version,
                     msg.delete, reqid=msg.reqid,
                 )
-                if getattr(self.store, "blocking_commit", False):
-                    await asyncio.to_thread(self.store.queue_transaction, t)
-                else:
-                    self.store.queue_transaction(t)
+                await self._store_latency_gate()
+                with self._maybe_span(
+                    "store_commit", ctx=msg.trace, stage="store",
+                    oid=msg.oid,
+                ):
+                    if getattr(self.store, "blocking_commit", False):
+                        await asyncio.to_thread(
+                            self.store.queue_transaction, t)
+                    else:
+                        self.store.queue_transaction(t)
             else:
                 # legacy full-object payload (recovery pushes reuse this)
                 await self._apply_full_object(

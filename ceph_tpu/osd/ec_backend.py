@@ -141,7 +141,7 @@ class ECBackendMixin:
                 tid = next(self._tids)
                 waits.append(self._traced_sub_op(
                     "ec_sub_write", parent_sp, shard, osd, reqid,
-                    self._sub_op(osd, MOSDECSubOpWrite(
+                    MOSDECSubOpWrite(
                         tid=tid, pg=pg, shard=shard, from_osd=self.id,
                         oid=oid, off=off, data=payload, attrs=attrs,
                         epoch=self.epoch, truncate=truncate,
@@ -149,7 +149,7 @@ class ECBackendMixin:
                         rmattrs=rmattrs or [], reqid=reqid,
                         prev_version=prev_version, guarded=guarded,
                         clone_snap=clone_snap, clone_snaps=clone_snaps,
-                    ), tid)))
+                    ), tid))
         first_err = 0
         if waits:
             reps = await asyncio.gather(*waits, return_exceptions=True)
@@ -197,12 +197,17 @@ class ECBackendMixin:
             # nobody else has (that one divergent shard would cost the
             # pg its availability margin)
             for shard, payload in local:
-                await self._apply_shard_write_async(
-                    pool, pg, shard, oid, payload, attrs, version=version,
-                    off=off, truncate=truncate, rmattrs=rmattrs,
-                    reqid=reqid, clone_snap=clone_snap,
-                    clone_snaps=clone_snaps,
-                )
+                await self._store_latency_gate()
+                with self._maybe_span(
+                    "store_commit", parent=parent_sp, stage="store",
+                    shard=shard, oid=oid,
+                ):
+                    await self._apply_shard_write_async(
+                        pool, pg, shard, oid, payload, attrs,
+                        version=version, off=off, truncate=truncate,
+                        rmattrs=rmattrs, reqid=reqid,
+                        clone_snap=clone_snap, clone_snaps=clone_snaps,
+                    )
         if estale:
             if _retried:
                 return -errno.EAGAIN
@@ -694,13 +699,21 @@ class ECBackendMixin:
             return ZERO
         return _v_parse(attrs.get(VERSION_ATTR))
 
-    async def _traced_sub_op(self, name, parent, shard, osd, reqid, coro):
+    async def _traced_sub_op(self, name, parent, shard, osd, reqid, msg, tid):
         """Child span per shard sub-op (the reference opens jaeger
-        child spans per ECSubRead/Write, ECCommon.cc:440-445)."""
+        child spans per ECSubRead/Write, ECCommon.cc:440-445) — and the
+        context-injection point: the sub-op message carries this span's
+        TraceContext, so the replica's apply/commit spans join the same
+        cluster-wide tree.  Untraced callers (recovery, background
+        repair) pass ``parent=None`` and ride the wire context-free."""
+        if parent is None:
+            return await self._sub_op(osd, msg, tid)
         with self.tracer.span(
             name, parent=parent, shard=shard, osd=osd, reqid=reqid,
-        ):
-            return await coro
+            stage="net",
+        ) as sp:
+            msg.trace = self.tracer.ctx_for(sp)
+            return await self._sub_op(osd, msg, tid)
 
     def _ec_avail(self, acting) -> dict[int, int]:
         """shard -> osd for the currently usable members of an acting
@@ -1024,11 +1037,11 @@ class ECBackendMixin:
         tid = next(self._tids)
         rep = await self._traced_sub_op(
             "ec_sub_read", self._op_span.get(), shard, osd,
-            "", self._sub_op(osd, MOSDECSubOpRead(
+            "", MOSDECSubOpRead(
                 tid=tid, pg=pg, shard=shard, from_osd=self.id, oid=oid,
                 off=off, length=length, want_attrs=True, epoch=self.epoch,
                 extents=extents or [], snap=snap,
-            ), tid))
+            ), tid)
         if rep.result != 0:
             return None, None, -rep.result
         return rep.data, rep.attrs, 0
@@ -1127,6 +1140,15 @@ class ECBackendMixin:
         result = 0
         try:
             await FAULTS.check("osd.ec_sub_write_apply")
+            # injected store latency (degraded-disk chaos) models the
+            # slow disk's SERVICE-QUEUE delay: it runs BEFORE the
+            # epoch/primacy/version guards below, so a map interval
+            # that changed while the op sat in the slow queue still
+            # fences it (a post-guard sleep would let a demoted
+            # primary's fan-out land after the new primary's
+            # reconcile already rolled the object — an acked-write
+            # time-travel the chaos engine caught on this scenario)
+            await self._store_latency_gate()
             if msg.version > ZERO and msg.version.epoch < self.epoch:
                 # a sub-write minted under an older map (the version
                 # carries the sender's ADMISSION epoch): accept it only
@@ -1152,13 +1174,20 @@ class ECBackendMixin:
                     # write would stamp stale data current
                     result = -errno.ESTALE
             if not skip and result == 0:
-                await self._apply_shard_write_async(
-                    pool, msg.pg, msg.shard, msg.oid, msg.data, msg.attrs,
-                    delete=msg.delete, version=msg.version,
-                    off=msg.off, truncate=msg.truncate,
-                    rmattrs=msg.rmattrs, reqid=msg.reqid,
-                    clone_snap=msg.clone_snap, clone_snaps=msg.clone_snaps,
-                )
+                # the replica leg of the cluster trace: joined to the
+                # primary's ec_sub_write span via the wire context
+                with self._maybe_span(
+                    "store_commit", ctx=msg.trace, stage="store",
+                    shard=msg.shard, oid=msg.oid,
+                ):
+                    await self._apply_shard_write_async(
+                        pool, msg.pg, msg.shard, msg.oid, msg.data,
+                        msg.attrs, delete=msg.delete, version=msg.version,
+                        off=msg.off, truncate=msg.truncate,
+                        rmattrs=msg.rmattrs, reqid=msg.reqid,
+                        clone_snap=msg.clone_snap,
+                        clone_snaps=msg.clone_snaps,
+                    )
         except OSError as e:
             result = -(e.errno or errno.EIO)
         await msg.conn.send_message(MOSDECSubOpWriteReply(

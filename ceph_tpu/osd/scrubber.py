@@ -402,6 +402,15 @@ class ScrubMixin:
                 if om is None:
                     continue
                 now = time.monotonic()
+                # slow-OSD-aware deprioritization (the mgr analytics
+                # loop): while the active mgr's outlier detection
+                # flags this OSD slow (MMgrConfigure
+                # scrub_deprioritize), background scrubs wait a
+                # multiple of the normal interval — client I/O on a
+                # struggling disk outranks housekeeping
+                factor = 1.0
+                if self.mgr_client.scrub_deprioritized:
+                    factor = self.conf["osd_scrub_deprioritize_factor"]
                 due: list[tuple[float, int, int, bool]] = []
                 for pid, pool in om.pools.items():
                     for ps in range(pool.pg_num):
@@ -418,8 +427,14 @@ class ScrubMixin:
                             continue
                         last, last_deep = self._scrub_stamps[(pid, ps)]
                         if deep_interval and now - last_deep > deep_interval:
+                            if now - last_deep <= deep_interval * factor:
+                                self.perf.inc("scrub_deferred_slow")
+                                continue
                             due.append((last_deep, pid, ps, True))
                         elif now - last > interval:
+                            if now - last <= interval * factor:
+                                self.perf.inc("scrub_deferred_slow")
+                                continue
                             due.append((last, pid, ps, False))
                 # drain everything due this tick CONCURRENTLY (stalest
                 # first for launch order): chunked admission through

@@ -214,12 +214,23 @@ class DecodeAggregator:
                 for j, (gi, off, width) in enumerate(chunk):
                     batch[j, :, :width] = group[gi][1][:, off:off + width]
                 shape_key = (bits.shape, b, k, w)
-                if shape_key not in self._warm:
+                cold = shape_key not in self._warm
+                if cold:
                     self._warm.add(shape_key)
                     self.stats["cold_launches"] += 1
                     self.metrics.inc("cold_launches", w=w, b=b)
-                out = np.asarray(
-                    jax.block_until_ready(gf_bitmatmul(bits, jnp.asarray(batch))))
+                # device-launch profiling span: bucket shape, lane
+                # occupancy and block-until-ready time, per launch —
+                # padding waste becomes visible in `ceph trace`/mgr
+                from ceph_tpu.common.tracing import device_tracer
+
+                with device_tracer().span(
+                    "xla_launch", stage="device", kind="decode_batch",
+                    w=w, b=b, b_real=b_real,
+                    occupancy=round(b_real / b, 3), cold=cold,
+                ) as _dsp:
+                    out = np.asarray(jax.block_until_ready(
+                        gf_bitmatmul(bits, jnp.asarray(batch))))
                 self.stats["launches"] += 1
                 self.stats["batched_requests"] += b_real
                 self.metrics.inc("launches", w=w, b=b)

@@ -168,9 +168,9 @@ class EncodeService:
                     padded[:, : rows.shape[1]] = rows
                 else:
                     padded = rows
-                self._note_shape(("tp", bits.shape, k, S), w=S)
-                out = np.asarray(
-                    sharded_encode_tp(self.mesh, bits, jnp.asarray(padded)))
+                with self._note_shape(("tp", bits.shape, k, S), w=S):
+                    out = np.asarray(sharded_encode_tp(
+                        self.mesh, bits, jnp.asarray(padded)))
                 self.stats["tp_dispatches"] += 1
                 self.metrics.inc("launches", w=S)
                 return [np.ascontiguousarray(out[:, : rows.shape[1]])]
@@ -190,9 +190,10 @@ class EncodeService:
         for i, (_, rows, _) in enumerate(group):
             batch[i, :, : rows.shape[1]] = rows
         axes = tuple(a for a in ("pg", "shard") if a in self.mesh.shape)
-        self._note_shape(("dp", bits.shape, B, k, S), w=S, b=B)
-        out = np.asarray(
-            batch_encode_dp(self.mesh, bits, jnp.asarray(batch), axis=axes))
+        with self._note_shape(("dp", bits.shape, B, k, S), w=S, b=B,
+                              b_real=len(group)):
+            out = np.asarray(batch_encode_dp(
+                self.mesh, bits, jnp.asarray(batch), axis=axes))
         self.stats["dp_dispatches"] += 1
         self.stats["coalesced"] += len(group)
         self.metrics.inc("launches", w=S, b=B)
@@ -205,13 +206,23 @@ class EncodeService:
             for i, (_, rows, _) in enumerate(group)
         ]
 
-    def _note_shape(self, shape_key: tuple, *, w: int, b: int = 1) -> None:
-        """Track whether a launch shape was already compiled; a miss is
-        a cold in-path compile the warmup should have covered."""
-        if shape_key not in self._warm:
+    def _note_shape(self, shape_key: tuple, *, w: int, b: int = 1,
+                    b_real: int = 1):
+        """Track whether a launch shape was already compiled (a miss is
+        a cold in-path compile the warmup should have covered) and
+        return the device-launch profiling span wrapping the launch."""
+        cold = shape_key not in self._warm
+        if cold:
             self._warm.add(shape_key)
             self.stats["cold_launches"] += 1
             self.metrics.inc("cold_launches", w=w, b=b)
+        from ceph_tpu.common.tracing import device_tracer
+
+        return device_tracer().span(
+            "xla_launch", stage="device",
+            kind=f"encode_{shape_key[0]}", w=w, b=b, b_real=b_real,
+            occupancy=round(b_real / max(b, 1), 3), cold=cold,
+        )
 
 
     def _run_group_single(self, group: list[tuple], bits, k) -> list[np.ndarray]:
@@ -231,9 +242,10 @@ class EncodeService:
         for (_, rows, _), w in zip(group, widths):
             big[:, off:off + w] = rows
             off += w
-        self._note_shape(("single", bits.shape, k, S), w=S)
-        out = np.asarray(BitmatrixCodec._apply(
-            bits, jnp.asarray(big), None))
+        with self._note_shape(("single", bits.shape, k, S), w=S,
+                              b_real=len(group)):
+            out = np.asarray(BitmatrixCodec._apply(
+                bits, jnp.asarray(big), None))
         self.stats["single_dispatches"] += 1
         self.stats["coalesced"] += len(group)
         self.metrics.inc("launches", w=S)

@@ -294,8 +294,9 @@ class ScrubVerifier:
         return hit
 
     def _note_launch(self, shape_key, kind, w, b, b_real,
-                     real_bytes, padded_bytes) -> None:
-        if shape_key not in self._warm:
+                     real_bytes, padded_bytes):
+        cold = shape_key not in self._warm
+        if cold:
             self._warm.add(shape_key)
             self.stats["cold_launches"] += 1
             self.metrics.inc("cold_launches", w=w, b=b, k=kind)
@@ -307,6 +308,16 @@ class ScrubVerifier:
         self.metrics.inc("padded_lanes", w=w, b=b, k=kind, by=b)
         self.metrics.inc("occupied_bytes", w=w, b=b, k=kind, by=real_bytes)
         self.metrics.inc("padded_bytes", w=w, b=b, k=kind, by=padded_bytes)
+        # device-launch profiling span (common/tracing.device_tracer):
+        # wraps the launch via the returned context manager, tagged
+        # with bucket shape, occupancy and cold-compile verdict
+        from ceph_tpu.common.tracing import device_tracer
+
+        return device_tracer().span(
+            "xla_launch", stage="device", kind=f"scrub_{kind}",
+            w=w, b=b, b_real=b_real, occupancy=round(b_real / b, 3),
+            cold=cold,
+        )
 
     def _run_crc_group(self, w: int, group: list[tuple]) -> list[int]:
         """Worker-thread body: batched crc32c launches over one bucket;
@@ -327,10 +338,12 @@ class ScrubVerifier:
             batch = np.zeros((b, w), np.uint8)
             for j, (arr, width, _f) in enumerate(chunk):
                 batch[j, :width] = arr
-            self._note_launch(("crc", b, w), "crc", w, b, b_real,
-                              sum(width for _, width, _ in chunk), b * w)
-            out = np.asarray(jax.block_until_ready(
-                batched_crc32c_device(mat, jnp.asarray(batch))))
+            with self._note_launch(
+                ("crc", b, w), "crc", w, b, b_real,
+                sum(width for _, width, _ in chunk), b * w,
+            ):
+                out = np.asarray(jax.block_until_ready(
+                    batched_crc32c_device(mat, jnp.asarray(batch))))
             for j in range(b_real):
                 outs[at + j] = int(out[j])
         return outs
@@ -367,12 +380,13 @@ class ScrubVerifier:
             for j, (_C, d, p, _f) in enumerate(chunk):
                 data[j, :, :d.shape[1]] = d
                 parity[j, :, :p.shape[1]] = p
-            self._note_launch((bits.shape, b, k, w), "enc", w, b, b_real,
-                              sum((k + m) * d.shape[1]
-                                  for _C, d, _p, _f in chunk),
-                              b * (k + m) * w)
-            out = np.asarray(jax.block_until_ready(gf_encode_compare(
-                bits, jnp.asarray(data), jnp.asarray(parity))))
+            with self._note_launch(
+                (bits.shape, b, k, w), "enc", w, b, b_real,
+                sum((k + m) * d.shape[1] for _C, d, _p, _f in chunk),
+                b * (k + m) * w,
+            ):
+                out = np.asarray(jax.block_until_ready(gf_encode_compare(
+                    bits, jnp.asarray(data), jnp.asarray(parity))))
             for j in range(b_real):
                 outs[at + j] = out[j]
         return outs

@@ -78,7 +78,8 @@ _STATUS = {
 
 
 class S3Frontend:
-    def __init__(self, store: RGWStore, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, store: RGWStore, host: str = "127.0.0.1",
+                 port: int = 0, conf=None):
         self.store = store
         self.host, self.port = host, port
         self._server: asyncio.AbstractServer | None = None
@@ -86,13 +87,22 @@ class S3Frontend:
         # session (mon subscription); reports dial out over the same
         # client messenger — rgw has no daemon messenger of its own
         from ceph_tpu.common import ConfigProxy, get_perf_counters
+        from ceph_tpu.common.tracing import Tracer
         from ceph_tpu.mgr.client import MgrClient
 
+        self.conf = conf if conf is not None else ConfigProxy()
         self.perf = get_perf_counters("rgw.main")
+        self.tracer = Tracer(
+            "rgw.main",
+            ring_max=self.conf["trace_ring_max"],
+            sample_rate=self.conf["trace_sample_rate"],
+            tail_slow_s=(self.conf["trace_tail_slow_s"] or None),
+        )
+        self._admin = None
         rados = store.meta.client
         self.mgr_client = MgrClient(
-            "rgw.main", rados.messenger, ConfigProxy(),
-            self._mgr_collect)
+            "rgw.main", rados.messenger, self.conf,
+            self._mgr_collect, tracers=(self.tracer,))
         self._rados = rados
 
     def _mgr_collect(self) -> dict:
@@ -109,12 +119,32 @@ class S3Frontend:
         self._server = await asyncio.start_server(
             self._serve, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+        sock_path = self.conf["admin_socket"]
+        if sock_path:
+            from ceph_tpu.common import AdminSocket
+
+            self._admin = AdminSocket(sock_path.replace("$id", "rgw.main"))
+            self._admin.register(
+                "dump_traces", "recent spans (blkin/otel role)",
+                lambda cmd: self.tracer.dump(),
+            )
+            self._admin.register(
+                "perf dump", "dump perf counters",
+                lambda cmd: self.perf.dump(),
+            )
+            self._admin.register(
+                "status", "daemon status",
+                lambda cmd: {"frontend": f"{self.host}:{self.port}"},
+            )
+            await self._admin.start()
         self._rados.set_mgr_map_listener(self.mgr_client.handle_mgr_map)
         self.mgr_client.start()
         log.info("rgw: listening on %s:%d", self.host, self.port)
 
     async def stop(self) -> None:
         await self.mgr_client.stop()
+        if self._admin is not None:
+            await self._admin.stop()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -128,7 +158,11 @@ class S3Frontend:
                 req = await self._read_request(reader)
                 if req is None:
                     break
-                status, headers, body = await self._handle(req)
+                with self.tracer.span(
+                    "rgw_req", method=req.method, path=req.path,
+                ) as sp:
+                    status, headers, body = await self._handle(req)
+                    sp.tag(status=status)
                 self.perf.inc("req")
                 if status >= 400:
                     self.perf.inc("req_err")

@@ -146,6 +146,103 @@ class TestAdminSocket:
 
         run(go())
 
+    def test_dump_traces_on_every_daemon(self, tmp_path):
+        """Satellite of the tracing PR: ``dump_traces`` must be served
+        by EVERY daemon's admin socket — OSD, mon, mgr, MDS and the
+        RGW frontend (mon/MDS/RGW historically lacked it) — and the
+        daemons that served traffic must have recorded spans."""
+
+        async def go():
+            from ceph_tpu.common import ConfigProxy
+            from ceph_tpu.fs import FSClient, MDSDaemon
+            from ceph_tpu.rgw import RGWStore, S3Frontend
+
+            sock_dir = str(tmp_path)
+            conf = {"admin_socket": sock_dir + "/ceph-$id.asok"}
+            async with Cluster(
+                n_osds=3, osd_conf=conf, mon_conf=conf,
+                n_mgrs=1, mgr_conf=conf,
+            ) as c:
+                # pools + one op per plane so every daemon works
+                await c.client.pool_create("rbd", pg_num=4, size=2)
+                io = c.client.ioctx("rbd")
+                await io.write_full("traced-obj", b"t" * 2048)
+                await c.client.pool_create("cephfs.meta", pg_num=4, size=2)
+                await c.client.pool_create("cephfs.data", pg_num=4, size=2)
+                mds = MDSDaemon(0, c.mon.addr, conf=ConfigProxy(conf))
+                await mds.start()
+                fs = FSClient(mds.addr, c.client.ioctx("cephfs.data"))
+                await fs.mount()
+                await fs.mkdir("/d")
+                await fs.unmount()
+                await c.client.pool_create("rgw.meta", pg_num=4, size=2)
+                await c.client.pool_create("rgw.data", pg_num=4, size=2)
+                store = RGWStore(
+                    c.client.ioctx("rgw.meta"),
+                    {"default": c.client.ioctx("rgw.data")},
+                )
+                fe = S3Frontend(store, conf=ConfigProxy(conf))
+                await fe.start()
+                # one (unauthenticated) request is enough for a span
+                import asyncio as _a
+
+                r, w = await _a.open_connection(fe.host, fe.port)
+                w.write(b"GET / HTTP/1.1\r\nHost: x\r\n\r\n")
+                await w.drain()
+                await r.read(64)
+                w.close()
+                try:
+                    socks = {
+                        "osd": sock_dir + "/ceph-0.asok",
+                        "mon": sock_dir + "/ceph-mon0.asok",
+                        "mgr": sock_dir + "/ceph-mgr.mgr0.asok",
+                        "mds": sock_dir + "/ceph-mds.0.asok",
+                        "rgw": sock_dir + "/ceph-rgw.main.asok",
+                    }
+                    for kind, path in socks.items():
+                        helptext = await admin_command(path, "help")
+                        assert "dump_traces" in helptext, (kind, helptext)
+                        spans = await admin_command(path, "dump_traces")
+                        assert isinstance(spans, list), kind
+                    # daemons that served traffic recorded real spans
+                    all_osd = []
+                    for i in range(3):
+                        all_osd += await admin_command(
+                            sock_dir + f"/ceph-{i}.asok", "dump_traces")
+                    assert any(s["name"] == "do_op" for s in all_osd)
+                    # wall + monotonic stamps ride every span dump
+                    sp = next(s for s in all_osd if s["name"] == "do_op")
+                    assert sp["start"] > 0 and sp["start_mono"] > 0
+                    assert sp["end_mono"] is not None
+                    assert sp["trace_id"]
+                    mds_spans = await admin_command(
+                        socks["mds"], "dump_traces")
+                    assert any(s["name"] == "mds_req" for s in mds_spans)
+                    rgw_spans = await admin_command(
+                        socks["rgw"], "dump_traces")
+                    assert any(s["name"] == "rgw_req" for s in rgw_spans)
+                finally:
+                    await fe.stop()
+                    await mds.stop()
+
+        run(go())
+
+    def test_trace_ring_max_configurable(self):
+        """trace_ring_max replaces the hardcoded 2048-span ring."""
+        from ceph_tpu.common.tracing import Tracer
+
+        t = Tracer("ring-test", ring_max=4, sample_rate=0.0,
+                   tail_slow_s=None)
+        for i in range(10):
+            with t.span(f"s{i}"):
+                pass
+        dump = t.dump()
+        assert len(dump) == 4
+        assert [d["name"] for d in dump] == ["s6", "s7", "s8", "s9"]
+        assert t.counters["spans_recorded"] == 10
+        assert t.counters["spans_dropped"] == 6
+        assert t.counters["sampler_reject"] == 10
+
     def test_dump_chaos_surface(self, tmp_path):
         """The chaos engine's observability plane: events applied by
         the runner land in the process-wide ``chaos`` counters and

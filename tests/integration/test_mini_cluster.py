@@ -38,20 +38,33 @@ def run(coro):
 
 class Cluster:
     def __init__(self, n_osds: int = N_OSDS, osd_conf: dict | None = None,
-                 store_factory=None):
+                 store_factory=None, mon_conf: dict | None = None,
+                 n_mgrs: int = 0, mgr_conf: dict | None = None):
+        from ceph_tpu.common import ConfigProxy
+
         self.osd_conf = osd_conf
         self.store_factory = store_factory
+        self.mgr_conf = mgr_conf
         crush = CrushMap()
         # one osd per host: failure domain host == osd for small tests
         B.build_hierarchy(crush, osds_per_host=1, n_hosts=n_osds)
-        self.mon = Monitor(crush=crush)
+        self.mon = Monitor(
+            crush=crush,
+            conf=ConfigProxy(mon_conf) if mon_conf else None)
         self.osds: list[OSDDaemon] = [None] * n_osds
+        self.mgrs: list = [None] * n_mgrs
         self.client = RadosClient(client_id=4242)
 
     async def __aenter__(self):
         await self.mon.start()
         from ceph_tpu.common import ConfigProxy
 
+        for i in range(len(self.mgrs)):
+            from ceph_tpu.mgr.daemon import MgrDaemon
+
+            conf = ConfigProxy(self.mgr_conf) if self.mgr_conf else None
+            self.mgrs[i] = MgrDaemon(f"mgr{i}", [self.mon.addr], conf=conf)
+            await self.mgrs[i].start()
         for i in range(len(self.osds)):
             conf = ConfigProxy(self.osd_conf) if self.osd_conf else None
             store = self.store_factory(i) if self.store_factory else None
@@ -65,6 +78,9 @@ class Cluster:
         for osd in self.osds:
             if osd is not None:
                 await osd.stop()
+        for mgr in self.mgrs:
+            if mgr is not None:
+                await mgr.stop()
         await self.mon.stop()
 
     async def wait_epoch(self, epoch: int) -> None:

@@ -128,13 +128,24 @@ def test_chaos_artifact_cited_and_green():
         "the disk-fault scenario must stay artifact-proven")
     assert "mgr-failover" in scenarios_covered, (
         "the mgr-failover scenario must stay artifact-proven")
-    # the mgr-failover runs must have judged the mgr invariant
+    assert "degraded-disk" in scenarios_covered, (
+        "the degraded-disk scenario (slow-OSD detection loop: "
+        "SLOW_OPS health + outlier-driven scrub deprioritization) "
+        "must stay artifact-proven")
+    # scenario-specific invariants must have been judged green
     for name in cited:
         with open(os.path.join(REPO, name)) as f:
             doc = json.load(f)
         for r in doc["runs"]:
             if r["scenario"] == "mgr-failover":
                 assert r["invariants"]["mgr"]["ok"], r
+            if r["scenario"] == "degraded-disk":
+                assert r["invariants"]["slow_osd"]["ok"], r
+                obs = r.get("slow_osd_obs", {})
+                assert obs.get("slow_ops_raised"), r
+                assert obs.get("outlier_flagged"), r
+                assert obs.get("scrub_deprioritized"), r
+                assert obs.get("slow_ops_cleared"), r
 
 
 def test_chaos_artifact_traces_replay():

@@ -130,6 +130,56 @@ class TestPrometheusExposition:
         assert "# TYPE ceph_tpu_osd_99_op counter" in text
         assert "# TYPE ceph_tpu_osd_99_pg_count gauge" in text
 
+    def test_mgr_module_exports_tracing_and_optracker_counters(self):
+        """Satellite of the tracing PR: the mgr prometheus module's
+        exposition carries the tracing-plane counters (spans recorded/
+        dropped, sampler accept/reject) and the per-daemon slow-op
+        count, each with a correct ``# TYPE`` line."""
+        from ceph_tpu.mgr.daemon import MgrDaemon
+
+        mgr = MgrDaemon("expo", ("127.0.0.1", 1))
+        mgr.sessions["osd.0"] = {
+            "counters": {
+                "trace_spans_recorded": 12.0,
+                "trace_spans_dropped": 0.0,
+                "trace_sampler_accept": 9.0,
+                "trace_sampler_reject": 3.0,
+                "slow_ops_total": 2.0,
+            },
+            "gauges": {"slow_ops": 2.0, "slow_ops_inflight": 1.0},
+            "histograms": {}, "status": {}, "reports": 1,
+        }
+        text = mgr.modules["prometheus"].text()
+        for name, typ in (
+            ("trace_spans_recorded", "counter"),
+            ("trace_spans_dropped", "counter"),
+            ("trace_sampler_accept", "counter"),
+            ("trace_sampler_reject", "counter"),
+            ("slow_ops_total", "counter"),
+            ("slow_ops", "gauge"),
+            ("slow_ops_inflight", "gauge"),
+        ):
+            metric = f"ceph_tpu_osd_0_{name}"
+            assert f"# TYPE {metric} {typ}" in text, (name, typ)
+            assert f"\n{metric} " in "\n" + text, name
+
+    def test_osd_report_carries_tracing_counters(self):
+        """The OSD's _mgr_collect (the MMgrReport raw material) must
+        include the tracer's telemetry and the slow-op counts the
+        prometheus module exports."""
+        from ceph_tpu.common.tracing import Tracer
+
+        # exercise the tracer counter plumbing without booting an OSD
+        t = Tracer("osd.77", sample_rate=1.0)
+        with t.span("do_op", oid="x"):
+            pass
+        assert t.counters["spans_recorded"] == 1
+        assert t.counters["sampler_accept"] == 1
+        # and the exported span is drainable exactly once
+        spans = t.drain_export()
+        assert len(spans) == 1 and spans[0]["name"] == "do_op"
+        assert t.drain_export() == []
+
     def test_histogram_exposition(self):
         pc = PerfCounters("osd.7")
         h = LatencyHistogram()

@@ -16,6 +16,7 @@ mini-cluster's command surface:
   ceph.py -m HOST:PORT mgr dump | mgr stat | mgr fail [NAME]
   ceph.py -m HOST:PORT mgr module ls | mgr module enable NAME
           | mgr module disable NAME
+  ceph.py -m HOST:PORT trace ls | trace show TRACE_ID
 
 Multiple monitors: -m accepts a comma-separated monmap.
 """
@@ -113,6 +114,25 @@ async def amain(args, extra: list[str]) -> int:
             code, rs, data = await client.command({"prefix": "pg stat"})
         elif verb == "health":
             code, rs, data = await client.command({"prefix": "health"})
+        elif verb == "trace" and extra[:1] == ["ls"]:
+            code, rs, data = await client.command({"prefix": "trace ls"})
+        elif verb == "trace" and extra[:1] == ["show"]:
+            code, rs, data = await client.command({
+                "prefix": "trace show", "trace_id": extra[1]})
+            if code == 0 and data:
+                # render the span tree human-readable, then the
+                # critical-path/stage breakdown as JSON
+                doc = json.loads(data)
+                for line in doc.get("rendered", []):
+                    print(line)
+                print(json.dumps({
+                    "trace_id": doc.get("trace_id"),
+                    "reqid": doc.get("reqid"),
+                    "duration_ms": doc.get("duration_ms"),
+                    "stages_ms": doc.get("stages_ms"),
+                    "critical_path": doc.get("critical_path"),
+                }, indent=2))
+                return 0
         elif verb == "config" and extra[:1] == ["set"]:
             code, rs, data = await client.command({
                 "prefix": "config set", "who": extra[1],
