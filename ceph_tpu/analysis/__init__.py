@@ -1,0 +1,39 @@
+"""ctlint — AST-based invariant analysis for the ceph_tpu tree.
+
+The runtime already *proves* its hot-path invariants after the fact:
+``cold_launches == 0`` counters show the device discipline held, chaos
+trace hashes show schedules were deterministic, and tests show frames
+and config keys stayed wired.  This package proves the same invariants
+at lint time, before a cold code path ships a violation — the role a
+race detector or clang-tidy pass plays for the C++ reference.
+
+Five rule families (see :mod:`ceph_tpu.analysis.rules`):
+
+- **device-discipline** — every jit/pmap/shard_map-wrapped callable
+  reachable from the I/O-path modules must appear in the declared
+  prewarm registry; shapes fed to jitted kernels must come from the
+  pow2-bucket helpers; no device sync under a held lock.
+- **lock-order** — cross-module lock-acquisition graph: cycles, and
+  blocking calls (sleep, socket send, store commit) under held locks.
+- **wire-protocol** — duplicate/unregistered frame ids and
+  encode/decode field asymmetry in ``msg/messages.py``.
+- **config-registry** — every config key read anywhere must have a
+  registered default; dead registered options are reported.
+- **determinism** — no wall clock, ``random``-module globals, or
+  unordered-set iteration in pure-trace paths (``chaos/schedule.py``).
+
+Run via ``tools/lint.py`` (human / ``--json`` / ``--update-baseline``)
+or through the tier-1 gate ``tests/test_static_analysis.py``.
+Suppress a finding inline with ``# ctlint: disable=<rule>`` and
+grandfather the remainder in ``ctlint_baseline.json``.
+"""
+
+from ceph_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Project,
+    Rule,
+    load_baseline,
+    run_analysis,
+    split_by_baseline,
+)
+from ceph_tpu.analysis.rules import ALL_RULES  # noqa: F401

@@ -1,0 +1,78 @@
+"""Declared prewarm registry — the static twin of ``cold_launches == 0``.
+
+Every ``jax.jit`` / ``pmap`` / ``shard_map``-wrapped callable reachable
+from the I/O-path modules (``osd/``, ``parallel/``,
+``mgr/analytics.py``) must appear here, keyed ``module:qualname``, with
+a note saying WHICH warmup path compiles it before the I/O path can
+reach it.  The device-discipline rule (``device-prewarm``) fails the
+lint when a reachable jit site is missing — so adding a new kernel
+forces the author to either wire it into a warmup or consciously
+register why it cannot compile mid-I/O.
+
+Keep the runtime invariant in mind when editing: an entry here is a
+*claim* that chaos' ``cold_launches`` gate stays green; the claim is
+checked by ``tools/chaos_run.py`` and the batcher tests, not by ctlint.
+"""
+
+from __future__ import annotations
+
+#: ``module:qualname`` of the jit/shard_map site -> which warmup covers
+#: it (or why it is allowed to compile outside the I/O path).
+PREWARMED: dict[str, str] = {
+    "ceph_tpu.ops.rs_kernels:gf_bitmatmul":
+        "decode/scrub batcher prewarm() + encode_service prewarm() "
+        "compile every (signature, batch, bucket) shape at EC map-"
+        "install warmup (osd/daemon.py _ec_warmup)",
+    "ceph_tpu.ops.rs_kernels:gf_encode_compare":
+        "scrub_batcher.prewarm() compiles the full bucket ladder at EC "
+        "warmup; the scrub I/O path only ever launches warmed shapes",
+    "ceph_tpu.ops.rs_kernels:gf_bitmatmul_pallas_grouped":
+        "bench/experimental Pallas path; not dispatched by the I/O "
+        "path (ec_benchmark + perf labs call it directly)",
+    "ceph_tpu.ops.rs_kernels:gf_bitmatmul_pallas":
+        "bench/experimental Pallas path; not dispatched by the I/O path",
+    "ceph_tpu.ops.rs_kernels:gf_bitmatmul_pallas_acc":
+        "bench/experimental Pallas path; not dispatched by the I/O path",
+    "ceph_tpu.ops.hashing:_crc_kernel_jit.kern":
+        "scrub_batcher.prewarm() compiles every (crc_lanes, bucket) "
+        "shape at EC warmup; lru_cache(1) keeps one program per process",
+    "ceph_tpu.mgr.analytics:AnalyticsEngine._build_jit":
+        "AnalyticsEngine.prewarm() compiles the single fixed (D, M, W) "
+        "shape at mgr start (mgr/daemon.py), before any digest pass",
+    "ceph_tpu.crush.jaxmapper:BatchedRuleMapper._build":
+        "compiled once per (map, rule) at mapper construction — remap "
+        "builds mappers at map-install/peering, never per-op; the "
+        "executable is reused across epochs (osd/remap.py)",
+    "ceph_tpu.ec.plugins.clay_jit:ClayRepairProgram.__init__":
+        "CLAY repair programs are staged per (profile, lost-node) at "
+        "recovery planning time via stage(), outside the shard-read "
+        "critical path; executables persist in the XLA disk cache",
+    "ceph_tpu.parallel.encode_farm:batch_encode_dp._encode":
+        "encode_service.prewarm() drives the farm over every warmed "
+        "(bucket, batch) shape at EC map-install warmup",
+    "ceph_tpu.parallel.encode_farm:sharded_encode_tp._encode":
+        "encode_service.prewarm() covers the tensor-parallel path for "
+        "the shapes the farm selects it for",
+}
+
+#: host-side entry points that dispatch straight into a jitted program:
+#: the device-shape rule (``device-raw-shape``) flags call sites in
+#: I/O-path modules that feed these a raw ``len()``/``.shape`` derived
+#: dimension instead of a pow2-bucketed one.
+JIT_ENTRYPOINTS: frozenset[str] = frozenset({
+    "gf_bitmatmul",
+    "gf_encode_compare",
+    "gf_bitmatmul_pallas",
+    "gf_bitmatmul_pallas_acc",
+    "gf_bitmatmul_pallas_grouped",
+    "batched_crc32c_device",
+    "batch_encode_dp",
+    "sharded_encode_tp",
+})
+
+#: the pow2-bucket helpers whose outputs are legitimate launch
+#: dimensions (the shape-discipline allowlist)
+BUCKET_HELPERS: frozenset[str] = frozenset({
+    "pow2_bucket",
+    "bucket_lanes",
+})

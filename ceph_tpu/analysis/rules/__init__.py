@@ -1,0 +1,21 @@
+"""ctlint rule families.  Each module contributes one Rule subclass;
+``ALL_RULES`` is the suite ``tools/lint.py`` and the tier-1 gate run."""
+
+from ceph_tpu.analysis.rules.configrule import ConfigRegistryRule
+from ceph_tpu.analysis.rules.determinism import DeterminismRule
+from ceph_tpu.analysis.rules.device import DeviceDisciplineRule
+from ceph_tpu.analysis.rules.locks import LockOrderRule
+from ceph_tpu.analysis.rules.wire import WireProtocolRule
+
+ALL_RULES = (
+    DeviceDisciplineRule,
+    LockOrderRule,
+    WireProtocolRule,
+    ConfigRegistryRule,
+    DeterminismRule,
+)
+
+#: rule-id -> one-line description (the catalog tools/lint.py prints)
+RULE_CATALOG: dict[str, str] = {}
+for _cls in ALL_RULES:
+    RULE_CATALOG.update(_cls.catalog)

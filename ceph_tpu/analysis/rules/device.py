@@ -1,0 +1,236 @@
+"""Rule family 1: device discipline.
+
+The runtime invariant is ``cold_launches == 0`` — no XLA compile and no
+unplanned device sync inside the I/O path.  Statically that decomposes
+into three checks:
+
+- ``device-prewarm`` — every jit/pmap/shard_map site in a module
+  reachable (via the import graph) from the I/O-path roots (``osd/``,
+  ``parallel/``, ``mgr/analytics.py``) must be declared in
+  :mod:`ceph_tpu.analysis.prewarm_registry` with a note naming the
+  warmup that compiles it.
+- ``device-raw-shape`` — arguments fed to the known jitted entry
+  points from I/O-path modules must not contain a raw ``len(...)`` or
+  ``.shape`` expression: dynamic dims mint fresh compiled shapes; go
+  through ``pow2_bucket`` / ``bucket_lanes``.
+- ``device-sync-under-lock`` — no ``block_until_ready`` / ``device_put``
+  while a lock is held: a device sync (worse, a compile) under a lock
+  serializes every other thread behind XLA.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ceph_tpu.analysis.core import SEV_ERROR, Finding, Project, Rule
+from ceph_tpu.analysis.prewarm_registry import (
+    BUCKET_HELPERS,
+    JIT_ENTRYPOINTS,
+    PREWARMED,
+)
+from ceph_tpu.analysis.rules.common import (
+    ScopedVisitor,
+    attr_chain,
+    call_name,
+    is_lockish,
+)
+
+#: wrappers whose application creates a compiled program
+_JIT_WRAPPERS = {"jax.jit", "jax.pmap", "pjit", "shard_map"}
+_SYNC_CALLS = {"block_until_ready", "device_put"}
+
+
+def _is_jit_wrapper(node: ast.AST) -> bool:
+    """True for ``jax.jit`` / ``pjit`` / ``shard_map`` / ``jax.pmap``
+    name nodes (exact match on the dotted or bare name — the
+    encode_farm facade is itself named ``shard_map``)."""
+    chain = attr_chain(node)
+    if chain is None:
+        return False
+    return chain in _JIT_WRAPPERS or chain.split(".")[-1] in {
+        "pjit", "pmap"} or chain == "jit" or chain.endswith(".jit")
+
+
+def _is_partial_of_jit(call: ast.Call) -> bool:
+    name = call_name(call)
+    if name not in ("functools.partial", "partial"):
+        return False
+    return bool(call.args) and _is_jit_wrapper(call.args[0])
+
+
+class _JitSiteVisitor(ScopedVisitor):
+    """Collects (qualname, line) of every program-creating site."""
+
+    def __init__(self):
+        super().__init__()
+        self.sites: list[tuple[str, int]] = []
+
+    def _check_decorators(self, node):
+        for dec in node.decorator_list:
+            if _is_jit_wrapper(dec):
+                self.sites.append(
+                    (".".join(self.scope + [node.name]), node.lineno))
+            elif isinstance(dec, ast.Call) and (
+                    _is_jit_wrapper(dec.func) or _is_partial_of_jit(dec)):
+                self.sites.append(
+                    (".".join(self.scope + [node.name]), node.lineno))
+
+    def visit_FunctionDef(self, node):
+        self._check_decorators(node)
+        self._push(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._check_decorators(node)
+        self._push(node)
+
+    def visit_Call(self, node):
+        if _is_jit_wrapper(node.func):
+            self.sites.append((self.qualname, node.lineno))
+        self.generic_visit(node)
+
+
+def _io_path_roots(project: Project) -> set[str]:
+    roots = set()
+    for sf in project.files:
+        if (sf.path.startswith("ceph_tpu/osd/")
+                or sf.path.startswith("ceph_tpu/parallel/")
+                or sf.path == "ceph_tpu/mgr/analytics.py"):
+            roots.add(sf.module)
+    return roots
+
+
+class DeviceDisciplineRule(Rule):
+    name = "device-discipline"
+    rules = ("device-prewarm", "device-raw-shape", "device-sync-under-lock")
+    catalog = {
+        "device-prewarm":
+            "jit/pmap/shard_map site reachable from the I/O path is "
+            "not declared in the prewarm registry",
+        "device-raw-shape":
+            "raw len()/.shape fed to a jitted entry point instead of a "
+            "pow2-bucketed dimension",
+        "device-sync-under-lock":
+            "block_until_ready/device_put while holding a lock",
+    }
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        roots = _io_path_roots(project)
+        reachable = project.reachable_from(roots) | roots
+        mods = project.by_module()
+
+        # -- device-prewarm ---------------------------------------------
+        for mod in sorted(reachable):
+            sf = mods.get(mod)
+            if sf is None:
+                continue
+            v = _JitSiteVisitor()
+            v.visit(sf.tree)
+            for qual, line in v.sites:
+                key = f"{mod}:{qual}"
+                if key not in PREWARMED:
+                    findings.append(Finding(
+                        "device-prewarm", SEV_ERROR, sf.path, line,
+                        f"jitted callable {key} is not in the prewarm "
+                        f"registry (ceph_tpu/analysis/prewarm_registry."
+                        f"py) — declare which warmup compiles it, or it "
+                        f"will compile inside the I/O path",
+                    ))
+
+        # stale registry entries point at renamed/removed kernels —
+        # only meaningful when the project actually contains the
+        # registry module (fixture projects don't)
+        cfg_path = "ceph_tpu/analysis/prewarm_registry.py"
+        if any(sf.path == cfg_path for sf in project.files):
+            live_keys = set()
+            for mod in mods:
+                v = _JitSiteVisitor()
+                v.visit(mods[mod].tree)
+                live_keys |= {f"{mod}:{q}" for q, _ in v.sites}
+            for key in sorted(set(PREWARMED) - live_keys):
+                findings.append(Finding(
+                    "device-prewarm", SEV_ERROR, cfg_path, 1,
+                    f"prewarm registry entry {key} matches no jit site "
+                    f"in the tree (renamed or removed kernel?)",
+                ))
+
+        # -- device-raw-shape / device-sync-under-lock ------------------
+        for sf in project.files:
+            in_io_path = sf.module in roots
+            findings.extend(_scan_module(sf, in_io_path))
+        return findings
+
+
+def _scan_module(sf, in_io_path: bool) -> list[Finding]:
+    findings: list[Finding] = []
+
+    class V(ScopedVisitor):
+        def __init__(self):
+            super().__init__()
+            self.lock_depth = 0
+
+        def visit_With(self, node):
+            held = sum(
+                1 for item in node.items if is_lockish(item.context_expr))
+            self.lock_depth += held
+            self.generic_visit(node)
+            self.lock_depth -= held
+
+        visit_AsyncWith = visit_With
+
+        def visit_Call(self, node):
+            name = call_name(node)
+            short = name.split(".")[-1] if name else None
+            if self.lock_depth and short in _SYNC_CALLS:
+                findings.append(Finding(
+                    "device-sync-under-lock", SEV_ERROR, sf.path,
+                    node.lineno,
+                    f"{short}() while holding a lock in "
+                    f"{sf.module}:{self.qualname} — a device sync (or "
+                    f"compile) under a lock stalls every waiter; move "
+                    f"the launch outside the critical section",
+                ))
+            if in_io_path and short in JIT_ENTRYPOINTS:
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    bad = _raw_dim(arg)
+                    if bad is not None:
+                        findings.append(Finding(
+                            "device-raw-shape", SEV_ERROR, sf.path,
+                            bad.lineno,
+                            f"argument of jitted entry point {short}() "
+                            f"in {sf.module}:{self.qualname} contains a "
+                            f"raw {_describe(bad)} — dynamic dims mint "
+                            f"new compiled shapes; route the size "
+                            f"through pow2_bucket()/bucket_lanes()",
+                        ))
+                        break
+            self.generic_visit(node)
+
+    V().visit(sf.tree)
+    return findings
+
+
+def _raw_dim(arg: ast.AST) -> ast.AST | None:
+    """First raw ``len(...)`` call or ``.shape`` access in the argument
+    expression that is not wrapped by a bucket helper."""
+    guarded: set[int] = set()
+    for sub in ast.walk(arg):
+        if isinstance(sub, ast.Call):
+            name = call_name(sub)
+            if name and name.split(".")[-1] in BUCKET_HELPERS:
+                for inner in ast.walk(sub):
+                    guarded.add(id(inner))
+    for sub in ast.walk(arg):
+        if id(sub) in guarded:
+            continue
+        if (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Name)
+                and sub.func.id == "len"):
+            return sub
+        if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+            return sub
+    return None
+
+
+def _describe(node: ast.AST) -> str:
+    return "len() call" if isinstance(node, ast.Call) else ".shape access"

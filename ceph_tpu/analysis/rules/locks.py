@@ -1,0 +1,198 @@
+"""Rule family 2: lock order and blocking-under-lock.
+
+- ``lock-cycle`` — the cross-module lock-acquisition graph (built from
+  lexically nested ``with <lock>`` blocks and ``.acquire()`` calls
+  under a held lock) must be acyclic; a cycle is a potential deadlock
+  the interleave fuzzer can only find by luck.
+- ``lock-blocking`` — no blocking call (sleep, fsync, subprocess,
+  socket send, dynamic import, store commit) while a lock is held.
+  One level of same-module call inlining is applied, so a method that
+  takes a lock and then calls a sibling that blocks is still caught.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ceph_tpu.analysis.core import SEV_ERROR, SEV_WARNING, Finding, Project, Rule
+from ceph_tpu.analysis.rules.common import (
+    ScopedVisitor,
+    call_name,
+    is_lockish,
+    lock_ident,
+)
+
+#: dotted (or trailing) call names that block the calling thread
+_BLOCKING = {
+    "time.sleep": "sleeps",
+    "os.fsync": "does disk I/O (fsync)",
+    "os.fdatasync": "does disk I/O (fdatasync)",
+    "subprocess.run": "spawns a process",
+    "subprocess.check_call": "spawns a process",
+    "subprocess.check_output": "spawns a process",
+    "subprocess.Popen": "spawns a process",
+    "importlib.import_module": "does a dynamic import (module-level "
+                               "code + disk I/O)",
+    "socket.create_connection": "does network I/O",
+}
+#: method names that block regardless of receiver
+_BLOCKING_METHODS = {
+    "sendall": "does network I/O",
+    "apply_transaction": "commits to the store",
+    "queue_transaction": "commits to the store",
+}
+
+
+def _blocking_reason(name: str | None) -> str | None:
+    if not name:
+        return None
+    if name in _BLOCKING:
+        return _BLOCKING[name]
+    short = name.split(".")[-1]
+    # match dotted suffixes like self._sock.sendall
+    for dotted, why in _BLOCKING.items():
+        if name.endswith("." + dotted):
+            return why
+    return _BLOCKING_METHODS.get(short)
+
+
+class _LockVisitor(ScopedVisitor):
+    """Per-module pass: collects acquisition-order edges, blocking
+    calls under locks, and (for the inlining pass) which functions
+    block or lock internally."""
+
+    def __init__(self, sf):
+        super().__init__()
+        self.sf = sf
+        self.held: list[tuple[str, int]] = []   # (lock ident, line)
+        self.edges: list[tuple[str, str, str, int]] = []  # a, b, path, line
+        self.blocking: list[tuple[str, int, str]] = []
+        #: qualname -> (reason, line) for defs that block unconditionally
+        self.fn_blocks: dict[str, tuple[str, int]] = {}
+        #: qualname -> lock idents the def acquires
+        self.fn_locks: dict[str, list[tuple[str, int]]] = {}
+        #: calls made under a held lock: (callee short name, line,
+        #: holder qualname) — resolved against fn_blocks/fn_locks later
+        self.calls_under_lock: list[tuple[str, int]] = []
+
+    def _enter_locks(self, node) -> int:
+        n = 0
+        for item in node.items:
+            if is_lockish(item.context_expr):
+                ident = lock_ident(
+                    self.sf.module, self.scope, item.context_expr)
+                if self.held:
+                    self.edges.append((
+                        self.held[-1][0], ident, self.sf.path, node.lineno))
+                self.held.append((ident, node.lineno))
+                n += 1
+        return n
+
+    def visit_With(self, node):
+        n = self._enter_locks(node)
+        self.generic_visit(node)
+        if n:
+            del self.held[-n:]
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node):
+        name = call_name(node)
+        short = name.split(".")[-1] if name else None
+        if short == "acquire" and name and is_lockish(node.func.value):
+            ident = lock_ident(self.sf.module, self.scope, node.func.value)
+            if self.held:
+                self.edges.append((
+                    self.held[-1][0], ident, self.sf.path, node.lineno))
+        if self.held:
+            reason = _blocking_reason(name)
+            if reason is not None:
+                self.blocking.append((name, node.lineno, reason))
+            elif name and name.startswith("self."):
+                self.calls_under_lock.append((short, node.lineno))
+        else:
+            reason = _blocking_reason(name)
+            if reason is not None and self.scope:
+                self.fn_blocks.setdefault(
+                    self.scope[-1], (reason, node.lineno))
+        self.generic_visit(node)
+
+
+class LockOrderRule(Rule):
+    name = "lock-order"
+    rules = ("lock-cycle", "lock-blocking")
+    catalog = {
+        "lock-cycle":
+            "cycle in the cross-module lock-acquisition graph "
+            "(potential deadlock)",
+        "lock-blocking":
+            "blocking call (sleep/fsync/subprocess/import/commit) "
+            "while holding a lock",
+    }
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        edges: dict[str, set[str]] = {}
+        edge_at: dict[tuple[str, str], tuple[str, int]] = {}
+        visitors = []
+        for sf in project.files:
+            v = _LockVisitor(sf)
+            v.visit(sf.tree)
+            visitors.append(v)
+            for a, b, path, line in v.edges:
+                if a == b:
+                    continue  # re-entrant nesting of one lock: RLock
+                edges.setdefault(a, set()).add(b)
+                edge_at.setdefault((a, b), (path, line))
+            for name, line, reason in v.blocking:
+                findings.append(Finding(
+                    "lock-blocking", SEV_ERROR, sf.path, line,
+                    f"{name}() under a held lock {reason} — every "
+                    f"other acquirer stalls behind it; shrink the "
+                    f"critical section",
+                ))
+            # one-level inlining: self.<m>() under a lock where <m>
+            # blocks in its own body (same module)
+            for short, line in v.calls_under_lock:
+                hit = v.fn_blocks.get(short)
+                if hit is not None:
+                    reason, _ = hit
+                    findings.append(Finding(
+                        "lock-blocking", SEV_WARNING, sf.path, line,
+                        f"call to self.{short}() under a held lock — "
+                        f"{short}() {reason} (defined in this module); "
+                        f"the lock is held across that",
+                    ))
+
+        for cycle in _cycles(edges):
+            a, b = cycle[0], cycle[1 % len(cycle)]
+            path, line = edge_at.get((a, b), ("ceph_tpu", 1))
+            findings.append(Finding(
+                "lock-cycle", SEV_ERROR, path, line,
+                "lock-order cycle: " + " -> ".join(cycle + [cycle[0]]),
+            ))
+        return findings
+
+
+def _cycles(edges: dict[str, set[str]]) -> list[list[str]]:
+    """Elementary cycles via DFS; each reported once, rotated so the
+    lexicographically smallest node leads (stable messages)."""
+    seen: set[tuple[str, ...]] = set()
+    out: list[list[str]] = []
+
+    def dfs(start: str, node: str, path: list[str], visited: set[str]):
+        for nxt in sorted(edges.get(node, ())):
+            if nxt == start:
+                i = path.index(min(path))
+                canon = tuple(path[i:] + path[:i])
+                if canon not in seen:
+                    seen.add(canon)
+                    out.append(list(canon))
+            elif nxt not in visited and nxt > start:
+                # only walk nodes > start: each cycle found exactly
+                # once, from its smallest member
+                dfs(start, nxt, path + [nxt], visited | {nxt})
+
+    for start in sorted(edges):
+        dfs(start, start, [start], {start})
+    return out

@@ -64,8 +64,8 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, MgrServiceMixin,
     def __init__(
         self,
         crush: CrushMap | None = None,
-        beacon_grace: float = 0.0,
-        out_interval: float = 0.0,
+        beacon_grace: float | None = None,
+        out_interval: float | None = None,
         rank: int = 0,
         n_mons: int = 1,
         store=None,
@@ -130,8 +130,21 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, MgrServiceMixin,
         self.paxos_trim_keep = paxos_trim_keep
         # failed osd -> {reporter: report time} (OSDMonitor failure_info)
         self._failure_reports: dict[int, dict[int, float]] = {}
-        self.beacon_grace = beacon_grace
-        self.out_interval = out_interval
+        # None = take the declared option defaults (both 0.0 = sweep
+        # disabled); an explicit constructor arg wins, matching the
+        # conf precedence tests rely on
+        self.beacon_grace = (
+            conf["mon_osd_beacon_grace"] if beacon_grace is None
+            else beacon_grace)
+        self.out_interval = (
+            conf["mon_osd_down_out_interval"] if out_interval is None
+            else out_interval)
+        # per-subsystem gated debug logging (debug_mon), live-updatable
+        # via the config observer like the reference's
+        # `ceph tell mon.* config set debug_mon N`
+        from ceph_tpu.common.dout import DoutLogger
+
+        self.dlog = DoutLogger("mon", conf, name_suffix=str(rank))
         self._epoch_blobs: dict[int, bytes] = {}
         self._epoch_incs: dict[int, bytes] = {}
         self._subscribers: dict[tuple[str, int], Connection] = {}
@@ -423,8 +436,8 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, MgrServiceMixin,
                     return  # reconnected: not a leader loss
             except (ConnectionError, OSError, asyncio.TimeoutError):
                 pass
-            log.info(
-                "mon.%d: quorum peer mon.%d lost; electing",
+            self.dlog.dout(
+                0, "mon.%d: quorum peer mon.%d lost; electing",
                 self.rank, peer[1],
             )
             await self.paxos.start_election()

@@ -359,13 +359,15 @@ class OSDMonitorMixin:
             try:
                 for osd, last in list(self._last_beacon.items()):
                     if om.is_up(osd) and now - last > self.beacon_grace:
-                        log.info("mon: osd.%d beacon timeout -> down", osd)
+                        self.dlog.dout(
+                            0, "mon: osd.%d beacon timeout -> down", osd)
                         self._down_at[osd] = now
                         await self._propose({"op": "down", "osd": osd})
                 if self.out_interval > 0:
                     for osd, when in list(self._down_at.items()):
                         if not om.is_out(osd) and now - when > self.out_interval:
-                            log.info("mon: osd.%d down too long -> out", osd)
+                            self.dlog.dout(
+                                0, "mon: osd.%d down too long -> out", osd)
                             await self._propose({"op": "out", "osd": osd})
             except ConnectionError:
                 continue  # lost quorum mid-sweep; retry next tick
@@ -625,9 +627,11 @@ class OSDMonitorMixin:
             return -errno.ENOENT, "no default crush root", b""
         await self._propose({
             "op": "pool_create", "name": name,
-            "pg_num": int(cmd.get("pg_num", "8")),
+            "pg_num": int(cmd.get("pg_num")
+                          or self.conf["osd_pool_default_pg_num"]),
             "pool_type": pool_type,
-            "size": int(cmd.get("size", "3")),
+            "size": int(cmd.get("size")
+                        or self.conf["osd_pool_default_size"]),
             "rule": cmd.get("rule", ""),
             "erasure_code_profile": cmd.get("erasure_code_profile", "default"),
             "fast_read": cmd.get("fast_read", "") in ("1", "true", "yes"),

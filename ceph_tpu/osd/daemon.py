@@ -178,6 +178,11 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         )
         self.mon_addr = self.mon_addrs[0]
         self.conf = conf if conf is not None else ConfigProxy()
+        # daemon-start plugin preload (ErasureCodePlugin.cc:180-196,
+        # driven by osd_erasure_code_plugins): load failures surface at
+        # boot, not on the first EC pool op; already-loaded plugins are
+        # skipped so repeated daemon constructions are free
+        ec_registry.preload(self.conf["osd_erasure_code_plugins"])
         self.store = store or MemStore()
         # scope this store's fault-injection points to this daemon
         # (store.read.osd.<id> etc — see common/fault_injector.py)

@@ -91,7 +91,14 @@ class DecodeAggregator:
         #: a previous launch); a launch outside this set is a cold
         #: compile — zero of those must happen after daemon warmup
         self._warm: set[tuple] = set()
-        self._warm_lock = threading.Lock()  # serializes prewarm threads
+        # _warm_lock guards ONLY the warm/claimed sets — never hold it
+        # across a compile/launch (device-sync-under-lock): prewarm
+        # claims missing shapes under the lock, compiles outside it,
+        # and concurrent prewarmers wait on the condition for claims
+        # they skipped to resolve
+        self._warm_lock = threading.Lock()
+        self._warm_cv = threading.Condition(self._warm_lock)
+        self._warm_claimed: set[tuple] = set()
         self.stats = collections.Counter()
         self.metrics = BucketCounters("recovery_decode_batch")
 
@@ -283,22 +290,43 @@ class DecodeAggregator:
             buckets.add(pow2_bucket(min(x, self.tile_cap),
                                     self.min_bucket))
         n = 0
-        with self._warm_lock:
+        wanted: list[tuple] = []   # every shape this call must see warm
+        todo: list[tuple] = []     # the subset THIS thread compiles
+        with self._warm_cv:
             for e in erasure_counts:
                 if e > ec_impl.get_chunk_count() - k:
                     # impossible signature: more erasures than parity
                     continue
                 bits_shape = (8 * e * r, 8 * k * r)
-                zbits = jnp.zeros(bits_shape, np.uint8)
                 for w in sorted(buckets):
                     for b in batches:
                         shape_key = (bits_shape, b, k * r, w)
-                        if shape_key in self._warm:
+                        wanted.append(shape_key)
+                        if (shape_key in self._warm
+                                or shape_key in self._warm_claimed):
                             continue
-                        jax.block_until_ready(gf_bitmatmul(
-                            zbits, jnp.zeros((b, k * r, w), np.uint8)))
-                        self._warm.add(shape_key)
-                        n += 1
+                        self._warm_claimed.add(shape_key)
+                        todo.append(shape_key)
+        try:
+            for shape_key in todo:
+                bits_shape, b, kr, w = shape_key
+                jax.block_until_ready(gf_bitmatmul(
+                    jnp.zeros(bits_shape, np.uint8),
+                    jnp.zeros((b, kr, w), np.uint8)))
+                with self._warm_cv:
+                    self._warm.add(shape_key)
+                    self._warm_cv.notify_all()
+                n += 1
+        finally:
+            with self._warm_cv:
+                self._warm_claimed.difference_update(todo)
+                self._warm_cv.notify_all()
+        # shapes another prewarm thread claimed first: wait for them —
+        # callers rely on "prewarm returned => no cold launch"
+        with self._warm_cv:
+            self._warm_cv.wait_for(lambda: all(
+                key in self._warm or key not in self._warm_claimed
+                for key in wanted), timeout=120.0)
         self.stats["prewarmed_shapes"] += n
         self.metrics.inc("prewarmed_shapes", by=n)
         return n

@@ -111,7 +111,12 @@ class ScrubVerifier:
         self._flush_handle = None
         self._bits_cache: collections.OrderedDict = collections.OrderedDict()
         self._warm: set[tuple] = set()
+        # guards ONLY the warm/claimed sets — never held across a
+        # compile (device-sync-under-lock); see decode_batcher for the
+        # claim/compile/notify pattern
         self._warm_lock = threading.Lock()
+        self._warm_cv = threading.Condition(self._warm_lock)
+        self._warm_claimed: set[tuple] = set()
         self.stats = collections.Counter()
         self.metrics = BucketCounters("scrub_verify_batch")
 
@@ -425,33 +430,56 @@ class ScrubVerifier:
             x = max(min(x, self.tile_cap), self.min_bucket, 1)
             buckets.add(1 << (x - 1).bit_length())
         n = 0
-        with self._warm_lock:
+        wanted: list[tuple] = []
+        todo: list[tuple] = []  # (key, compile thunk) claimed by US
+        ec_bits = None
+        if ec_impl is not None and getattr(
+                ec_impl, "rows_per_chunk", 1) == 1 and hasattr(
+                ec_impl, "coding_matrix"):
+            C = np.asarray(ec_impl.coding_matrix, dtype=np.uint8)
+            ec_m, ec_k = C.shape
+            ec_bits = self._enc_bits(C)
+        with self._warm_cv:
             for w in sorted(buckets):
-                mat = self._crc_mat(w)
                 for b in (1, self.crc_lanes):
                     key = ("crc", b, w)
-                    if key in self._warm:
+                    wanted.append(key)
+                    if key in self._warm or key in self._warm_claimed:
                         continue
-                    jax.block_until_ready(batched_crc32c_device(
-                        mat, jnp.zeros((b, w), np.uint8)))
-                    self._warm.add(key)
-                    n += 1
-            if ec_impl is not None and getattr(
-                    ec_impl, "rows_per_chunk", 1) == 1 and hasattr(
-                    ec_impl, "coding_matrix"):
-                C = np.asarray(ec_impl.coding_matrix, dtype=np.uint8)
-                m, k = C.shape
-                bits = self._enc_bits(C)
+                    self._warm_claimed.add(key)
+                    todo.append(key)
+            if ec_bits is not None:
                 for w in sorted(buckets):
                     for b in (batches or (1, self.max_batch)):
-                        key = (bits.shape, b, k, w)
-                        if key in self._warm:
+                        key = (ec_bits.shape, b, ec_k, w)
+                        wanted.append(key)
+                        if key in self._warm or key in self._warm_claimed:
                             continue
-                        jax.block_until_ready(gf_encode_compare(
-                            bits, jnp.zeros((b, k, w), np.uint8),
-                            jnp.zeros((b, m, w), np.uint8)))
-                        self._warm.add(key)
-                        n += 1
+                        self._warm_claimed.add(key)
+                        todo.append(key)
+        try:
+            for key in todo:
+                if key[0] == "crc":
+                    _, b, w = key
+                    jax.block_until_ready(batched_crc32c_device(
+                        self._crc_mat(w), jnp.zeros((b, w), np.uint8)))
+                else:
+                    _, b, k_, w = key
+                    jax.block_until_ready(gf_encode_compare(
+                        ec_bits, jnp.zeros((b, k_, w), np.uint8),
+                        jnp.zeros((b, ec_m, w), np.uint8)))
+                with self._warm_cv:
+                    self._warm.add(key)
+                    self._warm_cv.notify_all()
+                n += 1
+        finally:
+            with self._warm_cv:
+                self._warm_claimed.difference_update(todo)
+                self._warm_cv.notify_all()
+        with self._warm_cv:
+            self._warm_cv.wait_for(lambda: all(
+                key in self._warm or key not in self._warm_claimed
+                for key in wanted), timeout=120.0)
         self.stats["prewarmed_shapes"] += n
         self.metrics.inc("prewarmed_shapes", by=n)
         return n

@@ -1,0 +1,15 @@
+# ctlint fixture: a declared-but-never-read option and a read of an
+# undeclared key.
+from ceph_tpu.common.config import Option, declare
+
+declare(
+    Option("fixture_dead_knob", int, 3, desc="nothing reads this"),
+    Option("fixture_live_knob", float, 1.0, desc="read below"),
+)
+
+
+def tick(conf):
+    interval = conf["fixture_live_knob"]
+    # config-undeclared: no Option registers this key
+    budget = conf["fixture_undeclared_knob"]
+    return interval, budget

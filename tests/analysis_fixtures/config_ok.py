@@ -1,0 +1,11 @@
+# ctlint fixture: every declared option is read, every read key is
+# declared.
+from ceph_tpu.common.config import Option, declare
+
+declare(
+    Option("fixture_live_knob", float, 1.0, desc="read below"),
+)
+
+
+def tick(conf):
+    return conf["fixture_live_knob"]

@@ -1,0 +1,15 @@
+# ctlint: pure-trace
+# ctlint fixture: wall clock, shared random state, and unordered-set
+# iteration inside a pure-trace module.
+import random
+import time
+
+
+def generate(seed, n):
+    events = []
+    alive = set(range(n))
+    for osd in alive:  # det-set-iter: hash-order iteration
+        events.append(("kill", osd, time.time()))  # det-wallclock
+    # det-random: module-level shared RNG, not a seeded instance
+    events.append(("pick", random.choice(sorted(alive))))
+    return events

@@ -1,0 +1,12 @@
+# ctlint: pure-trace
+# ctlint fixture: pure in (seed, n) — seeded RNG, sorted iteration,
+# no clock.
+import random
+
+
+def generate(seed, n):
+    rng = random.Random(f"chaos:{seed}")
+    alive = set(range(n))
+    events = [("kill", osd) for osd in sorted(alive)]
+    events.append(("pick", rng.choice(sorted(alive))))
+    return events

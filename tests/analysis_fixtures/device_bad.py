@@ -1,0 +1,25 @@
+# ctlint fixture: violates every device-discipline rule.  NEVER
+# imported — parsed by tests/test_static_analysis.py with a synthetic
+# I/O-path module path.
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from ceph_tpu.ops.rs_kernels import gf_bitmatmul
+
+_dispatch_lock = threading.Lock()
+
+
+@jax.jit  # device-prewarm: not declared in the prewarm registry
+def rogue_kernel(x):
+    return x + 1
+
+
+def dispatch(bits, data):
+    # device-raw-shape: raw len() straight into a jitted entry point
+    out = gf_bitmatmul(bits, jnp.zeros((1, 4, len(data)), jnp.uint8))
+    with _dispatch_lock:
+        # device-sync-under-lock: sync while the lock is held
+        jax.block_until_ready(out)
+    return out

@@ -1,0 +1,21 @@
+# ctlint fixture: lock-order cycle + blocking call under a lock.
+import threading
+import time
+
+
+class Daemons:
+    def __init__(self):
+        self._map_lock = threading.Lock()
+        self._io_lock = threading.Lock()
+
+    def forward(self):
+        with self._map_lock:
+            with self._io_lock:
+                pass
+
+    def backward(self):
+        # lock-cycle: opposite nesting order of forward()
+        with self._io_lock:
+            with self._map_lock:
+                # lock-blocking: sleeping while both locks are held
+                time.sleep(0.1)

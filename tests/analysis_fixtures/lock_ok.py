@@ -1,0 +1,20 @@
+# ctlint fixture: consistent lock order, blocking work outside locks.
+import threading
+import time
+
+
+class Daemons:
+    def __init__(self):
+        self._map_lock = threading.Lock()
+        self._io_lock = threading.Lock()
+
+    def forward(self):
+        with self._map_lock:
+            with self._io_lock:
+                pass
+
+    def backward(self):
+        with self._map_lock:  # same order as forward()
+            with self._io_lock:
+                pass
+        time.sleep(0.1)  # sleep with no lock held

@@ -1,0 +1,42 @@
+# ctlint fixture: duplicate frame id, unregistered TYPE, and
+# encode/decode asymmetry.  Never imported — a real import would trip
+# the messenger registry assert.
+
+
+class Message:
+    TYPE = 0
+
+
+class MAlpha(Message):
+    TYPE = 7
+
+    def encode_payload(self, enc):
+        enc.u32(self.a)
+        enc.str_(self.name)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        # wire-asymmetry: forgets to read `name`
+        return cls(dec.u32())
+
+
+class MBeta(Message):
+    TYPE = 7  # wire-frame-id: duplicate of MAlpha
+
+    def encode_payload(self, enc):
+        enc.u64(self.x)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        return cls(dec.u64())
+
+
+class MGamma(Message):
+    # wire-frame-id: encode/decode pair but TYPE never registered
+
+    def encode_payload(self, enc):
+        enc.u8(self.flag)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        return cls(dec.u8())

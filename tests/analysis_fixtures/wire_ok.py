@@ -1,0 +1,36 @@
+# ctlint fixture: a symmetric message — scalar fields, a counted
+# vector, and a nested sub-struct, all read back in write order.
+
+
+class Message:
+    TYPE = 0
+
+
+def _enc_pair(enc, a, b):
+    enc.u32(a)
+    enc.u64(b)
+
+
+def _dec_pair(dec):
+    return dec.u32(), dec.u64()
+
+
+class MClean(Message):
+    TYPE = 9
+
+    def encode_payload(self, enc):
+        enc.u64(self.tid)
+        enc.str_(self.oid)
+        _enc_pair(enc, self.epoch, self.version)
+        enc.u32(len(self.shards))
+        for s in self.shards:
+            enc.i32(s)
+        enc.bool_(self.force)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        msg = cls(dec.u64(), dec.str_())
+        msg.epoch, msg.version = _dec_pair(dec)
+        msg.shards = [dec.i32() for _ in range(dec.u32())]
+        msg.force = dec.bool_()
+        return msg

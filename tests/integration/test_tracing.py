@@ -178,7 +178,7 @@ class TestClusterTraceAssembly:
 
                 # the digest carries it to the mon: `ceph trace ls` +
                 # `ceph trace show` serve the same assembly
-                got = None
+                got = shown = None
                 for _ in range(60):
                     code, _rs, data = await c.client.command(
                         {"prefix": "trace ls"})
@@ -187,13 +187,27 @@ class TestClusterTraceAssembly:
                         if any(t["trace_id"] == tid
                                for t in doc.get("traces", [])):
                             got = doc
-                            break
+                            # a digest minted BEFORE the daemon
+                            # reports landed lists the trace with only
+                            # the client-side spans — keep polling
+                            # until the mon serves a tree assembled
+                            # from the full span set (the next digest
+                            # tick carries it)
+                            code, rs, data = await c.client.command(
+                                {"prefix": "trace show",
+                                 "trace_id": str(tid)})
+                            if code == 0:
+                                cand = json.loads(data)
+                                if any(
+                                    n.startswith("ec_sub_write@")
+                                    for n in _tree_names(cand["tree"])
+                                ):
+                                    shown = cand
+                                    break
                     await asyncio.sleep(0.2)
                 assert got is not None, "trace never reached the mon"
-                code, rs, data = await c.client.command(
-                    {"prefix": "trace show", "trace_id": str(tid)})
-                assert code == 0, rs
-                shown = json.loads(data)
+                assert shown is not None, (
+                    "mon digest never grew the daemon spans")
                 assert shown["trace_id"] == tid
                 assert shown["stages_ms"]
                 assert shown["critical_path"]

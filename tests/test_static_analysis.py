@@ -1,0 +1,237 @@
+"""ctlint tier-1 gate + per-rule fixture proofs.
+
+Three layers:
+
+1. fixture tests — each rule family fires on its known-violating
+   snippet (``tests/analysis_fixtures/*_bad.py``) and stays silent on
+   the clean twin (``*_ok.py``);
+2. live-tree gate — the committed tree has ZERO unbaselined findings
+   and no stale baseline entries (the pytest twin of
+   ``python tools/lint.py``);
+3. determinism regression — the CHAOS_r11 trace hashes re-derive
+   bit-identically AND ``chaos/schedule.py`` stays free of
+   nondeterminism findings, tying the static rule to the committed
+   runtime artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from ceph_tpu.analysis.core import (
+    Project,
+    SourceFile,
+    load_baseline,
+    run_analysis,
+    split_by_baseline,
+)
+from ceph_tpu.analysis.rules import ALL_RULES, RULE_CATALOG
+from ceph_tpu.analysis.rules.configrule import ConfigRegistryRule
+from ceph_tpu.analysis.rules.determinism import DeterminismRule
+from ceph_tpu.analysis.rules.device import DeviceDisciplineRule
+from ceph_tpu.analysis.rules.locks import LockOrderRule
+from ceph_tpu.analysis.rules.wire import WireProtocolRule
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).parent / "analysis_fixtures"
+
+
+def fixture_project(name: str, fake_path: str) -> Project:
+    """Parse one fixture under a synthetic repo path (so path-scoped
+    rules — I/O-path roots, pure-trace — see it in scope)."""
+    sf = SourceFile(fake_path, (FIXTURES / name).read_text())
+    return Project(root=REPO, files=[sf], aux_files=[])
+
+
+def rule_ids(project: Project, rule) -> list[str]:
+    return [f.rule for f in run_analysis(REPO, rules=[rule],
+                                         project=project)]
+
+
+class TestDeviceRule:
+    def test_bad_fixture_fires_all_three(self):
+        proj = fixture_project(
+            "device_bad.py", "ceph_tpu/osd/_fixture_device.py")
+        ids = rule_ids(proj, DeviceDisciplineRule())
+        assert set(ids) == {
+            "device-prewarm", "device-raw-shape", "device-sync-under-lock",
+        }
+
+    def test_ok_fixture_silent(self):
+        proj = fixture_project(
+            "device_ok.py", "ceph_tpu/osd/_fixture_device.py")
+        assert rule_ids(proj, DeviceDisciplineRule()) == []
+
+    def test_registry_removal_fires(self, monkeypatch):
+        """The live tree passes ONLY because every reachable jit site
+        is declared: removing one registry entry must fire."""
+        from ceph_tpu.analysis import prewarm_registry
+
+        monkeypatch.delitem(
+            prewarm_registry.PREWARMED,
+            "ceph_tpu.ops.rs_kernels:gf_bitmatmul")
+        findings = run_analysis(REPO, rules=[DeviceDisciplineRule()])
+        assert any(
+            f.rule == "device-prewarm"
+            and "gf_bitmatmul" in f.message
+            for f in findings
+        )
+
+    def test_io_path_fully_accounted(self):
+        """Acceptance: every jitted callable reachable from parallel/,
+        osd/ and mgr/analytics.py is registered — the static twin of
+        the runtime cold_launches == 0 gate."""
+        from ceph_tpu.analysis.prewarm_registry import PREWARMED
+        from ceph_tpu.analysis.rules.device import (
+            _io_path_roots,
+            _JitSiteVisitor,
+        )
+
+        proj = Project.load(REPO)
+        roots = _io_path_roots(proj)
+        reach = proj.reachable_from(roots) | roots
+        mods = proj.by_module()
+        sites = []
+        for mod in sorted(reach):
+            v = _JitSiteVisitor()
+            v.visit(mods[mod].tree)
+            sites += [f"{mod}:{q}" for q, _ in v.sites]
+        assert sites, "expected jitted callables on the I/O path"
+        missing = [s for s in sites if s not in PREWARMED]
+        assert not missing, f"unregistered jit sites: {missing}"
+
+
+class TestLockRule:
+    def test_bad_fixture(self):
+        proj = fixture_project("lock_bad.py", "ceph_tpu/osd/_fixture.py")
+        ids = rule_ids(proj, LockOrderRule())
+        assert "lock-cycle" in ids
+        assert "lock-blocking" in ids
+
+    def test_ok_fixture_silent(self):
+        proj = fixture_project("lock_ok.py", "ceph_tpu/osd/_fixture.py")
+        assert rule_ids(proj, LockOrderRule()) == []
+
+
+class TestWireRule:
+    def test_bad_fixture(self):
+        proj = fixture_project("wire_bad.py", "ceph_tpu/msg/_fixture.py")
+        ids = rule_ids(proj, WireProtocolRule())
+        assert ids.count("wire-frame-id") == 2  # dup TYPE + missing TYPE
+        assert ids.count("wire-asymmetry") == 1
+
+    def test_ok_fixture_silent(self):
+        proj = fixture_project("wire_ok.py", "ceph_tpu/msg/_fixture.py")
+        assert rule_ids(proj, WireProtocolRule()) == []
+
+
+class TestConfigRule:
+    def test_bad_fixture(self):
+        proj = fixture_project(
+            "config_bad.py", "ceph_tpu/common/_fixture.py")
+        ids = rule_ids(proj, ConfigRegistryRule())
+        assert sorted(ids) == ["config-dead", "config-undeclared"]
+
+    def test_ok_fixture_silent(self):
+        proj = fixture_project(
+            "config_ok.py", "ceph_tpu/common/_fixture.py")
+        assert rule_ids(proj, ConfigRegistryRule()) == []
+
+
+class TestDeterminismRule:
+    def test_bad_fixture(self):
+        proj = fixture_project("det_bad.py", "ceph_tpu/chaos/_fixture.py")
+        ids = rule_ids(proj, DeterminismRule())
+        assert set(ids) == {"det-wallclock", "det-random", "det-set-iter"}
+
+    def test_ok_fixture_silent(self):
+        proj = fixture_project("det_ok.py", "ceph_tpu/chaos/_fixture.py")
+        assert rule_ids(proj, DeterminismRule()) == []
+
+    def test_inline_suppression(self):
+        text = (FIXTURES / "det_bad.py").read_text().replace(
+            "events.append((\"kill\", osd, time.time()))",
+            "events.append((\"kill\", osd, time.time()))"
+            "  # ctlint: disable=det-wallclock",
+        )
+        sf = SourceFile("ceph_tpu/chaos/_fixture.py", text)
+        proj = Project(root=REPO, files=[sf], aux_files=[])
+        ids = rule_ids(proj, DeterminismRule())
+        assert "det-wallclock" not in ids
+        assert "det-set-iter" in ids  # other findings untouched
+
+
+class TestLiveTree:
+    def test_zero_unbaselined_findings(self):
+        """The tier-1 ctlint gate: new findings fail the build."""
+        findings = run_analysis(REPO)
+        baseline = load_baseline(REPO / "ctlint_baseline.json")
+        new, _old, stale = split_by_baseline(findings, baseline)
+        assert not new, "unbaselined ctlint findings:\n" + "\n".join(
+            f.render() for f in new)
+        assert not stale, (
+            "stale baseline entries (run tools/lint.py "
+            "--update-baseline): %r" % (stale,))
+
+    def test_baseline_entries_justified(self):
+        data = json.loads((REPO / "ctlint_baseline.json").read_text())
+        bad = [e for e in data["findings"]
+               if not e.get("justification")
+               or e["justification"].startswith("TODO")]
+        assert not bad, f"baseline entries without justification: {bad}"
+
+    def test_catalog_covers_every_rule(self):
+        for cls in ALL_RULES:
+            for rid in cls.rules:
+                assert rid in RULE_CATALOG
+
+    def test_cli_json_mode(self):
+        """tools/lint.py --json exits 0 on the committed tree — the
+        pre-commit / CI invocation."""
+        res = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "lint.py"), "--json"],
+            capture_output=True, text=True, timeout=300, cwd=REPO,
+        )
+        assert res.returncode == 0, res.stdout + res.stderr
+        payload = json.loads(res.stdout)
+        assert payload["new"] == []
+        assert payload["stale_baseline"] == []
+
+
+class TestChaosDeterminismRegression:
+    """Satellite: tie the static determinism rule to the committed
+    chaos artifact — the CHAOS_r11 hashes must re-derive AND the
+    schedule generator must stay statically clean."""
+
+    @pytest.fixture(scope="class")
+    def artifact(self):
+        path = REPO / "CHAOS_r11.json"
+        if not path.exists():
+            pytest.skip("CHAOS_r11.json not committed")
+        return json.loads(path.read_text())
+
+    def test_trace_hashes_rederive(self, artifact):
+        from ceph_tpu.chaos.runner import SCENARIOS
+        from ceph_tpu.chaos.schedule import generate_schedule, trace_hash
+
+        checked = 0
+        for run in artifact["runs"]:
+            sc = SCENARIOS.get(run["scenario"])
+            if sc is None:
+                continue
+            events = generate_schedule(run["seed"], sc)
+            assert trace_hash(events) == run["trace_hash"], (
+                run["scenario"], run["seed"])
+            checked += 1
+        assert checked >= 8, "artifact unexpectedly thin"
+
+    def test_schedule_has_no_nondeterminism_findings(self):
+        findings = run_analysis(REPO, rules=[DeterminismRule()])
+        sched = [f for f in findings
+                 if f.path == "ceph_tpu/chaos/schedule.py"]
+        assert sched == [], "\n".join(f.render() for f in sched)
