@@ -76,3 +76,27 @@ BUCKET_HELPERS: frozenset[str] = frozenset({
     "pow2_bucket",
     "bucket_lanes",
 })
+
+#: declared analytics columns: the gauge names expected to occupy
+#: metric slots of the mgr's fixed-shape (daemons x metrics x window)
+#: time-series store.  The mgr RESERVES these slots at start
+#: (TimeSeriesStore.reserve), so adding a column here both documents
+#: it and guarantees it can never be overflow-dropped by transient
+#: metrics racing for slots — the declaration the "fixed shape, never
+#: resized" prewarm contract requires before a new column may feed
+#: the digest (e.g. the progress module's degraded/misplaced EWMAs).
+#: mgr_stats_max_metrics must stay >= len(ANALYTICS_COLUMNS).
+ANALYTICS_COLUMNS: tuple[str, ...] = (
+    "read_lat_us",
+    "write_lat_us",
+    "subop_w_lat_us",
+    "num_pgs",
+    "inflight_ops",
+    "slow_ops",
+    "slow_ops_inflight",
+    # event-plane columns (PR 8): cluster-log/progress ETA inputs —
+    # integer-exact EWMA of degraded/misplaced PG counts rides the
+    # same ONE-launch digest
+    "pgs_degraded",
+    "pgs_misplaced",
+)

@@ -330,8 +330,68 @@ def check_disk_faults(fsck_reports: list[dict]) -> list[dict]:
     return out
 
 
+def check_events(obs: dict) -> list[dict]:
+    """The cluster event plane under chaos (``obs`` is the runner's
+    event-watcher record):
+
+    - when the trace degraded the cluster (kills/outs/disk deaths),
+      the mgr progress module must have OBSERVED it: at least one
+      progress event, whose completion fraction is monotone
+      non-decreasing, reaches 1.0, and is reaped post-settle;
+    - every injected daemon death left a crash dump the crash module
+      collected (``ceph crash ls``);
+    - at settle — after the runner muted the EXPECTED codes
+      (RECENT_CRASH for its own injected deaths) — zero UNMUTED
+      unexpected health checks remain: chaos debris must not leave the
+      operator staring at a warning nobody can explain.
+    """
+    out: list[dict] = []
+    events: dict[str, dict] = obs.get("progress_events") or {}
+    if obs.get("expect_progress") and not events:
+        out.append({
+            "invariant": "progress_never_observed",
+            "detail": "the trace degraded the cluster but the mgr "
+            "progress module never opened an event",
+        })
+    for eid, rec in sorted(events.items()):
+        fr = rec.get("fractions") or []
+        if any(b < a for a, b in zip(fr, fr[1:])):
+            out.append({
+                "invariant": "progress_regressed", "event": eid,
+                "detail": f"completion fractions walked backwards: {fr}",
+            })
+        if rec.get("final", 0.0) < 1.0:
+            out.append({
+                "invariant": "progress_incomplete", "event": eid,
+                "detail": f"never reached 1.0 (final "
+                f"{rec.get('final')}, fractions {fr[-5:]})",
+            })
+        if not rec.get("reaped"):
+            out.append({
+                "invariant": "progress_not_reaped", "event": eid,
+                "detail": "event still active after settle + grace",
+            })
+    crash_entities = obs.get("crash_entities") or set()
+    for entity, n in sorted((obs.get("deaths") or {}).items()):
+        if n > 0 and entity not in crash_entities:
+            out.append({
+                "invariant": "crash_missing", "entity": entity,
+                "detail": f"{n} injected death(s) but no crash dump "
+                "collected for it",
+            })
+    unexpected = sorted(
+        set(obs.get("unmuted_checks") or [])
+        - set(obs.get("allowed_checks") or []))
+    if unexpected:
+        out.append({
+            "invariant": "unexpected_health_at_settle",
+            "detail": f"unmuted health checks at settle: {unexpected}",
+        })
+    return out
+
+
 #: checker registry: name -> callable, for reporting
 ALL_INVARIANTS = (
     "history", "final_reads", "converged", "quorum", "scrub",
-    "disk_faults", "cold_launches", "mgr", "slow_osd",
+    "disk_faults", "cold_launches", "mgr", "slow_osd", "events",
 )

@@ -41,13 +41,24 @@ log = logging.getLogger("ceph_tpu.chaos")
 #: dict so CLI users can ship their own as JSON.
 SCENARIOS: dict[str, dict] = {
     # the classic OSDThrasher: kill/revive, out/in, reweight, repair
-    # and balancer runs against replicated + EC pools
+    # and balancer runs against replicated + EC pools.  A mgr rides
+    # along so the EVENT-PLANE invariant (check_events) can watch
+    # progress events open/complete/reap and crash dumps land for
+    # every injected kill.  (n_mgrs/watch_events/conf do not feed the
+    # schedule generator's draws — trace hashes are unchanged.)
     "osd_thrash": {
         "name": "osd_thrash",
-        "n_osds": 5, "n_mons": 1,
+        "n_osds": 5, "n_mons": 1, "n_mgrs": 1,
+        "watch_events": True,
         "duration": 3.0, "n_events": 9,
         "mix": {"osd_kill": 3.0, "osd_out": 2.0, "reweight": 1.0,
                 "scrub": 0.5, "repair": 0.5, "balance": 0.5},
+        "conf": {
+            # fast mgr cadences so short degraded windows are observed
+            "mgr_report_interval": 0.2, "mgr_digest_interval": 0.2,
+            "mgr_module_tick_interval": 0.15,
+            "mgr_progress_complete_grace": 1.0,
+        },
         "pools": [
             {"name": "rep", "type": "replicated", "pg_num": 4,
              "size": 2, "snaps": True},
@@ -84,7 +95,11 @@ SCENARIOS: dict[str, dict] = {
     # clean at rest.
     "disk-fault": {
         "name": "disk-fault",
-        "n_osds": 5, "n_mons": 1,
+        "n_osds": 5, "n_mons": 1, "n_mgrs": 1,
+        "watch_events": True,
+        # ledger damage outlives the run on surviving daemons: the
+        # devicehealth warning at settle is EXPECTED, not debris
+        "settle_allowed_health": ["DEVICE_HEALTH"],
         "store": "blockstore",
         "self_heal": True,
         "duration": 3.0, "n_events": 10,
@@ -92,6 +107,11 @@ SCENARIOS: dict[str, dict] = {
                 "disk_dead": 0.5, "osd_kill": 0.5,
                 "deep_scrub": 0.5, "repair": 0.5},
         "max_dead": 1,
+        "conf": {
+            "mgr_report_interval": 0.2, "mgr_digest_interval": 0.2,
+            "mgr_module_tick_interval": 0.15,
+            "mgr_progress_complete_grace": 1.0,
+        },
         "pools": [
             {"name": "rep", "type": "replicated", "pg_num": 4,
              "size": 2, "snaps": True},
@@ -219,16 +239,27 @@ class ChaosCluster:
         self.events_applied = 0
         self._store_dir: str | None = None
         self._stores: dict[int, object] = {}  # osd id -> mounted store
+        # entity -> injected-death count (kills + self-escalations);
+        # the check_events invariant demands a crash dump for each
+        self.deaths: dict[str, int] = {}
+        import tempfile
+
+        # run-scoped crash_dir: every daemon persists dumps here and
+        # the mgr crash module collects them (`ceph crash ls`)
+        self.crash_dir = tempfile.mkdtemp(prefix="chaos-crash-")
 
     def _conf(self):
-        """Per-daemon ConfigProxy carrying the scenario's overrides
-        (fresh per daemon: config observers must not cross daemons)."""
-        sc_conf = self.scenario.get("conf")
-        if not sc_conf:
-            return None
+        """Per-daemon ConfigProxy carrying the scenario's overrides +
+        the run-scoped crash_dir (fresh per daemon: config observers
+        must not cross daemons)."""
         from ceph_tpu.common import ConfigProxy
 
-        return ConfigProxy(dict(sc_conf))
+        overrides = dict(self.scenario.get("conf") or {})
+        overrides.setdefault("crash_dir", self.crash_dir)
+        return ConfigProxy(overrides)
+
+    def _note_death(self, entity: str) -> None:
+        self.deaths[entity] = self.deaths.get(entity, 0) + 1
 
     def _make_store(self, osd_id: int):
         """Per-scenario store engine: 'blockstore' puts each OSD on a
@@ -352,10 +383,11 @@ class ChaosCluster:
                 store.umount()
             except OSError:
                 log.exception("chaos: store umount failed")
-        if self._store_dir is not None:
-            import shutil
+        import shutil
 
+        if self._store_dir is not None:
             shutil.rmtree(self._store_dir, ignore_errors=True)
+        shutil.rmtree(self.crash_dir, ignore_errors=True)
 
     # -- event application ---------------------------------------------
 
@@ -386,6 +418,13 @@ class ChaosCluster:
         if kind == "osd_kill":
             osd = self.osds[a["osd"]]
             if osd is not None:
+                # an injected kill IS an unclean death: the daemon
+                # persists a crash dump the way a SIGKILL'd reference
+                # daemon leaves one for ceph-crash to post
+                if not osd.stopping:
+                    osd.record_crash(
+                        reason="chaos: injected daemon kill")
+                    self._note_death(f"osd.{a['osd']}")
                 # keep the store: revive is a daemon restart (the
                 # reference thrasher's revive keeps the disk too).
                 # Wiping here would let TWO sequential kills destroy
@@ -400,8 +439,10 @@ class ChaosCluster:
             cur = self.osds[a["osd"]]
             if cur is not None and cur.stopping:
                 # the daemon died on its own (read-error-ledger disk
-                # escalation): stash its store and treat it as killed
+                # escalation — its _escalate path already wrote the
+                # crash dump): stash its store and treat it as killed
                 # so the revive below restarts it
+                self._note_death(f"osd.{a['osd']}")
                 self._stashed_stores = getattr(self, "_stashed_stores", {})
                 self._stashed_stores[a["osd"]] = cur.store
                 self.osds[a["osd"]] = None
@@ -495,6 +536,8 @@ class ChaosCluster:
         elif kind == "mgr_kill":
             mgr = self.mgrs[a["mgr"]]
             if mgr is not None:
+                mgr.record_crash(reason="chaos: injected mgr kill")
+                self._note_death(f"mgr.{mgr.name}")
                 await mgr.stop()
                 self.mgrs[a["mgr"]] = None
         elif kind == "mgr_revive":
@@ -502,7 +545,7 @@ class ChaosCluster:
                 from ceph_tpu.mgr.daemon import MgrDaemon
 
                 mgr = MgrDaemon(self._mgr_name(a["mgr"]),
-                                list(self.monmap))
+                                list(self.monmap), conf=self._conf())
                 self.netem.attach(mgr.messenger)
                 await mgr.start()
                 self.mgrs[a["mgr"]] = mgr
@@ -759,6 +802,121 @@ async def _watch_slow_osd(cluster, targets, obs, perf_base) -> None:
         await asyncio.sleep(0.25)
 
 
+async def _watch_events(cluster, obs) -> None:
+    """Event-plane observer: sample the active mgr's progress module
+    while the thrash runs, recording each event's fraction sequence
+    (monotonicity is judged over THESE samples), final fraction, and
+    whether it was reaped into the completed history."""
+    while True:
+        try:
+            _sample_progress(cluster, obs)
+        except Exception:  # a sampler must never die mid-thrash
+            log.exception("chaos: event watcher sample failed")
+        await asyncio.sleep(0.2)
+
+
+def _sample_progress(cluster, obs) -> None:
+    for g in cluster.mgrs:
+        if g is None:
+            continue
+        prog = g.modules.get("progress")
+        if prog is None:
+            continue
+        if g.active and prog.running:
+            for ev in prog.public_events():
+                rec = obs["progress_events"].setdefault(ev["id"], {
+                    "kind": ev["kind"], "fractions": [],
+                    "final": 0.0, "reaped": False,
+                })
+                fr = float(ev.get("fraction") or 0.0)
+                if not rec["fractions"] or rec["fractions"][-1] != fr:
+                    rec["fractions"].append(fr)
+                rec["final"] = max(rec["final"], fr)
+        # completed history is ground truth for reap/final even when
+        # the sampler missed the active window (module state persists
+        # on the daemon object)
+        for done in prog.public_completed():
+            rec = obs["progress_events"].setdefault(done["id"], {
+                "kind": done["kind"], "fractions": [],
+                "final": 0.0, "reaped": False,
+            })
+            rec["final"] = max(
+                rec["final"], float(done.get("fraction") or 0.0))
+            rec["reaped"] = True
+
+
+async def _settle_events(cluster, obs, time_scale: float) -> None:
+    """Post-settle event-plane verification: wait for active progress
+    events to complete + reap, require a crash dump per injected
+    death, mute the EXPECTED RECENT_CRASH, and record what health
+    codes remain unmuted."""
+    import json as _json
+
+    # 1. progress events must finish and reap (completion grace +
+    # slack for the module tick cadence)
+    deadline = time.monotonic() + 20.0 * time_scale
+    while time.monotonic() < deadline:
+        live = [
+            g for g in cluster.mgrs
+            if g is not None and g.active
+            and g.modules.get("progress") is not None
+            and g.modules["progress"].running
+        ]
+        if live and all(not g.modules["progress"].events for g in live):
+            break
+        await asyncio.sleep(0.3)
+    # final authoritative sample: the watcher is a 0.2s poller and can
+    # race the module's reap; the module's own state cannot
+    _sample_progress(cluster, obs)
+    # 2. every injected death must have a collected crash dump —
+    # judged through `ceph crash ls` (mon <- digest <- crash module),
+    # proving the full collection chain, not just the files on disk
+    expected = {e for e, n in cluster.deaths.items() if n > 0}
+    deadline = time.monotonic() + 15.0
+    seen: set = set()
+    while time.monotonic() < deadline:
+        try:
+            code, _rs, data = await cluster.client.command(
+                {"prefix": "crash ls"})
+            if code == 0 and data:
+                seen = {
+                    m.get("entity")
+                    for m in _json.loads(data).get("crashes", [])
+                }
+        except (OSError, ValueError, ConnectionError,
+                asyncio.TimeoutError):
+            pass
+        if expected <= seen:
+            break
+        await asyncio.sleep(0.4)
+    obs["crash_entities"] = seen
+    obs["deaths"] = dict(cluster.deaths)
+    # 3. mute the crash warning the runner itself caused, then the
+    # remaining UNMUTED checks must be the allowed set only
+    if expected:
+        try:
+            await cluster.client.command({
+                "prefix": "health mute", "code": "RECENT_CRASH"})
+        except (OSError, ConnectionError, asyncio.TimeoutError):
+            pass
+    allowed = set(obs.get("allowed_checks") or [])
+    deadline = time.monotonic() + 12.0
+    checks: list = []
+    while time.monotonic() < deadline:
+        try:
+            code, _rs, data = await cluster.client.command(
+                {"prefix": "health"})
+            if code == 0 and data:
+                checks = sorted(_json.loads(data).get("checks") or {})
+        except (OSError, ValueError, ConnectionError,
+                asyncio.TimeoutError):
+            pass
+        if not (set(checks) - allowed):
+            break
+        await asyncio.sleep(0.4)
+    obs["unmuted_checks"] = checks
+
+
 async def run_scenario(
     scenario: dict | str, seed: int, *, time_scale: float = 1.0,
     settle_timeout: float = 90.0,
@@ -778,6 +936,7 @@ async def run_scenario(
         "trace_hash": th, "n_events": len(events),
     }
     watch_task: asyncio.Task | None = None
+    events_watch_task: asyncio.Task | None = None
     try:
         await cluster.start()
         cold_before = _cold_launch_snapshot()
@@ -810,6 +969,22 @@ async def run_scenario(
             }
             watch_task = asyncio.ensure_future(
                 _watch_slow_osd(cluster, targets, slow_obs, perf_base))
+
+        events_obs: dict | None = None
+        if scenario.get("watch_events"):
+            degrading = {"osd_kill", "osd_out", "disk_dead"}
+            events_obs = {
+                # only traces that actually degraded the cluster are
+                # required to produce progress events (deterministic
+                # per (seed, scenario) — it derives from the trace)
+                "expect_progress": any(
+                    e.kind in degrading for e in events),
+                "progress_events": {},
+                "allowed_checks": list(
+                    scenario.get("settle_allowed_health", [])),
+            }
+            events_watch_task = asyncio.ensure_future(
+                _watch_events(cluster, events_obs))
 
         loop = asyncio.get_running_loop()
         t0 = loop.time()
@@ -899,6 +1074,28 @@ async def run_scenario(
                 watch_task.cancel()
             violations["slow_osd"] = inv.check_slow_osd(slow_obs)
             result["slow_osd_obs"] = dict(slow_obs)
+        if events_obs is not None:
+            # the event plane: progress completion/reap, crash dumps
+            # per injected death, no unmuted debris at settle
+            await _settle_events(cluster, events_obs, time_scale)
+            if events_watch_task is not None:
+                events_watch_task.cancel()
+            violations["events"] = inv.check_events(events_obs)
+            result["events_obs"] = {
+                "expect_progress": events_obs["expect_progress"],
+                "events": {
+                    eid: {"kind": rec["kind"], "final": rec["final"],
+                          "reaped": rec["reaped"],
+                          "samples": len(rec["fractions"])}
+                    for eid, rec in sorted(
+                        events_obs["progress_events"].items())
+                },
+                "deaths": events_obs.get("deaths", {}),
+                "crash_entities": sorted(
+                    e for e in events_obs.get("crash_entities", ())
+                    if e),
+                "unmuted_checks": events_obs.get("unmuted_checks", []),
+            }
         violations["cold_launches"] = inv.check_cold_launches(
             cold_before, _cold_launch_snapshot())
 
@@ -929,6 +1126,8 @@ async def run_scenario(
     finally:
         if watch_task is not None:
             watch_task.cancel()
+        if events_watch_task is not None:
+            events_watch_task.cancel()
         await cluster.stop()
 
 

@@ -4,7 +4,9 @@ leveled dout logging."""
 
 from ceph_tpu.common.admin_socket import AdminSocket, admin_command
 from ceph_tpu.common.config import OPTIONS, ConfigProxy, Option, declare
+from ceph_tpu.common.crash import record_crash, scan_crashes
 from ceph_tpu.common.dout import DoutLogger
+from ceph_tpu.common.logclient import LogClient, format_entry
 from ceph_tpu.common.optracker import OpTracker, TrackedOp
 from ceph_tpu.common.metrics import (
     MetricsServer,
@@ -22,11 +24,15 @@ __all__ = [
     "TrackedOp",
     "admin_command",
     "ConfigProxy",
+    "LogClient",
     "MetricsServer",
     "Option",
     "PerfCounters",
     "all_collections",
     "declare",
+    "format_entry",
     "get_perf_counters",
     "prometheus_text",
+    "record_crash",
+    "scan_crashes",
 ]

@@ -299,7 +299,7 @@ declare(
     Option("mgr_stats_max_daemons", int, 16, LEVEL_ADVANCED,
            "daemon slots in the mgr time-series store (LRU-evicted); "
            "part of the prewarmed analytics shape", min=1),
-    Option("mgr_stats_max_metrics", int, 12, LEVEL_ADVANCED,
+    Option("mgr_stats_max_metrics", int, 16, LEVEL_ADVANCED,
            "metric slots in the mgr time-series store (overflow "
            "metrics are counted + dropped, never resized mid-run); "
            "part of the prewarmed analytics shape", min=1),
@@ -320,6 +320,50 @@ declare(
            "module raises a per-device warning (see "
            "osd_max_object_read_errors for the osd's own suicide "
            "threshold)", min=1),
+    # -- cluster event plane (common/logclient.py, mon/log_service.py,
+    # mgr progress/crash modules) --------------------------------------
+    Option("mon_cluster_log_max", int, 512, LEVEL_ADVANCED,
+           "cluster-log entries the mon keeps in its paxos-replicated "
+           "ring (`ceph log last`; reference mon_log_max / "
+           "LogMonitor's bounded log)", min=16),
+    Option("mon_health_history_max", int, 128, LEVEL_ADVANCED,
+           "health-check transitions (raise/clear) kept in the mon's "
+           "replicated history ring (`ceph health history`)", min=8),
+    Option("mon_health_tick_interval", float, 0.5, LEVEL_ADVANCED,
+           "seconds between the leader's health-transition sweeps "
+           "(diffing current checks against the replicated history to "
+           "mint raise/clear events; 0 disables)", min=0.0),
+    Option("mon_health_mute_ttl_default", float, 0.0, LEVEL_ADVANCED,
+           "default seconds a `ceph health mute <code>` lasts when no "
+           "ttl is given (0 = until unmuted)", min=0.0),
+    Option("log_client_flush_interval", float, 0.25, LEVEL_ADVANCED,
+           "seconds between a daemon's LogClient MLog flushes to the "
+           "mon (reference LogClient's log_flush cadence)", min=0.05),
+    Option("log_client_max_pending", int, 256, LEVEL_ADVANCED,
+           "unacked cluster-log entries a daemon buffers before "
+           "dropping the oldest (counted; survives mon failover by "
+           "resend-until-acked)", min=8),
+    Option("log_client_rate", int, 64, LEVEL_ADVANCED,
+           "cluster-log entries one daemon may emit per flush "
+           "interval; beyond it entries are dropped and counted (the "
+           "reference's clog rate limiting role)", min=1),
+    Option("log_client_level", int, 1, LEVEL_ADVANCED,
+           "minimum severity shipped to the mon cluster log "
+           "(0=debug 1=info 2=warn 3=error 4=sec); the daemon-local "
+           "tail ring keeps every level for crash dumps", min=0, max=4),
+    Option("crash_dir", str, "", LEVEL_ADVANCED,
+           "directory daemons persist crash dumps into on unhandled "
+           "exit or fault-injector-induced death ('' disables; the "
+           "reference's /var/lib/ceph/crash + ceph-crash agent role)"),
+    Option("mgr_crash_recent_age", float, 600.0, LEVEL_ADVANCED,
+           "an unarchived crash younger than this keeps the "
+           "RECENT_CRASH health warning raised (reference "
+           "mgr/crash/warn_recent_interval, scaled to mini-cluster "
+           "timescales)", min=0.0),
+    Option("mgr_progress_complete_grace", float, 2.0, LEVEL_ADVANCED,
+           "seconds a completed progress event stays visible in "
+           "`ceph progress` before the mgr progress module reaps it",
+           min=0.0),
 )
 
 

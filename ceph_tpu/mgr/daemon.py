@@ -33,6 +33,7 @@ import time
 import numpy as np
 
 from ceph_tpu.msg.messages import (
+    MLogAck,
     MMgrBeacon,
     MMgrConfigure,
     MMgrMap,
@@ -141,6 +142,14 @@ class TimeSeriesStore:
                 out.append(int(self.values[d, m, i]))
         return out
 
+    def reserve(self, names) -> None:
+        """Pre-assign metric slots (in order) so the declared
+        analytics columns (analysis/prewarm_registry.py
+        ANALYTICS_COLUMNS) get deterministic positions and can never
+        be overflow-dropped by transient metrics racing for slots."""
+        for name in names:
+            self._metric_slot(name)
+
     def snapshot(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         return (self.values.copy(), self.valid.copy(),
                 self.cursor.copy())
@@ -190,6 +199,18 @@ class MgrDaemon:
             self.conf["mgr_stats_max_metrics"],
             self.conf["mgr_stats_window"],
         )
+        # declared analytics columns claim their slots up front (the
+        # event plane's degraded/misplaced EWMA columns included)
+        from ceph_tpu.analysis.prewarm_registry import ANALYTICS_COLUMNS
+
+        self.store.reserve(ANALYTICS_COLUMNS)
+        # cluster-log channel: SLOW_OPS raise/clear, scrub-
+        # deprioritize verdicts and progress milestones all land in
+        # the mon's replicated log through it
+        from ceph_tpu.common.logclient import LogClient
+
+        self.clog = LogClient(
+            f"mgr.{name}", self.conf, send=self._send_mon)
         self.engine = AnalyticsEngine(
             *self.store.shape,
             backend=self.conf["mgr_analytics_backend"],
@@ -234,6 +255,7 @@ class MgrDaemon:
         self._warm_task = asyncio.ensure_future(
             asyncio.to_thread(self.engine.prewarm))
         await self._mon_hunt()
+        self.clog.start()
         self._beacon_task = asyncio.ensure_future(self._beacon_loop())
         self._digest_task = asyncio.ensure_future(self._digest_loop())
         self._module_task = asyncio.ensure_future(self._module_loop())
@@ -241,6 +263,7 @@ class MgrDaemon:
 
     async def stop(self) -> None:
         self.stopping = True
+        await self.clog.stop()
         for t in (self._beacon_task, self._digest_task,
                   self._module_task, self._warm_task):
             if t:
@@ -251,6 +274,20 @@ class MgrDaemon:
         if self._admin is not None:
             await self._admin.stop()
         await self.messenger.shutdown()
+
+    async def _send_mon(self, msg: Message) -> None:
+        if self._mon_conn is None:
+            raise ConnectionError("no monitor session")
+        await self._mon_conn.send_message(msg)
+
+    def record_crash(self, reason: str = "",
+                     exc: BaseException | None = None) -> str | None:
+        """Persist a crash dump for this mgr (unhandled death / chaos
+        kill); the crash module on the surviving active collects it."""
+        from ceph_tpu.common.crash import record_crash
+
+        return record_crash(self.conf, f"mgr.{self.name}", exc=exc,
+                            reason=reason, log_tail=self.clog.tail())
 
     def _register_admin_commands(self, sock) -> None:
         sock.register(
@@ -342,6 +379,8 @@ class MgrDaemon:
                 await self._handle_open(msg)
             elif isinstance(msg, MMgrReport):
                 self._handle_report(msg)
+            elif isinstance(msg, MLogAck):
+                self.clog.handle_ack(msg)
             elif isinstance(msg, MMonCommandAck):
                 fut = self._cmd_waiters.get(msg.tid)
                 if fut and not fut.done():
@@ -450,6 +489,17 @@ class MgrDaemon:
             self.engine.analyze, values, valid, cursor)
         await self._push_scrub_flags()
         digest = self._build_digest()
+        # SLOW_OPS raise/clear lands in the cluster log at its signal
+        # site (the mon's health tick only logs its own map-derived
+        # checks, so these lines never double up)
+        slow = digest["health"].get("SLOW_OPS")
+        if (slow is not None) != getattr(self, "_slow_ops_flag", False):
+            self._slow_ops_flag = slow is not None
+            if slow is not None:
+                self.clog.cluster.warn(
+                    f"Health check failed: {slow['summary']} (SLOW_OPS)")
+            else:
+                self.clog.cluster.info("Health check cleared: SLOW_OPS")
         try:
             await self._mon_conn.send_message(MMonMgrReport(
                 blob=json.dumps(digest).encode()))
@@ -541,6 +591,10 @@ class MgrDaemon:
                     scrub_deprioritize=want))
                 self._deprioritized[daemon] = want
                 self.perf.inc("scrub_deprioritize_pushes")
+                self.clog.cluster.info(
+                    f"{daemon} scrub deprioritized (latency outlier)"
+                    if want else
+                    f"{daemon} scrub deprioritization lifted")
             except (ConnectionError, OSError):
                 pass  # daemon gone; next session re-opens clean
 
@@ -651,6 +705,15 @@ class MgrDaemon:
             digest["prometheus"] = prom.text()
             if prom.addr:
                 digest["prometheus_addr"] = list(prom.addr)
+        prog = self.modules.get("progress")
+        if prog is not None and prog.running:
+            digest["progress"] = {
+                "events": prog.public_events(),
+                "completed": prog.public_completed(),
+            }
+        crash = self.modules.get("crash")
+        if crash is not None and crash.running:
+            digest["crash"] = crash.summary()
         return digest
 
     # -- modules -------------------------------------------------------

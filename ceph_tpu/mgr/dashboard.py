@@ -23,6 +23,10 @@ Endpoints:
   GET /api/traces       cross-daemon trace summaries + assembled
                         trees from the active mgr's TraceCollector
                         (rides the MMonMgrReport digest)
+  GET /api/logs         the replicated cluster log's newest entries
+                        (+ the follow cursor `ceph -w` uses)
+  GET /api/progress     mgr progress-module events (recovery/
+                        rebalance fractions + ETAs, via the digest)
   GET /metrics          prometheus text (same as the exporter)
 
 Runs inside the monitor process and reads its in-memory state via the
@@ -73,6 +77,8 @@ _PAGE = """<!doctype html>
 <a href="/api/osds">osds</a> &middot;
 <a href="/api/pg">pg</a> &middot;
 <a href="/api/traces">traces</a> &middot;
+<a href="/api/logs">logs</a> &middot;
+<a href="/api/progress">progress</a> &middot;
 <a href="/metrics">metrics</a></p>
 </body></html>
 """
@@ -122,6 +128,13 @@ class Dashboard:
         if path == "/api/traces":
             digest = getattr(self.mon, "_mgr_digest", None) or {}
             return (json.dumps(digest.get("traces", {})).encode(),
+                    b"application/json")
+        if path == "/api/logs":
+            return (json.dumps(self.mon._log_last(50)).encode(),
+                    b"application/json")
+        if path == "/api/progress":
+            digest = getattr(self.mon, "_mgr_digest", None) or {}
+            return (json.dumps(digest.get("progress", {})).encode(),
                     b"application/json")
         if path == "/api/pools":
             om = self.mon.osdmap

@@ -42,13 +42,23 @@ class CommandMixin:
         mutating = prefix in self.WRITE_PREFIXES or prefix in (
             # not mutations, but only the leader ingests pg stats /
             # mgr digests and knows the live quorum: redirect so peons
-            # don't serve an empty status plane
+            # don't serve an empty status plane.  `log last` / `health
+            # history` are deliberately ABSENT: they serve replicated
+            # state, so a follow stream keeps working on any member
+            # through a mon failover.
             "status", "health", "pg stat", "df", "osd df",
             "osd perf", "mgr stat", "trace ls", "trace show",
+            "progress", "crash ls", "crash info",
         )
         if mutating and not self.is_leader:
             leader = self.paxos.leader if self.paxos.leader is not None else -1
             return -errno.EAGAIN, f"ENOTLEADER {leader}", b""
+        if prefix in self.WRITE_PREFIXES:
+            # every accepted admin write lands in the AUDIT channel of
+            # the replicated cluster log (the reference logs command
+            # dispatch through LogChannel("audit"))
+            await self._log_append("audit", 1, "from='client' cmd=" + str(
+                {k: v for k, v in sorted(cmd.items())}) + ": dispatch")
         try:
             if prefix == "osd erasure-code-profile set":
                 name = cmd["name"]
@@ -247,10 +257,14 @@ class CommandMixin:
                         for name, pid in self._pool_ids.items()
                     },
                     "pgs": pgsum,
-                    "health": self._health_checks(pgsum),
+                    "health": self._render_health(pgsum),
                     # the `ceph status` mgr line (reference mgrmap
                     # summary: "mgr: x(active), standbys: y")
                     "mgr": self._mgr_status_block(),
+                    # the mgr progress module's events (recovery /
+                    # rebalance completion + ETA), folded into status
+                    "progress": (self._mgr_digest or {}).get(
+                        "progress", {}),
                 }).encode()
                 return 0, "", data
             if prefix == "config set":
@@ -497,18 +511,85 @@ class CommandMixin:
                 a["rendered"] = render_tree(a["tree"])
                 return 0, "", json.dumps(a).encode()
             if prefix == "health":
-                h = self._health_checks()
-                # module health checks ride the mgr digest (reference
-                # MMonMgrReport carrying the mgr's health_checks)
-                for name, chk in ((self._mgr_digest or {}).get(
-                        "health", {}) or {}).items():
-                    h["checks"][name] = chk
-                    if (chk.get("severity") == "HEALTH_ERR"
-                            or h["status"] == "HEALTH_ERR"):
-                        h["status"] = "HEALTH_ERR"
-                    elif h["status"] == "HEALTH_OK":
-                        h["status"] = "HEALTH_WARN"
+                # own checks + mgr-digest module checks, mute-filtered
+                # (mon/log_service.py — the reference HealthMonitor +
+                # MMonMgrReport health merge)
+                h = self._render_health()
                 return 0, h["status"], json.dumps(h).encode()
+            if prefix == "health history":
+                return 0, "", json.dumps({
+                    "history": self._health_history,
+                    "mutes": self._health_mutes,
+                }).encode()
+            if prefix == "health mute":
+                code_name = cmd["code"]
+                ttl = float(cmd.get("ttl") or
+                            self.conf["mon_health_mute_ttl_default"])
+                import time as _time
+
+                await self._propose({
+                    "op": "health_mute", "code": code_name,
+                    "until": (_time.time() + ttl) if ttl > 0 else None,
+                    "sticky": cmd.get("sticky", "") in
+                    ("1", "true", "yes", "on"),
+                    "at": _time.time(),
+                })
+                return 0, f"muted {code_name}" + (
+                    f" for {ttl:g}s" if ttl > 0 else ""), b""
+            if prefix == "health unmute":
+                code_name = cmd["code"]
+                if code_name not in self._health_mutes:
+                    return -errno.ENOENT, f"{code_name} is not muted", b""
+                await self._propose({
+                    "op": "health_unmute", "code": code_name})
+                return 0, f"unmuted {code_name}", b""
+            if prefix == "log last":
+                return 0, "", json.dumps(self._log_last(
+                    n=int(cmd.get("n", "20")),
+                    channel=cmd.get("channel", ""),
+                    since=int(cmd.get("since", "0")),
+                )).encode()
+            if prefix == "progress":
+                # recovery/rebalance progress events from the mgr
+                # progress module (ride the MMonMgrReport digest)
+                d = self._mgr_digest or {}
+                prog = d.get("progress", {}) or {}
+                return 0, "", json.dumps({
+                    "events": prog.get("events", []),
+                    "completed": prog.get("completed", []),
+                    "source_mgr": d.get("active"),
+                }).encode()
+            if prefix == "crash ls":
+                d = self._mgr_digest or {}
+                crash = d.get("crash", {}) or {}
+                return 0, "", json.dumps({
+                    "crashes": crash.get("crashes", []),
+                    "recent": crash.get("recent", 0),
+                    "source_mgr": d.get("active"),
+                }).encode()
+            if prefix == "crash info":
+                d = self._mgr_digest or {}
+                cid = cmd["id"]
+                for meta in (d.get("crash", {}) or {}).get("crashes", []):
+                    if meta.get("crash_id") == cid:
+                        return 0, "", json.dumps(meta).encode()
+                return -errno.ENOENT, f"no crash {cid!r} in the " \
+                    "collector window (see `crash ls`)", b""
+            if prefix in ("crash archive", "crash archive-all"):
+                # the shared crash_dir IS the posted record: archiving
+                # marks dumps acknowledged in place; the mgr crash
+                # module observes it on its next scan and RECENT_CRASH
+                # clears
+                from ceph_tpu.common.crash import archive_crash
+
+                cdir = self.conf["crash_dir"]
+                if not cdir:
+                    return -errno.EINVAL, \
+                        "crash_dir is not configured on this mon", b""
+                cid = None if prefix.endswith("-all") else cmd["id"]
+                n = archive_crash(cdir, cid)
+                return 0, f"archived {n} crash dump(s)", json.dumps(
+                    {"archived": n}).encode()
             if prefix == "pg stat":
                 book = getattr(self, "_pg_stats", {}) or {}
                 return 0, "", json.dumps({

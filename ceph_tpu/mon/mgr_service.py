@@ -26,7 +26,7 @@ log = logging.getLogger("ceph_tpu.mon")
 
 #: modules enabled in a fresh map (mirror of mgr/modules.py
 #: DEFAULT_MODULES without importing the mgr package into the mon)
-_DEFAULT_MODULES = ("devicehealth", "prometheus")
+_DEFAULT_MODULES = ("crash", "devicehealth", "progress", "prometheus")
 
 
 class MgrServiceMixin:

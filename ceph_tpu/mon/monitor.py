@@ -34,6 +34,7 @@ import time
 from ceph_tpu.crush.types import CrushMap
 from ceph_tpu.msg.messages import (
     MConfig,
+    MLog,
     MMgrBeacon,
     MMonCommand,
     MMonCommandAck,
@@ -54,13 +55,15 @@ log = logging.getLogger("ceph_tpu.mon")
 from ceph_tpu.mon.auth_service import AuthServiceMixin  # noqa: E402
 from ceph_tpu.mon.commands import CommandMixin  # noqa: E402
 from ceph_tpu.mon.config_service import ConfigServiceMixin  # noqa: E402
+from ceph_tpu.mon.log_service import LogServiceMixin  # noqa: E402
 from ceph_tpu.mon.mgr_service import MgrServiceMixin  # noqa: E402
 from ceph_tpu.mon.osd_service import OSDMonitorMixin  # noqa: E402
 from ceph_tpu.mon.stats_service import StatsServiceMixin  # noqa: E402
 
 
 class Monitor(OSDMonitorMixin, StatsServiceMixin, MgrServiceMixin,
-              AuthServiceMixin, ConfigServiceMixin, CommandMixin):
+              LogServiceMixin, AuthServiceMixin, ConfigServiceMixin,
+              CommandMixin):
     def __init__(
         self,
         crush: CrushMap | None = None,
@@ -172,6 +175,9 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, MgrServiceMixin,
         self._next_pool = 1
         # MgrMap state (mon/mgr_service.py) — must predate replay
         self._init_mgr_service()
+        # cluster log + health history/mute state (mon/log_service.py)
+        # — replicated, must predate replay too
+        self._init_log_service()
         # the mon's own report stream to the active mgr (every daemon
         # carries one); fed the map directly on publish — the mon is
         # its own MgrMap source
@@ -243,12 +249,18 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, MgrServiceMixin,
                 lambda cmd: self.tracer.dump(),
             )
             self._admin.register(
+                "dump_log", "cluster-log/health-history service state "
+                "(ring sizes, mute book, per-entity seqs)",
+                lambda cmd: self.dump_log_service(),
+            )
+            self._admin.register(
                 "perf dump", "dump perf counters",
                 lambda cmd: self.perf.dump(),
             )
             await self._admin.start()
         await self._replay()
         self._start_mgr_tick()
+        self._start_health_tick()
         self.mgr_client.start()
         if self.beacon_grace > 0:
             self._tick_task = asyncio.ensure_future(self._tick())
@@ -297,6 +309,7 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, MgrServiceMixin,
             "config_db": self._config_db,
             "auth_db": self._auth_db,
             "mgr_map": self._mgr_map,
+            "log_service": self._log_service_snapshot(),
         }))
         return self._state_version, enc.bytes()
 
@@ -320,6 +333,7 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, MgrServiceMixin,
         self._auth_db = dict(aux.get("auth_db", {}))
         if aux.get("mgr_map"):
             self._mgr_map = dict(aux["mgr_map"])
+        self._install_log_service(aux.get("log_service") or {})
         self._sync_auth_keyring()
         self._apply_config_locally()
         self._up_from = {
@@ -388,6 +402,8 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, MgrServiceMixin,
             self._tick_task.cancel()
         if self._mgr_tick_task:
             self._mgr_tick_task.cancel()
+        if self._health_tick_task:
+            self._health_tick_task.cancel()
         if self._probe_task:
             self._probe_task.cancel()
         if getattr(self, "_autoscale_task", None):
@@ -487,6 +503,15 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, MgrServiceMixin,
         if kind in ("mgr_beacon", "mgr_down", "mgr_module"):
             await self._apply_mgr_op(op)
             return  # MgrMap has its own epoch sequence
+        if kind == "clog":
+            self._apply_clog_op(op)
+            return  # log entries don't mint osdmap epochs
+        if kind == "health_history":
+            self._apply_health_history_op(op)
+            return
+        if kind in ("health_mute", "health_unmute"):
+            self._apply_health_mute_op(op)
+            return
         if await self._apply_osd_op(op):
             await self._new_epoch()
 
@@ -542,6 +567,8 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, MgrServiceMixin,
                 await self._forward_to_leader(msg)
         elif isinstance(msg, MOSDFailure):
             await self._handle_failure(msg)
+        elif isinstance(msg, MLog):
+            await self._handle_log(msg)
         elif isinstance(msg, MMgrBeacon):
             await self._handle_mgr_beacon(msg)
         elif isinstance(msg, MMonMgrReport):
@@ -615,6 +642,8 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, MgrServiceMixin,
         "osd tier add", "osd tier remove", "osd tier cache-mode",
         "osd tier set-overlay", "osd tier remove-overlay",
         "mgr module enable", "mgr module disable", "mgr fail",
+        "health mute", "health unmute",
+        "crash archive", "crash archive-all",
     })
 
 

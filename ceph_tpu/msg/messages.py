@@ -1308,6 +1308,66 @@ class MMonMgrReport(Message):
         return cls(dec.bytes_())
 
 
+# -- cluster log (src/messages/MLog.h, MLogAck.h) ---------------------------
+
+class MLog(Message):
+    """daemon -> mon: a batch of cluster-log entries from one daemon's
+    LogClient (reference MLog carrying LogEntry vectors).  ``entity``
+    identifies the sender once for the whole batch; each entry carries
+    its per-entity ``seq`` so the mon's LogMonitor twin can dedup
+    resends across flushes and mon failovers.  Entries are dicts
+    {"seq", "stamp", "channel", "level", "message"}."""
+
+    TYPE = 126
+
+    def __init__(self, entity: str = "", entries: list[dict] | None = None):
+        self.entity = entity
+        self.entries = entries or []
+
+    def encode_payload(self, enc):
+        enc.str_(self.entity)
+        enc.u32(len(self.entries))
+        for e in self.entries:
+            enc.u64(int(e["seq"]))
+            enc.str_(repr(float(e["stamp"])))
+            enc.str_(e["channel"])
+            enc.u8(int(e["level"]))
+            enc.str_(e["message"])
+
+    @classmethod
+    def decode_payload(cls, dec):
+        entity = dec.str_()
+        entries = [
+            {
+                "seq": dec.u64(),
+                "stamp": float(dec.str_()),
+                "channel": dec.str_(),
+                "level": dec.u8(),
+                "message": dec.str_(),
+            }
+            for _ in range(dec.u32())
+        ]
+        return cls(entity, entries)
+
+
+class MLogAck(Message):
+    """mon -> daemon: entries up to ``last_seq`` are committed in the
+    replicated cluster log (reference MLogAck); the LogClient drops
+    them from its resend buffer."""
+
+    TYPE = 127
+
+    def __init__(self, last_seq: int = 0):
+        self.last_seq = last_seq
+
+    def encode_payload(self, enc):
+        enc.u64(self.last_seq)
+
+    @classmethod
+    def decode_payload(cls, dec):
+        return cls(dec.u64())
+
+
 # -- cephfs client <-> mds (src/messages/MClientRequest.h) ------------------
 
 class MClientRequest(Message):

@@ -46,6 +46,7 @@ from ceph_tpu.ec import registry as ec_registry
 from ceph_tpu.msg.messages import (
     PING,
     PING_REPLY,
+    MLogAck,
     MMgrConfigure,
     MMgrMap,
     MMonSubscribe,
@@ -264,6 +265,13 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             f"osd.{osd_id}", self.messenger, self.conf,
             self._mgr_collect,
             tracers=(self.tracer, device_tracer()))
+        # cluster-log channel (common/logclient.py): operator-relevant
+        # events (self-markdown, repair requeues) ship to the mon's
+        # replicated log; the local tail ring feeds crash dumps
+        from ceph_tpu.common.logclient import LogClient
+
+        self.clog = LogClient(
+            f"osd.{osd_id}", self.conf, send=self._send_mon_log)
         self.dlog = DoutLogger("osd", self.conf, name_suffix=str(osd_id))
         self._admin: object | None = None
         self._log_keep = self.conf["osd_min_pg_log_entries"]
@@ -399,6 +407,7 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             await self._admin.start()
         await self._mon_hunt()
         self.mgr_client.start()
+        self.clog.start()
         if self.beacon_interval > 0:
             self._beacon_task = asyncio.ensure_future(self._beacon())
         if self.conf["osd_heartbeat_interval"] > 0:
@@ -508,6 +517,7 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             # harness's later stop() must be a no-op
         self._stopped = True
         self.stopping = True
+        await self.clog.stop()
         await self.mgr_client.stop()
         if self._admin is not None:
             await self._admin.stop()
@@ -520,6 +530,25 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             if t:
                 t.cancel()
         await self.messenger.shutdown()
+
+    async def _send_mon_log(self, msg: Message) -> None:
+        """LogClient send hook: ship one MLog over the current mon
+        session (re-homed by the hunt task after mon failover, so
+        unacked entries resend to the new mon)."""
+        if self._mon_conn is None:
+            raise ConnectionError("no monitor session")
+        await self._mon_conn.send_message(msg)
+
+    def record_crash(self, reason: str = "",
+                     exc: BaseException | None = None) -> str | None:
+        """Persist a crash dump (common/crash.py) for an unhandled
+        exit or a fault-injector-induced death: entity, exception/
+        reason, config fingerprint and the in-memory log tail — the
+        mgr crash module collects it (`ceph crash ls`)."""
+        from ceph_tpu.common.crash import record_crash
+
+        return record_crash(self.conf, f"osd.{self.id}", exc=exc,
+                            reason=reason, log_tail=self.clog.tail())
 
     def _statfs(self) -> dict:
         """This OSD's store usage; cached per beacon tick.  Also drives
@@ -572,7 +601,7 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         for pid, pool in om.pools.items():
             for ps in range(pool.pg_num):
                 pg = pg_t(pid, ps)
-                _u, _up, acting, primary = om.pg_to_up_acting_osds(
+                up, _up, acting, primary = om.pg_to_up_acting_osds(
                     pg, folded=True)
                 if primary != self.id:
                     continue
@@ -613,7 +642,12 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                             or 1)
                         n_bytes *= k
                 out[f"{pid}.{ps}"] = {
-                    "state": state, "objects": n_obj, "bytes": n_bytes}
+                    "state": state, "objects": n_obj, "bytes": n_bytes,
+                    # upmap/reweight moved this pg off its CRUSH-ideal
+                    # home: objects are misplaced (not missing) — the
+                    # mgr progress module's rebalance-event source
+                    "misplaced": (not degraded and up != acting),
+                }
         return _json.dumps(out).encode()
 
     def _mgr_collect(self) -> dict:
@@ -622,11 +656,20 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         import json as _json
 
         pg_states: dict[str, int] = {}
+        pgs_degraded = pgs_misplaced = 0
         try:
             for st in _json.loads(
                     self._collect_pg_stats() or b"{}").values():
                 s = st.get("state", "unknown")
                 pg_states[s] = pg_states.get(s, 0) + 1
+                # the progress module's raw material: PGs this OSD
+                # leads that are missing data (degraded/recovering/
+                # peering) vs merely living off their CRUSH home
+                if ("degraded" in s or "recovering" in s
+                        or "peering" in s):
+                    pgs_degraded += 1
+                elif st.get("misplaced"):
+                    pgs_misplaced += 1
         except ValueError:
             pass
         # ops currently in flight past the complaint threshold: the
@@ -652,6 +695,10 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                 "inflight_ops": float(len(self.op_tracker.inflight)),
                 "slow_ops": float(self.op_tracker.complaints),
                 "slow_ops_inflight": float(slow_inflight),
+                # event-plane columns (reserved in the analytics
+                # store; their integer-exact EWMAs drive progress ETAs)
+                "pgs_degraded": float(pgs_degraded),
+                "pgs_misplaced": float(pgs_misplaced),
             },
             "histograms": dict(self.op_tracker.histograms),
             "status": {
@@ -1240,6 +1287,17 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             "osd_max_object_read_errors; marking self failed and "
             "shutting down", self.id, len(self._read_error_ledger),
         )
+        # the self-markdown is an operator-visible cluster event AND a
+        # fault-induced death: one line in the replicated cluster log,
+        # one crash dump for `ceph crash ls` / RECENT_CRASH
+        self.clog.cluster.error(
+            f"osd.{self.id} marking self down: "
+            f"{len(self._read_error_ledger)} objects with verified "
+            "medium errors (read-error ledger escalation)")
+        self.record_crash(
+            reason="read-error ledger escalation: "
+            f"{len(self._read_error_ledger)} damaged objects >= "
+            "osd_max_object_read_errors; daemon self-terminated")
 
         async def _die() -> None:
             try:
@@ -1248,6 +1306,9 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                 ))
             except (ConnectionError, OSError, AttributeError):
                 pass  # peers' connection resets will report us instead
+            # last flush: the markdown log entry must beat the stop
+            # (stop() cancels the flush loop)
+            await self.clog.flush()
             await self.stop()
 
         # held OUTSIDE _repair_tasks: stop() cancels those, and the
@@ -1342,6 +1403,8 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                 self.mgr_client.handle_mgr_map(msg)
             elif isinstance(msg, MMgrConfigure):
                 self.mgr_client.handle_configure(msg)
+            elif isinstance(msg, MLogAck):
+                self.clog.handle_ack(msg)
             elif isinstance(msg, MConfig):
                 self._apply_mon_config(msg)
             elif isinstance(msg, MOSDPing):

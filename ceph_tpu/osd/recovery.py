@@ -1056,6 +1056,9 @@ class RecoveryMixin:
         if key in pend:
             return
         pend.add(key)
+        self.clog.cluster.warn(
+            f"pg {pg} object {oid}: write-path repair failed; "
+            "requeued background repair")
 
         async def _retry() -> None:
             try:

@@ -216,10 +216,12 @@ class TestReadErrorEscalation:
         keeps working off the surviving members."""
 
         async def go():
+            crash_dir = str(tmp_path / "crash")
             async with Cluster(
                 n_osds=4,
                 store_factory=_blockstore_factory(tmp_path),
-                osd_conf={"osd_max_object_read_errors": 2},
+                osd_conf={"osd_max_object_read_errors": 2,
+                          "crash_dir": crash_dir},
             ) as c:
                 await c.client.pool_create("dd", pg_num=8, size=2)
                 io = c.client.ioctx("dd")
@@ -253,6 +255,18 @@ class TestReadErrorEscalation:
                         break
                 assert down, "dying disk never escalated to markdown"
                 assert c.osds[victim]._disk_escalated
+                # event-plane wiring: the self-markdown emitted a
+                # cluster-log entry and persisted a crash dump
+                tail = " | ".join(
+                    e["message"] for e in c.osds[victim].clog.tail())
+                assert "marking self down" in tail
+                from ceph_tpu.common.crash import scan_crashes
+
+                dumps = scan_crashes(crash_dir)
+                assert any(
+                    m["entity"] == f"osd.{victim}"
+                    and "read-error ledger" in m["reason"]
+                    for m in dumps), dumps
                 FAULTS.clear()
                 # the cluster serves every object without the dead osd
                 for oid in oids:

@@ -148,6 +148,41 @@ def test_chaos_artifact_cited_and_green():
                 assert obs.get("slow_ops_cleared"), r
 
 
+def test_chaos_event_plane_artifact():
+    """The event-plane PR's honesty contract (r12): the cited matrix
+    must carry the FULL scenario set x >= 8 seeds, and every
+    osd_thrash / disk-fault run must have been judged by the
+    ``events`` invariant — progress events observed (monotone, reach
+    1.0, reaped), a crash dump collected for every injected daemon
+    death, and zero unmuted unexpected health codes at settle."""
+    cited = _chaos_artifacts()
+    assert any("r12" in n for n in cited), (
+        "CHAOS_r12 (event-plane matrix) must stay cited")
+    name = next(n for n in sorted(cited) if "r12" in n)
+    with open(os.path.join(REPO, name)) as f:
+        doc = json.load(f)
+    assert len(doc["scenarios"]) >= 6, doc["scenarios"]
+    assert len(doc["seeds"]) >= 8
+    assert doc["summary"]["all_green"], doc["summary"]
+    judged = 0
+    for r in doc["runs"]:
+        if r["scenario"] not in ("osd_thrash", "disk-fault"):
+            continue
+        judged += 1
+        assert r["invariants"]["events"]["ok"], r
+        obs = r.get("events_obs", {})
+        if obs.get("expect_progress"):
+            evs = obs.get("events", {})
+            assert evs, r
+            assert all(e["final"] == 1.0 and e["reaped"]
+                       for e in evs.values()), r
+        # every injected death has a collected crash dump
+        for entity, n in (obs.get("deaths") or {}).items():
+            if n > 0:
+                assert entity in (obs.get("crash_entities") or []), r
+    assert judged >= 16, "osd_thrash + disk-fault x 8 seeds expected"
+
+
 def test_chaos_artifact_traces_replay():
     """Determinism guard: regenerating every artifact run's schedule
     from (scenario, seed) must reproduce its recorded trace hash

@@ -180,6 +180,45 @@ class TestPrometheusExposition:
         assert len(spans) == 1 and spans[0]["name"] == "do_op"
         assert t.drain_export() == []
 
+    def test_mgr_module_exports_event_plane_series(self, tmp_path):
+        """Event-plane satellite: the prometheus module exports
+        health-check states, progress completion fractions and crash
+        counts as typed series."""
+        from ceph_tpu.common import ConfigProxy, record_crash
+        from ceph_tpu.mgr.daemon import MgrDaemon
+
+        conf = ConfigProxy({"crash_dir": str(tmp_path)})
+        mgr = MgrDaemon("expo2", ("127.0.0.1", 1), conf=conf)
+        prog = mgr.modules["progress"]
+        crash = mgr.modules["crash"]
+        prom = mgr.modules["prometheus"]
+        prog.running = crash.running = True
+        # one active progress event + one collected crash
+        mgr.sessions["osd.0"] = {
+            "counters": {}, "histograms": {}, "status": {},
+            "reports": 1, "gauges": {"pgs_degraded": 4.0},
+        }
+        record_crash(conf, "osd.0", reason="test")
+
+        async def drive():
+            await prog.tick()
+            await crash.tick()
+
+        run(drive())
+        text = prom.text()
+        for name, typ in (
+            ("ceph_tpu_health_recent_crash", "gauge"),
+            ("ceph_tpu_health_checks_active", "gauge"),
+            ("ceph_tpu_progress_events_active", "gauge"),
+            ("ceph_tpu_progress_recovery_fraction", "gauge"),
+            ("ceph_tpu_crash_reports_total", "counter"),
+            ("ceph_tpu_crash_recent", "gauge"),
+        ):
+            assert f"# TYPE {name} {typ}" in text, (name, typ)
+            assert f"\n{name} " in "\n" + text, name
+        assert "ceph_tpu_crash_reports_total 1" in text
+        assert "ceph_tpu_progress_events_active 1" in text
+
     def test_histogram_exposition(self):
         pc = PerfCounters("osd.7")
         h = LatencyHistogram()

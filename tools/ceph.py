@@ -17,8 +17,17 @@ mini-cluster's command surface:
   ceph.py -m HOST:PORT mgr module ls | mgr module enable NAME
           | mgr module disable NAME
   ceph.py -m HOST:PORT trace ls | trace show TRACE_ID
+  ceph.py -m HOST:PORT log last [N]
+  ceph.py -m HOST:PORT -w              # follow the cluster log
+  ceph.py -m HOST:PORT progress
+  ceph.py -m HOST:PORT health history | health mute CODE [TTL]
+          | health unmute CODE
+  ceph.py -m HOST:PORT crash ls | crash info ID | crash archive ID
+          | crash archive-all
 
-Multiple monitors: -m accepts a comma-separated monmap.
+Multiple monitors: -m accepts a comma-separated monmap.  The follow
+mode (`-w`) polls the mon's replicated log with a cursor, so it rides
+through a mon failover (reconnect + resume at the cursor).
 """
 
 from __future__ import annotations
@@ -42,6 +51,43 @@ def parse_addrs(spec: str) -> list[tuple[str, int]]:
     return out
 
 
+def _progress_bar(ev: dict, width: int = 24) -> str:
+    frac = float(ev.get("fraction") or 0.0)
+    filled = int(frac * width)
+    bar = "=" * filled + ">" * (1 if filled < width else 0)
+    eta = ev.get("eta_s")
+    eta_s = f"  ETA {eta:g}s" if eta not in (None, 0.0) else ""
+    return (f"  [{bar:<{width}}] {frac * 100:5.1f}%  "
+            f"{ev.get('message', ev.get('id', ''))}{eta_s}")
+
+
+async def _watch_log(client, channel: str = "") -> int:
+    """`ceph -w`: follow the replicated cluster log via the mon-side
+    cursor; mon failover only pauses the stream (the client re-homes
+    and the cursor resumes on whichever mon answers)."""
+    from ceph_tpu.common.logclient import format_entry
+
+    cursor = 0
+    first = True
+    while True:
+        cmd = {"prefix": "log last", "n": "20" if first else "0",
+               "since": "0" if first else str(cursor)}
+        if channel:
+            cmd["channel"] = channel
+        try:
+            code, _rs, data = await client.command(cmd)
+        except (OSError, ConnectionError):
+            await asyncio.sleep(0.5)
+            continue
+        if code == 0 and data:
+            doc = json.loads(data)
+            for e in doc.get("entries", []):
+                print(format_entry(e), flush=True)
+            cursor = max(cursor, int(doc.get("cursor", 0)))
+            first = False
+        await asyncio.sleep(0.5)
+
+
 async def amain(args, extra: list[str]) -> int:
     from ceph_tpu.client import RadosClient
 
@@ -49,8 +95,36 @@ async def amain(args, extra: list[str]) -> int:
     await client.connect_multi(parse_addrs(args.mon))
     try:
         verb = args.cmd
+        if args.watch:
+            return await _watch_log(
+                client, channel=getattr(args, "channel", ""))
         if verb == "status":
             code, rs, data = await client.command({"prefix": "status"})
+            if code == 0 and data:
+                doc = json.loads(data)
+                print(json.dumps(doc, indent=2))
+                # the human block — mgr progress bars + the last 5
+                # cluster-log lines (the `ceph -s` tail) — goes to
+                # stderr so stdout stays machine-parseable JSON
+                events = (doc.get("progress") or {}).get("events", [])
+                if events:
+                    print("\nprogress:", file=sys.stderr)
+                    for ev in events:
+                        print(_progress_bar(ev), file=sys.stderr)
+                lcode, _lrs, ldata = await client.command(
+                    {"prefix": "log last", "n": "5"})
+                if lcode == 0 and ldata:
+                    from ceph_tpu.common.logclient import format_entry
+
+                    entries = json.loads(ldata).get("entries", [])
+                    if entries:
+                        print("\nrecent cluster log:", file=sys.stderr)
+                        for e in entries:
+                            print("  " + format_entry(e),
+                                  file=sys.stderr)
+                if rs:
+                    print(rs, file=sys.stderr)
+                return 0
         elif verb == "df":
             om = client.osdmap
             data = json.dumps({
@@ -112,8 +186,63 @@ async def amain(args, extra: list[str]) -> int:
             })
         elif verb == "pg" and extra[:1] == ["stat"]:
             code, rs, data = await client.command({"prefix": "pg stat"})
+        elif verb == "health" and extra[:1] == ["history"]:
+            code, rs, data = await client.command(
+                {"prefix": "health history"})
+        elif verb == "health" and extra[:1] == ["mute"]:
+            cmd = {"prefix": "health mute", "code": extra[1]}
+            if len(extra) > 2:
+                cmd["ttl"] = extra[2]
+            if args.sticky:
+                cmd["sticky"] = "true"
+            code, rs, data = await client.command(cmd)
+        elif verb == "health" and extra[:1] == ["unmute"]:
+            code, rs, data = await client.command(
+                {"prefix": "health unmute", "code": extra[1]})
         elif verb == "health":
             code, rs, data = await client.command({"prefix": "health"})
+        elif verb == "log" and extra[:1] == ["last"]:
+            cmd = {"prefix": "log last"}
+            if len(extra) > 1:
+                cmd["n"] = extra[1]
+            code, rs, data = await client.command(cmd)
+            if code == 0 and data:
+                from ceph_tpu.common.logclient import format_entry
+
+                for e in json.loads(data).get("entries", []):
+                    print(format_entry(e))
+                return 0
+        elif verb == "progress":
+            code, rs, data = await client.command({"prefix": "progress"})
+            if code == 0 and data:
+                doc = json.loads(data)
+                for ev in doc.get("events", []):
+                    print(_progress_bar(ev))
+                for ev in doc.get("completed", []):
+                    print(f"  [done in {ev.get('duration_s', '?')}s] "
+                          f"{ev.get('message', ev.get('id', ''))}")
+                if not doc.get("events") and not doc.get("completed"):
+                    print("(no active progress events)")
+                return 0
+        elif verb == "crash" and extra[:1] == ["ls"]:
+            code, rs, data = await client.command({"prefix": "crash ls"})
+            if code == 0 and data:
+                doc = json.loads(data)
+                for m in doc.get("crashes", []):
+                    mark = "  (archived)" if m.get("archived") else ""
+                    print(f"{m['crash_id']}  {m.get('entity', '?')}  "
+                          f"{m.get('reason', '')[:60]}{mark}")
+                print(f"{doc.get('recent', 0)} recent (unarchived)")
+                return 0
+        elif verb == "crash" and extra[:1] == ["info"]:
+            code, rs, data = await client.command(
+                {"prefix": "crash info", "id": extra[1]})
+        elif verb == "crash" and extra[:1] == ["archive-all"]:
+            code, rs, data = await client.command(
+                {"prefix": "crash archive-all"})
+        elif verb == "crash" and extra[:1] == ["archive"]:
+            code, rs, data = await client.command(
+                {"prefix": "crash archive", "id": extra[1]})
         elif verb == "trace" and extra[:1] == ["ls"]:
             code, rs, data = await client.command({"prefix": "trace ls"})
         elif verb == "trace" and extra[:1] == ["show"]:
@@ -193,10 +322,21 @@ def main(argv=None) -> int:
     ap.add_argument("--pool-type", default="replicated")
     ap.add_argument("--erasure-code-profile", default="")
     ap.add_argument("--max-swaps", type=int, default=0)
-    ap.add_argument("cmd")
+    ap.add_argument("-w", "--watch", action="store_true",
+                    help="follow the cluster log (like `ceph -w`)")
+    ap.add_argument("--channel", default="",
+                    help="with -w: only this log channel "
+                    "(cluster/audit)")
+    ap.add_argument("--sticky", action="store_true",
+                    help="with `health mute`: keep the mute across a "
+                    "clear (sticky semantics)")
+    ap.add_argument("cmd", nargs="?", default="status")
     ap.add_argument("extra", nargs="*")
     args = ap.parse_args(argv)
-    return asyncio.run(amain(args, args.extra))
+    try:
+        return asyncio.run(amain(args, args.extra))
+    except KeyboardInterrupt:
+        return 130
 
 
 if __name__ == "__main__":
