@@ -1,0 +1,25 @@
+# Developer entry points.  The same gates CI and the git pre-commit
+# hook run (.githooks/pre-commit; enable once per clone with
+# `git config core.hooksPath .githooks`).
+
+PY ?= python
+
+.PHONY: lint test chaos bench
+
+# ctlint: zero unbaselined findings, no stale/dead baseline entries
+# (exit 1 = new findings, 2 = stale/rotten baseline)
+lint:
+	$(PY) tools/lint.py
+
+# tier-1 test suite (the ROADMAP verify line, minus the timeout wrapper)
+test:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+# chaos sweep with the ctlint preflight (a dirty tree aborts before
+# any cluster boots)
+chaos:
+	$(PY) tools/chaos_run.py --lint --scenarios all --seeds 8
+
+bench:
+	$(PY) tools/bench_all.py

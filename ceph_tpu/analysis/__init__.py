@@ -7,20 +7,30 @@ and config keys stayed wired.  This package proves the same invariants
 at lint time, before a cold code path ships a violation — the role a
 race detector or clang-tidy pass plays for the C++ reference.
 
-Five rule families (see :mod:`ceph_tpu.analysis.rules`):
+Six rule families (see :mod:`ceph_tpu.analysis.rules`):
 
 - **device-discipline** — every jit/pmap/shard_map-wrapped callable
   reachable from the I/O-path modules must appear in the declared
   prewarm registry; shapes fed to jitted kernels must come from the
-  pow2-bucket helpers; no device sync under a held lock.
+  pow2-bucket helpers; no device sync under a held lock (resolved
+  through the call graph — a helper that syncs frames below the
+  critical section is caught too).
 - **lock-order** — cross-module lock-acquisition graph: cycles, and
-  blocking calls (sleep, socket send, store commit) under held locks.
+  blocking calls (sleep, socket send, store commit) under held locks,
+  resolved interprocedurally with the blocking chain named.
 - **wire-protocol** — duplicate/unregistered frame ids and
   encode/decode field asymmetry in ``msg/messages.py``.
 - **config-registry** — every config key read anywhere must have a
   registered default; dead registered options are reported.
 - **determinism** — no wall clock, ``random``-module globals, or
   unordered-set iteration in pure-trace paths (``chaos/schedule.py``).
+- **transfer** — device-residency dataflow
+  (:mod:`ceph_tpu.analysis.dataflow`): no device value reaching a
+  host-materializing op on the I/O path, no redundant device_put, no
+  undeclared in-out launch buffers, no implicit scalar syncs; paired
+  at runtime with ``common/transfer_guard.py`` (``host_transfers``
+  counter) the way the prewarm registry pairs with
+  ``cold_launches``.
 
 Run via ``tools/lint.py`` (human / ``--json`` / ``--update-baseline``)
 or through the tier-1 gate ``tests/test_static_analysis.py``.

@@ -253,6 +253,29 @@ def split_by_baseline(
     return new, old, stale
 
 
+def baseline_integrity(
+    baseline: dict[tuple[str, str, str], str],
+    project: Project,
+    known_rules: set[str],
+) -> list[tuple[tuple[str, str, str], str]]:
+    """Entries that cannot possibly fire again: their rule id is gone
+    from the catalog or their file is gone from the tree.  A normal
+    stale entry (finding fixed, file still there) merely needs an
+    ``--update-baseline``; these are harder rot — the (rule, file)
+    pair no longer EXISTS — and the chaos/bench preflight fails on
+    them so dead grandfather entries cannot mask a rename."""
+    paths = {sf.path for sf in project.files} \
+        | {sf.path for sf in project.aux_files}
+    out: list[tuple[tuple[str, str, str], str]] = []
+    for key in sorted(baseline):
+        rule, path, _msg = key
+        if rule not in known_rules:
+            out.append((key, f"rule {rule!r} no longer exists"))
+        elif path not in paths:
+            out.append((key, f"file {path!r} no longer exists"))
+    return out
+
+
 def write_baseline(path: str | Path, findings: list[Finding],
                    previous: dict[tuple[str, str, str], str]) -> None:
     """Rewrite the baseline to exactly the current finding set, keeping

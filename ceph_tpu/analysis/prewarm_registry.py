@@ -77,6 +77,20 @@ BUCKET_HELPERS: frozenset[str] = frozenset({
     "bucket_lanes",
 })
 
+#: donation declarations: ``module:qualname`` of a jitted callable ->
+#: positional-arg indices whose buffers the launch may consume
+#: (``donate_argnums`` / ``input_output_aliases``).  The transfer rule
+#: ``device-nondonated-inout`` flags an in-place update pattern
+#: (``x = kernel(..., x, ...)``) whose arg is NOT declared here: every
+#: such launch silently allocates a second output buffer.  An entry is
+#: a *claim* that the kernel really aliases the buffer (pallas
+#: input_output_aliases or jit donate_argnums) — keep the two in sync.
+DONATED: dict[str, tuple[int, ...]] = {
+    # carry is aliased to the output (input_output_aliases={3: 0} on
+    # the inner pallas_call; python-signature position 2)
+    "ceph_tpu.ops.rs_kernels:gf_bitmatmul_pallas_acc": (2,),
+}
+
 #: declared analytics columns: the gauge names expected to occupy
 #: metric slots of the mgr's fixed-shape (daemons x metrics x window)
 #: time-series store.  The mgr RESERVES these slots at start

@@ -5,6 +5,7 @@ from ceph_tpu.analysis.rules.configrule import ConfigRegistryRule
 from ceph_tpu.analysis.rules.determinism import DeterminismRule
 from ceph_tpu.analysis.rules.device import DeviceDisciplineRule
 from ceph_tpu.analysis.rules.locks import LockOrderRule
+from ceph_tpu.analysis.rules.transfer import TransferRule
 from ceph_tpu.analysis.rules.wire import WireProtocolRule
 
 ALL_RULES = (
@@ -13,6 +14,7 @@ ALL_RULES = (
     WireProtocolRule,
     ConfigRegistryRule,
     DeterminismRule,
+    TransferRule,
 )
 
 #: rule-id -> one-line description (the catalog tools/lint.py prints)

@@ -15,7 +15,11 @@ into three checks:
   through ``pow2_bucket`` / ``bucket_lanes``.
 - ``device-sync-under-lock`` — no ``block_until_ready`` / ``device_put``
   while a lock is held: a device sync (worse, a compile) under a lock
-  serializes every other thread behind XLA.
+  serializes every other thread behind XLA.  Calls under the lock are
+  resolved through the project call graph
+  (:mod:`ceph_tpu.analysis.dataflow`), so a helper that syncs three
+  frames below the critical section is caught too, with the chain
+  named in the finding.
 """
 
 from __future__ import annotations
@@ -155,14 +159,18 @@ class DeviceDisciplineRule(Rule):
                 ))
 
         # -- device-raw-shape / device-sync-under-lock ------------------
+        from ceph_tpu.analysis.dataflow import engine_for
+
+        engine = engine_for(project)
         for sf in project.files:
             in_io_path = sf.module in roots
-            findings.extend(_scan_module(sf, in_io_path))
+            findings.extend(_scan_module(sf, in_io_path, engine))
         return findings
 
 
-def _scan_module(sf, in_io_path: bool) -> list[Finding]:
+def _scan_module(sf, in_io_path: bool, engine=None) -> list[Finding]:
     findings: list[Finding] = []
+    seen: set[tuple] = set()
 
     class V(ScopedVisitor):
         def __init__(self):
@@ -178,6 +186,39 @@ def _scan_module(sf, in_io_path: bool) -> list[Finding]:
 
         visit_AsyncWith = visit_With
 
+        def _check_callee_syncs(self, node, name: str) -> None:
+            """Call-graph pass: the callee (transitively, bounded
+            depth) forces a device sync while our lock is held."""
+            if engine is None:
+                return
+            caller = _enclosing(engine, sf.module, self.qualname)
+            if caller is None:
+                return
+            fid = engine.graph.resolve(caller, node)
+            if fid is None:
+                return
+            hit = engine.may_sync(fid)
+            if hit is None:
+                return
+            sync, chain = hit
+            callee = engine.graph.functions[fid]
+            via = " -> ".join(
+                f"{c}()" for c in (callee.name,) + tuple(
+                    x for x in chain if x != callee.name))
+            key = (sf.path, name, via)
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(Finding(
+                "device-sync-under-lock", SEV_ERROR, sf.path,
+                node.lineno,
+                f"call to {name}() while holding a lock in "
+                f"{sf.module}:{self.qualname} — {via} forces a device "
+                f"sync (via the call graph); every waiter stalls "
+                f"behind XLA; move the launch outside the critical "
+                f"section",
+            ))
+
         def visit_Call(self, node):
             name = call_name(node)
             short = name.split(".")[-1] if name else None
@@ -190,6 +231,8 @@ def _scan_module(sf, in_io_path: bool) -> list[Finding]:
                     f"compile) under a lock stalls every waiter; move "
                     f"the launch outside the critical section",
                 ))
+            elif self.lock_depth and name is not None:
+                self._check_callee_syncs(node, name)
             if in_io_path and short in JIT_ENTRYPOINTS:
                 for arg in list(node.args) + [k.value for k in node.keywords]:
                     bad = _raw_dim(arg)
@@ -208,6 +251,20 @@ def _scan_module(sf, in_io_path: bool) -> list[Finding]:
 
     V().visit(sf.tree)
     return findings
+
+
+def _enclosing(engine, module: str, qualname: str):
+    """FunctionInfo for the visitor's scope chain (longest known def
+    prefix), shared shape with rules/locks.py."""
+    if qualname == "<module>":
+        return None
+    parts = qualname.split(".")
+    for end in range(len(parts), 0, -1):
+        fid = f"{module}:{'.'.join(parts[:end])}"
+        fn = engine.graph.functions.get(fid)
+        if fn is not None:
+            return fn
+    return None
 
 
 def _raw_dim(arg: ast.AST) -> ast.AST | None:

@@ -6,15 +6,24 @@
   the interleave fuzzer can only find by luck.
 - ``lock-blocking`` — no blocking call (sleep, fsync, subprocess,
   socket send, dynamic import, store commit) while a lock is held.
-  One level of same-module call inlining is applied, so a method that
-  takes a lock and then calls a sibling that blocks is still caught.
+  Calls under a lock are resolved through the project call graph
+  (:mod:`ceph_tpu.analysis.dataflow`: ``self.method``, module
+  functions, imported functions, class methods across modules), so a
+  method that takes a lock and then calls a helper three frames away
+  that blocks is still caught — the chain that blocks is named in the
+  finding.  Depth is bounded by the engine's
+  ``CEPH_TPU_CTLINT_TRANSFER_MAX_DEPTH`` rounds; deeper chains widen
+  to "not proven" rather than slowing the lint down.
 """
 
 from __future__ import annotations
 
-import ast
-
 from ceph_tpu.analysis.core import SEV_ERROR, SEV_WARNING, Finding, Project, Rule
+from ceph_tpu.analysis.dataflow import (
+    BLOCKING_CALLS,
+    BLOCKING_METHODS,
+    engine_for,
+)
 from ceph_tpu.analysis.rules.common import (
     ScopedVisitor,
     call_name,
@@ -22,25 +31,10 @@ from ceph_tpu.analysis.rules.common import (
     lock_ident,
 )
 
-#: dotted (or trailing) call names that block the calling thread
-_BLOCKING = {
-    "time.sleep": "sleeps",
-    "os.fsync": "does disk I/O (fsync)",
-    "os.fdatasync": "does disk I/O (fdatasync)",
-    "subprocess.run": "spawns a process",
-    "subprocess.check_call": "spawns a process",
-    "subprocess.check_output": "spawns a process",
-    "subprocess.Popen": "spawns a process",
-    "importlib.import_module": "does a dynamic import (module-level "
-                               "code + disk I/O)",
-    "socket.create_connection": "does network I/O",
-}
-#: method names that block regardless of receiver
-_BLOCKING_METHODS = {
-    "sendall": "does network I/O",
-    "apply_transaction": "commits to the store",
-    "queue_transaction": "commits to the store",
-}
+# the seed sets live in dataflow (shared with the summary pass); kept
+# importable here for back-compat with older rule consumers
+_BLOCKING = BLOCKING_CALLS
+_BLOCKING_METHODS = BLOCKING_METHODS
 
 
 def _blocking_reason(name: str | None) -> str | None:
@@ -48,18 +42,17 @@ def _blocking_reason(name: str | None) -> str | None:
         return None
     if name in _BLOCKING:
         return _BLOCKING[name]
-    short = name.split(".")[-1]
     # match dotted suffixes like self._sock.sendall
     for dotted, why in _BLOCKING.items():
         if name.endswith("." + dotted):
             return why
-    return _BLOCKING_METHODS.get(short)
+    return _BLOCKING_METHODS.get(name.split(".")[-1])
 
 
 class _LockVisitor(ScopedVisitor):
     """Per-module pass: collects acquisition-order edges, blocking
-    calls under locks, and (for the inlining pass) which functions
-    block or lock internally."""
+    calls under locks, and every call made under a held lock (for the
+    call-graph resolution pass)."""
 
     def __init__(self, sf):
         super().__init__()
@@ -67,13 +60,9 @@ class _LockVisitor(ScopedVisitor):
         self.held: list[tuple[str, int]] = []   # (lock ident, line)
         self.edges: list[tuple[str, str, str, int]] = []  # a, b, path, line
         self.blocking: list[tuple[str, int, str]] = []
-        #: qualname -> (reason, line) for defs that block unconditionally
-        self.fn_blocks: dict[str, tuple[str, int]] = {}
-        #: qualname -> lock idents the def acquires
-        self.fn_locks: dict[str, list[tuple[str, int]]] = {}
-        #: calls made under a held lock: (callee short name, line,
-        #: holder qualname) — resolved against fn_blocks/fn_locks later
-        self.calls_under_lock: list[tuple[str, int]] = []
+        #: calls made under a held lock, for interprocedural
+        #: resolution: (call node, display name, line, holder qualname)
+        self.calls_under_lock: list[tuple] = []
 
     def _enter_locks(self, node) -> int:
         n = 0
@@ -108,13 +97,9 @@ class _LockVisitor(ScopedVisitor):
             reason = _blocking_reason(name)
             if reason is not None:
                 self.blocking.append((name, node.lineno, reason))
-            elif name and name.startswith("self."):
-                self.calls_under_lock.append((short, node.lineno))
-        else:
-            reason = _blocking_reason(name)
-            if reason is not None and self.scope:
-                self.fn_blocks.setdefault(
-                    self.scope[-1], (reason, node.lineno))
+            elif name is not None:
+                self.calls_under_lock.append(
+                    (node, name, node.lineno, self.qualname))
         self.generic_visit(node)
 
 
@@ -127,18 +112,18 @@ class LockOrderRule(Rule):
             "(potential deadlock)",
         "lock-blocking":
             "blocking call (sleep/fsync/subprocess/import/commit) "
-            "while holding a lock",
+            "while holding a lock — directly or via the call graph",
     }
 
     def run(self, project: Project) -> list[Finding]:
         findings: list[Finding] = []
+        engine = engine_for(project)
         edges: dict[str, set[str]] = {}
         edge_at: dict[tuple[str, str], tuple[str, int]] = {}
-        visitors = []
+        by_module = {sf.module: sf for sf in project.files}
         for sf in project.files:
             v = _LockVisitor(sf)
             v.visit(sf.tree)
-            visitors.append(v)
             for a, b, path, line in v.edges:
                 if a == b:
                     continue  # re-entrant nesting of one lock: RLock
@@ -151,18 +136,34 @@ class LockOrderRule(Rule):
                     f"other acquirer stalls behind it; shrink the "
                     f"critical section",
                 ))
-            # one-level inlining: self.<m>() under a lock where <m>
-            # blocks in its own body (same module)
-            for short, line in v.calls_under_lock:
-                hit = v.fn_blocks.get(short)
-                if hit is not None:
-                    reason, _ = hit
-                    findings.append(Finding(
-                        "lock-blocking", SEV_WARNING, sf.path, line,
-                        f"call to self.{short}() under a held lock — "
-                        f"{short}() {reason} (defined in this module); "
-                        f"the lock is held across that",
-                    ))
+            # call-graph pass: a call under a lock whose resolved
+            # callee (transitively, bounded depth) blocks
+            seen: set[tuple] = set()
+            for node, name, line, holder in v.calls_under_lock:
+                caller = self._enclosing(engine, sf.module, holder)
+                if caller is None:
+                    continue
+                fid = engine.graph.resolve(caller, node)
+                if fid is None:
+                    continue
+                hit = engine.may_block(fid)
+                if hit is None:
+                    continue
+                reason, chain = hit
+                callee = engine.graph.functions[fid]
+                via = " -> ".join(
+                    f"{c}()" for c in (callee.name,) + tuple(
+                        x for x in chain if x != callee.name))
+                key = ("lock-blocking", sf.path, name, via)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    "lock-blocking", SEV_WARNING, sf.path, line,
+                    f"call to {name}() under a held lock — {via} "
+                    f"{reason} (via the call graph); the lock is held "
+                    f"across that",
+                ))
 
         for cycle in _cycles(edges):
             a, b = cycle[0], cycle[1 % len(cycle)]
@@ -171,7 +172,22 @@ class LockOrderRule(Rule):
                 "lock-cycle", SEV_ERROR, path, line,
                 "lock-order cycle: " + " -> ".join(cycle + [cycle[0]]),
             ))
+        _ = by_module
         return findings
+
+    @staticmethod
+    def _enclosing(engine, module: str, qualname: str):
+        """FunctionInfo whose qualname matches the visitor scope chain
+        (longest prefix of the scope that is a known def)."""
+        if qualname == "<module>":
+            return None
+        parts = qualname.split(".")
+        for end in range(len(parts), 0, -1):
+            fid = f"{module}:{'.'.join(parts[:end])}"
+            fn = engine.graph.functions.get(fid)
+            if fn is not None:
+                return fn
+        return None
 
 
 def _cycles(edges: dict[str, set[str]]) -> list[list[str]]:

@@ -212,16 +212,17 @@ def check_scrub_reports(reports: list[dict]) -> list[dict]:
 
 
 def check_cold_launches(before: dict, after: dict) -> list[dict]:
-    """``before``/``after``: {batcher_name: cold_launches count}
-    snapshots around the run; any growth means chaos minted an XLA
-    compile inside the I/O path."""
+    """``before``/``after``: {counter_name: count} snapshots around
+    the run (per-batcher cold_launches plus the transfer guard's
+    host_transfers); any growth means chaos minted an XLA compile —
+    or an implicit host<->device transfer — inside the I/O path."""
     out: list[dict] = []
     for name, b in before.items():
         a = after.get(name, b)
         if a > b:
             out.append({
                 "invariant": "cold_launch", "batcher": name,
-                "detail": f"cold_launches grew {b} -> {a} during chaos",
+                "detail": f"{name} grew {b} -> {a} during chaos",
             })
     return out
 

@@ -208,6 +208,7 @@ def _cold_launch_snapshot() -> dict:
     discipline — its prewarm at mgr start cancels the counter, so any
     growth here is a compile on the digest path."""
     from ceph_tpu.common.metrics import get_perf_counters
+    from ceph_tpu.common.transfer_guard import snapshot as tg_snapshot
     from ceph_tpu.parallel import decode_batcher, scrub_batcher
 
     return {
@@ -217,6 +218,11 @@ def _cold_launch_snapshot() -> dict:
             scrub_batcher.shared().stats.get("cold_launches", 0)),
         "mgr_analytics": int(get_perf_counters(
             "mgr_analytics").dump().get("cold_launches", 0)),
+        # the transfer guard's violation counter rides the same
+        # delta-checked snapshot: chaos that provokes an implicit
+        # host<->device transfer inside a guarded steady-state launch
+        # fails exactly like an in-path XLA compile would
+        "transfer_guard_host_transfers": tg_snapshot()["host_transfers"],
     }
 
 

@@ -364,6 +364,30 @@ declare(
            "seconds a completed progress event stays visible in "
            "`ceph progress` before the mgr progress module reaps it",
            min=0.0),
+    # -- transfer discipline (ctlint transfer rules + runtime guard,
+    # common/transfer_guard.py) ----------------------------------------
+    Option("osd_transfer_guard", str, "auto", LEVEL_ADVANCED,
+           "runtime host<->device transfer guard around steady-state "
+           "batched launches (decode/scrub/encode/analytics): auto = "
+           "arm after EC map-install warmup, on = armed immediately, "
+           "off = never; violations are counted in "
+           "BucketCounters('transfer_guard').host_transfers and "
+           "answered from the host fallback (the runtime twin of "
+           "ctlint's device-host-sink rule)",
+           enum=("auto", "on", "off")),
+    Option("osd_transfer_guard_window", float, 0.0, LEVEL_ADVANCED,
+           "seconds after EC warmup completes before the transfer "
+           "guard engages (grace window for straggling lazy "
+           "first-use uploads; 0 = immediately)", min=0.0),
+    Option("ctlint_transfer_max_depth", int, 6, LEVEL_DEV,
+           "interprocedural propagation depth of ctlint's dataflow "
+           "engine (summary fixpoint rounds; call chains deeper than "
+           "this widen to unknown) — consumed by the analyzer via "
+           "CEPH_TPU_CTLINT_TRANSFER_MAX_DEPTH", min=1),
+    Option("ctlint_transfer_max_states", int, 4096, LEVEL_DEV,
+           "per-function tainted-name cap in ctlint's dataflow "
+           "engine (widening valve) — consumed by the analyzer via "
+           "CEPH_TPU_CTLINT_TRANSFER_MAX_STATES", min=16),
 )
 
 

@@ -1037,5 +1037,10 @@ class BatchedRuleMapper:
         with _enable_x64(True):
             if self._jitted is None:
                 self._jitted = self._build()
-            vals, cnt = self._jitted(xs, rew)
-            return np.asarray(vals), np.asarray(cnt)
+            # explicit transfer discipline (ctlint device-host-sink):
+            # the two inputs ride one device_put each and the mapping
+            # result comes back in ONE device_get — the by-design host
+            # exit (placements feed the host-side OSDMap/peering code)
+            vals, cnt = self._jitted(
+                jax.device_put(xs), jax.device_put(rew))
+            return jax.device_get(vals), jax.device_get(cnt)

@@ -143,21 +143,23 @@ class MatrixErasureCode(ErasureCode):
         return gf_matmul(M, rows)
 
     def _apply_device(self, M: np.ndarray, rows: np.ndarray) -> np.ndarray:
-        import jax.numpy as jnp
+        import jax
 
         from ceph_tpu.ops.rs_kernels import BitmatrixCodec
 
         key = M.tobytes()
         bits = self._device_bits.get(key)
         if bits is None:
-            bits = jnp.asarray(gf_matrix_to_bitmatrix(M))
+            bits = jax.device_put(gf_matrix_to_bitmatrix(M))
             self._device_bits[key] = bits
             if len(self._device_bits) > DECODE_CACHE_SIZE:
                 self._device_bits.popitem(last=False)
         else:
             self._device_bits.move_to_end(key)
-        out = BitmatrixCodec._apply(bits, jnp.asarray(rows), None)
-        return np.asarray(out)
+        # explicit put/get pair: the per-op sync path's one upload and
+        # its one by-design host exit (chunks persist to the store)
+        out = BitmatrixCodec._apply(bits, jax.device_put(rows), None)
+        return jax.device_get(out)
 
     # -- encode --------------------------------------------------------------
 

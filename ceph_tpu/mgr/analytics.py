@@ -241,6 +241,9 @@ class AnalyticsEngine:
             import contextlib
 
             from ceph_tpu.common.tracing import device_tracer
+            from ceph_tpu.common.transfer_guard import (
+                no_implicit_transfers,
+            )
 
             # device-launch profiling span on real digest passes only
             # (prewarm's compile is intentional, not a launch to study)
@@ -250,11 +253,20 @@ class AnalyticsEngine:
                     shape=str(self.shape))
                 if count_cold else contextlib.nullcontext()
             )
-            with span_cm:
-                out = self._jit(values.astype(np.int64),
-                                valid.astype(bool),
-                                cursor.astype(np.int64))
-                out = [np.asarray(jax.block_until_ready(a)) for a in out]
+            # transfers are explicit: the three store-snapshot arrays
+            # ride ONE device_put each (they used to slide into the
+            # jitted digest as raw numpy — an implicit h2d per array
+            # per tick, flagged by ctlint's transfer rules and
+            # disallowed under the runtime guard), and the six digest
+            # outputs come back in ONE device_get (the by-design host
+            # exit: the digest is consumed host-side by the mon/mgr
+            # report plane)
+            with span_cm, no_implicit_transfers("mgr_analytics"):
+                out = self._jit(
+                    jax.device_put(values.astype(np.int64)),
+                    jax.device_put(valid.astype(bool)),
+                    jax.device_put(cursor.astype(np.int64)))
+                out = jax.device_get(jax.block_until_ready(list(out)))
         pct, nsamples, ewma, mean_scaled, cnt, outlier = out
         return {
             "percentiles": pct, "n_samples": nsamples,

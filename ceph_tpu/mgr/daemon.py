@@ -252,8 +252,20 @@ class MgrDaemon:
         # prewarm the analytics shape NOW (off the loop): the digest
         # path must never compile — cold_launches stays 0 for the
         # daemon's whole life (the decode/scrub batcher discipline)
+        def _warm_then_guard() -> None:
+            self.engine.prewarm()
+            # steady state starts here: arm the transfer guard so any
+            # implicit host<->device transfer on a later digest pass
+            # is counted (host_transfers) + answered from the numpy
+            # fallback — the runtime twin of ctlint's transfer rules
+            mode = self.conf["osd_transfer_guard"]
+            if mode != "off":
+                from ceph_tpu.common.transfer_guard import configure
+
+                configure(mode, self.conf["osd_transfer_guard_window"])
+
         self._warm_task = asyncio.ensure_future(
-            asyncio.to_thread(self.engine.prewarm))
+            asyncio.to_thread(_warm_then_guard))
         await self._mon_hunt()
         self.clog.start()
         self._beacon_task = asyncio.ensure_future(self._beacon_loop())

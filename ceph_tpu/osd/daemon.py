@@ -984,6 +984,16 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                     log.exception(
                         "osd.%d: EC warmup for profile %r failed",
                         self.id, name)
+            # every profile's ladder is compiled: the steady state
+            # starts here, so arm the runtime transfer guard (the
+            # twin of ctlint's transfer rules) — any implicit
+            # host<->device transfer on a later decode/scrub/encode
+            # launch is counted + answered from the host fallback
+            mode = self.conf["osd_transfer_guard"]
+            if mode != "off":
+                from ceph_tpu.common.transfer_guard import configure
+
+                configure(mode, self.conf["osd_transfer_guard_window"])
 
         task = asyncio.ensure_future(asyncio.to_thread(_warm))
         self._warm_tasks.add(task)

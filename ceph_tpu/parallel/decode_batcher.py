@@ -197,7 +197,6 @@ class DecodeAggregator:
         bucket, max_batch lanes); returns per-request outputs in
         request order."""
         import jax
-        import jax.numpy as jnp
 
         from ceph_tpu.ops.rs_kernels import gf_bitmatmul
 
@@ -230,14 +229,23 @@ class DecodeAggregator:
                 # occupancy and block-until-ready time, per launch —
                 # padding waste becomes visible in `ceph trace`/mgr
                 from ceph_tpu.common.tracing import device_tracer
+                from ceph_tpu.common.transfer_guard import (
+                    no_implicit_transfers,
+                )
 
+                # transfers are EXPLICIT by construction: device_put
+                # uploads the padded batch, device_get gathers the
+                # whole launch result once (the by-design host exit —
+                # rebuilt shards persist to the store); the guard
+                # turns any implicit transfer sneaking in between
+                # into a counted violation + host fallback
                 with device_tracer().span(
                     "xla_launch", stage="device", kind="decode_batch",
                     w=w, b=b, b_real=b_real,
                     occupancy=round(b_real / b, 3), cold=cold,
-                ) as _dsp:
-                    out = np.asarray(jax.block_until_ready(
-                        gf_bitmatmul(bits, jnp.asarray(batch))))
+                ) as _dsp, no_implicit_transfers("decode_batch"):
+                    out = jax.device_get(jax.block_until_ready(
+                        gf_bitmatmul(bits, jax.device_put(batch))))
                 self.stats["launches"] += 1
                 self.stats["batched_requests"] += b_real
                 self.metrics.inc("launches", w=w, b=b)

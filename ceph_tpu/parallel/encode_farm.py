@@ -50,6 +50,29 @@ def shard_map(f=None, **kw):
     return _shard_map_fn(f, **kw)
 
 
+# -- input shardings --------------------------------------------------------
+#
+# Callers must device_put operands with THESE shardings (ctlint's
+# transfer discipline: explicit, correctly-placed uploads — an
+# unsharded put costs a reshard hop on every dispatch, and compiled
+# executables are keyed by input sharding, so prewarm and dispatch
+# must agree).  Single-homed here, beside the in_specs they mirror.
+
+def dp_batch_sharding(mesh: Mesh, axis="pg") -> NamedSharding:
+    """Sharding for :func:`batch_encode_dp`'s (B, k, S) stripe batch."""
+    return NamedSharding(mesh, P(axis, None, None))
+
+
+def tp_data_sharding(mesh: Mesh, axis: str = "shard") -> NamedSharding:
+    """Sharding for :func:`sharded_encode_tp`'s (k, S) chunk rows."""
+    return NamedSharding(mesh, P(axis, None))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Full replication (the bit-matrix operand of the dp path)."""
+    return NamedSharding(mesh, P())
+
+
 def batch_encode_dp(mesh: Mesh, bitmat: jax.Array, batch: jax.Array, axis: str = "pg"):
     """Encode a (B, k, S) stripe batch sharded over ``axis``; returns
     (B, m, S) parity with the same batch sharding."""

@@ -98,14 +98,24 @@ class EncodeService:
     # -- dispatch side -------------------------------------------------
 
     def _bits(self, M: np.ndarray):
-        import jax.numpy as jnp
+        import jax
 
         from ceph_tpu.ops.gf256 import gf_matrix_to_bitmatrix
 
         key = M.shape[0].to_bytes(2, "little") + M.tobytes()
         hit = self._bits_cache.get(key)
         if hit is None:
-            hit = jnp.asarray(gf_matrix_to_bitmatrix(M))
+            bits = gf_matrix_to_bitmatrix(M)
+            if self.mesh is not None:
+                # replicate across the mesh at cache-fill time so no
+                # launch pays a per-dispatch reshard of the matrix
+                from ceph_tpu.parallel.encode_farm import (
+                    replicated_sharding,
+                )
+
+                hit = jax.device_put(bits, replicated_sharding(self.mesh))
+            else:
+                hit = jax.device_put(bits)
             self._bits_cache[key] = hit
             if len(self._bits_cache) > _BITS_CACHE_SIZE:
                 self._bits_cache.popitem(last=False)
@@ -142,12 +152,23 @@ class EncodeService:
     def _run_group(self, group: list[tuple]) -> list[np.ndarray]:
         """Worker-thread body: one farm dispatch for the whole group;
         returns per-request outputs in order."""
-        import jax.numpy as jnp
+        import jax
 
         from ceph_tpu.parallel.encode_farm import (
             batch_encode_dp,
             sharded_encode_tp,
         )
+
+        # NOTE on guard coverage: the mesh (shard_map) dispatches below
+        # are NOT wrapped in no_implicit_transfers — XLA's multi-device
+        # execution path ships tiny internal scalar constants
+        # (observed: replicated uint8[] avals) host->device on every
+        # dispatch, which the guard cannot tell apart from real payload
+        # round-trips.  Payload transfers here are explicit and
+        # mesh-sharded at source (device_put with NamedSharding, no
+        # reshard hop); the single-device paths — where the
+        # batched-vs-host gap actually lives — run fully guarded
+        # (_run_group_single, decode/scrub batchers, mgr analytics).
 
         M = group[0][0]
         bits = self._bits(M)
@@ -168,9 +189,14 @@ class EncodeService:
                     padded[:, : rows.shape[1]] = rows
                 else:
                     padded = rows
+                from ceph_tpu.parallel.encode_farm import (
+                    tp_data_sharding,
+                )
+
                 with self._note_shape(("tp", bits.shape, k, S), w=S):
-                    out = np.asarray(sharded_encode_tp(
-                        self.mesh, bits, jnp.asarray(padded)))
+                    out = jax.device_get(sharded_encode_tp(
+                        self.mesh, bits, jax.device_put(
+                            padded, tp_data_sharding(self.mesh))))
                 self.stats["tp_dispatches"] += 1
                 self.metrics.inc("launches", w=S)
                 return [np.ascontiguousarray(out[:, : rows.shape[1]])]
@@ -190,10 +216,14 @@ class EncodeService:
         for i, (_, rows, _) in enumerate(group):
             batch[i, :, : rows.shape[1]] = rows
         axes = tuple(a for a in ("pg", "shard") if a in self.mesh.shape)
+        from ceph_tpu.parallel.encode_farm import dp_batch_sharding
+
         with self._note_shape(("dp", bits.shape, B, k, S), w=S, b=B,
                               b_real=len(group)):
-            out = np.asarray(batch_encode_dp(
-                self.mesh, bits, jnp.asarray(batch), axis=axes))
+            out = jax.device_get(batch_encode_dp(
+                self.mesh, bits, jax.device_put(
+                    batch, dp_batch_sharding(self.mesh, axes)),
+                axis=axes))
         self.stats["dp_dispatches"] += 1
         self.stats["coalesced"] += len(group)
         self.metrics.inc("launches", w=S, b=B)
@@ -230,8 +260,9 @@ class EncodeService:
         along S (column-independent GF matmul), pad to a power-of-two
         width so jit shapes stay bounded, ONE kernel launch for the
         whole window."""
-        import jax.numpy as jnp
+        import jax
 
+        from ceph_tpu.common.transfer_guard import no_implicit_transfers
         from ceph_tpu.ops.rs_kernels import BitmatrixCodec
 
         widths = [rows.shape[1] for _, rows, _ in group]
@@ -243,9 +274,10 @@ class EncodeService:
             big[:, off:off + w] = rows
             off += w
         with self._note_shape(("single", bits.shape, k, S), w=S,
-                              b_real=len(group)):
-            out = np.asarray(BitmatrixCodec._apply(
-                bits, jnp.asarray(big), None))
+                              b_real=len(group)), \
+                no_implicit_transfers("encode_single"):
+            out = jax.device_get(BitmatrixCodec._apply(
+                bits, jax.device_put(big), None))
         self.stats["single_dispatches"] += 1
         self.stats["coalesced"] += len(group)
         self.metrics.inc("launches", w=S)
@@ -287,6 +319,8 @@ class EncodeService:
                 f <<= 1
         n = 0
         if self.mesh is not None:
+            from ceph_tpu.parallel.encode_farm import dp_batch_sharding
+
             ndev = 1
             for ax in self.mesh.shape.values():
                 ndev *= ax
@@ -296,6 +330,9 @@ class EncodeService:
                 ndev * pow2_bucket(-(-g // ndev), 1)
                 for g in range(1, coalesce + 1)
             })
+            # warm with the SAME input shardings the dispatch path
+            # uses (executables are keyed by sharding, not just shape)
+            dp_spec = dp_batch_sharding(self.mesh, axes)
             for S in sorted(pow2_bucket(w, 1) for w in widths):
                 for B in bbs:
                     key = ("dp", bits.shape, B, k, S)
@@ -303,19 +340,26 @@ class EncodeService:
                         continue
                     jax.block_until_ready(batch_encode_dp(
                         self.mesh, bits,
-                        jnp.zeros((B, k, S), np.uint8), axis=axes))
+                        jax.device_put(
+                            np.zeros((B, k, S), np.uint8), dp_spec),
+                        axis=axes))
                     self._warm.add(key)
                     n += 1
             nsh = self.mesh.shape.get("shard", 1)
             if nsh > 1 and k % nsh == 0:
-                from ceph_tpu.parallel.encode_farm import sharded_encode_tp
+                from ceph_tpu.parallel.encode_farm import (
+                    sharded_encode_tp,
+                    tp_data_sharding,
+                )
 
+                tp_spec = tp_data_sharding(self.mesh)
                 for S in sorted(pow2_bucket(w, 1) for w in widths):
                     key = ("tp", bits.shape, k, S)
                     if key in self._warm:
                         continue
                     jax.block_until_ready(sharded_encode_tp(
-                        self.mesh, bits, jnp.zeros((k, S), np.uint8)))
+                        self.mesh, bits, jax.device_put(
+                            np.zeros((k, S), np.uint8), tp_spec)))
                     self._warm.add(key)
                     n += 1
         else:

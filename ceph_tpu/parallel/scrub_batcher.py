@@ -328,8 +328,8 @@ class ScrubVerifier:
         """Worker-thread body: batched crc32c launches over one bucket;
         returns each lane's raw device crc (L_W of the padded lane)."""
         import jax
-        import jax.numpy as jnp
 
+        from ceph_tpu.common.transfer_guard import no_implicit_transfers
         from ceph_tpu.ops.hashing import batched_crc32c_device
 
         mat = self._crc_mat(w)
@@ -343,12 +343,15 @@ class ScrubVerifier:
             batch = np.zeros((b, w), np.uint8)
             for j, (arr, width, _f) in enumerate(chunk):
                 batch[j, :width] = arr
+            # explicit put/get only: one upload of the lane batch, one
+            # (B,)-word gather of the crc contributions (the by-design
+            # host exit — crcs fold host-side via crc32c_zeros algebra)
             with self._note_launch(
                 ("crc", b, w), "crc", w, b, b_real,
                 sum(width for _, width, _ in chunk), b * w,
-            ):
-                out = np.asarray(jax.block_until_ready(
-                    batched_crc32c_device(mat, jnp.asarray(batch))))
+            ), no_implicit_transfers("scrub_crc"):
+                out = jax.device_get(jax.block_until_ready(
+                    batched_crc32c_device(mat, jax.device_put(batch))))
             for j in range(b_real):
                 outs[at + j] = int(out[j])
         return outs
@@ -368,8 +371,8 @@ class ScrubVerifier:
         """Worker-thread body: batched re-encode-compare launches for
         one (profile, bucket); returns each item's (m,) mismatch mask."""
         import jax
-        import jax.numpy as jnp
 
+        from ceph_tpu.common.transfer_guard import no_implicit_transfers
         from ceph_tpu.ops.rs_kernels import gf_encode_compare
 
         C = group[0][0]
@@ -385,13 +388,16 @@ class ScrubVerifier:
             for j, (_C, d, p, _f) in enumerate(chunk):
                 data[j, :, :d.shape[1]] = d
                 parity[j, :, :p.shape[1]] = p
+            # explicit put/get only; the gather is the tiny (B, m)
+            # mismatch mask — parity itself never leaves the device
             with self._note_launch(
                 (bits.shape, b, k, w), "enc", w, b, b_real,
                 sum((k + m) * d.shape[1] for _C, d, _p, _f in chunk),
                 b * (k + m) * w,
-            ):
-                out = np.asarray(jax.block_until_ready(gf_encode_compare(
-                    bits, jnp.asarray(data), jnp.asarray(parity))))
+            ), no_implicit_transfers("scrub_enc"):
+                out = jax.device_get(jax.block_until_ready(
+                    gf_encode_compare(bits, jax.device_put(data),
+                                      jax.device_put(parity))))
             for j in range(b_real):
                 outs[at + j] = out[j]
         return outs

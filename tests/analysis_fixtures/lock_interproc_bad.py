@@ -1,0 +1,33 @@
+# ctlint fixture: blocking + device sync reached only THROUGH the
+# call graph (two frames below the lock — the one-level inliner of
+# ctlint v1 could not see either).  NEVER imported.
+import threading
+import time
+
+
+class Daemon:
+    def __init__(self):
+        self._map_lock = threading.Lock()
+
+    # -- lock-blocking via the call graph -----------------------------
+
+    def tick(self):
+        with self._map_lock:
+            self.refresh()
+
+    def refresh(self):
+        self.flush()
+
+    def flush(self):
+        time.sleep(0.1)
+
+    # -- device-sync-under-lock via the call graph --------------------
+
+    def launch_locked(self, out):
+        with self._map_lock:
+            self.finish(out)
+
+    def finish(self, out):
+        import jax
+
+        jax.block_until_ready(out)

@@ -1,0 +1,18 @@
+# ctlint fixture: the clean twin of transfer_bad.py — explicit
+# transfers only, declared donation, no device-steered control flow.
+# NEVER imported.
+import jax
+import jax.numpy as jnp
+
+from ceph_tpu.ops.rs_kernels import gf_bitmatmul, gf_bitmatmul_pallas_acc
+
+
+def launch(bits, batch, carry, seed):
+    # explicit put in; the result STAYS device-resident
+    out = gf_bitmatmul(bits, jax.device_put(batch))
+    # in-place update is fine: position 2 (carry) is declared in
+    # prewarm_registry.DONATED (input_output_aliases on the kernel)
+    carry = gf_bitmatmul_pallas_acc(bits, out, carry, seed, tile_s=512)
+    # predicates stay on device too
+    flag = jnp.any(carry)
+    return carry, flag

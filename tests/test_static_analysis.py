@@ -35,6 +35,7 @@ from ceph_tpu.analysis.rules.configrule import ConfigRegistryRule
 from ceph_tpu.analysis.rules.determinism import DeterminismRule
 from ceph_tpu.analysis.rules.device import DeviceDisciplineRule
 from ceph_tpu.analysis.rules.locks import LockOrderRule
+from ceph_tpu.analysis.rules.transfer import TransferRule
 from ceph_tpu.analysis.rules.wire import WireProtocolRule
 
 REPO = Path(__file__).resolve().parent.parent
@@ -118,6 +119,150 @@ class TestLockRule:
         assert rule_ids(proj, LockOrderRule()) == []
 
 
+class TestInterprocLockRules:
+    """Satellite: the lock rules see through the call graph — a helper
+    that blocks (or syncs) two frames below the critical section is
+    caught, where ctlint v1's one-level same-module inliner was blind."""
+
+    def test_blocking_two_frames_below_the_lock(self):
+        proj = fixture_project(
+            "lock_interproc_bad.py", "ceph_tpu/osd/_fixture_ip.py")
+        fs = run_analysis(REPO, rules=[LockOrderRule()], project=proj)
+        msgs = [f.message for f in fs if f.rule == "lock-blocking"]
+        assert any(
+            "via the call graph" in m and "flush()" in m
+            and "refresh()" in m for m in msgs), msgs
+
+    def test_sync_two_frames_below_the_lock(self):
+        proj = fixture_project(
+            "lock_interproc_bad.py", "ceph_tpu/osd/_fixture_ip.py")
+        fs = run_analysis(
+            REPO, rules=[DeviceDisciplineRule()], project=proj)
+        msgs = [f.message for f in fs
+                if f.rule == "device-sync-under-lock"]
+        assert any(
+            "via the call graph" in m and "finish()" in m
+            and "block_until_ready" in m for m in msgs), msgs
+
+    def test_ok_fixture_silent(self):
+        proj = fixture_project(
+            "lock_interproc_ok.py", "ceph_tpu/osd/_fixture_ip.py")
+        assert rule_ids(proj, LockOrderRule()) == []
+        assert "device-sync-under-lock" not in rule_ids(
+            proj, DeviceDisciplineRule())
+
+
+class TestTransferRule:
+    def test_bad_fixture_fires_all_four(self):
+        proj = fixture_project(
+            "transfer_bad.py", "ceph_tpu/parallel/_fixture_transfer.py")
+        ids = rule_ids(proj, TransferRule())
+        assert set(ids) == {
+            "device-host-sink", "device-redundant-put",
+            "device-nondonated-inout", "device-implicit-sync",
+        }, sorted(ids)
+
+    def test_interprocedural_sink_two_calls_away(self):
+        """The tentpole claim: a .tobytes() inside a helper fires at
+        the device-valued call site two frames above."""
+        proj = fixture_project(
+            "transfer_bad.py", "ceph_tpu/parallel/_fixture_transfer.py")
+        fs = run_analysis(REPO, rules=[TransferRule()], project=proj)
+        assert any(
+            f.rule == "device-host-sink" and "_persist()" in f.message
+            and ".tobytes()" in f.message for f in fs), [
+                f.message for f in fs]
+
+    def test_ok_fixture_silent(self):
+        proj = fixture_project(
+            "transfer_ok.py", "ceph_tpu/parallel/_fixture_transfer.py")
+        assert rule_ids(proj, TransferRule()) == []
+
+    def test_host_sink_scoped_to_io_path(self):
+        """The same violations OUTSIDE the I/O-path module set: the
+        local rules still fire but host-sink (an I/O-path budget rule)
+        stays quiet."""
+        proj = fixture_project(
+            "transfer_bad.py", "ceph_tpu/client/_fixture_transfer.py")
+        ids = rule_ids(proj, TransferRule())
+        assert "device-host-sink" not in ids
+        assert "device-implicit-sync" in ids
+        assert "device-redundant-put" in ids
+
+    def test_donated_entries_point_at_live_jit_sites(self):
+        """Every DONATED key must name a jit site that still exists
+        (the donation schema's own stale-entry check)."""
+        from ceph_tpu.analysis.prewarm_registry import DONATED
+        from ceph_tpu.analysis.rules.device import _JitSiteVisitor
+
+        proj = Project.load(REPO)
+        mods = proj.by_module()
+        for key in DONATED:
+            mod, qual = key.split(":")
+            assert mod in mods, key
+            v = _JitSiteVisitor()
+            v.visit(mods[mod].tree)
+            assert qual in {q for q, _ in v.sites}, key
+
+
+class TestDataflowEngine:
+    """Unit coverage of the interprocedural engine on tiny synthetic
+    projects (cross-module call resolution + summary propagation)."""
+
+    def _proj(self, **mods):
+        files = [
+            SourceFile(f"ceph_tpu/{name.replace('__', '/')}.py", text)
+            for name, text in mods.items()
+        ]
+        return Project(root=REPO, files=files, aux_files=[])
+
+    def test_cross_module_blocking_summary(self):
+        from ceph_tpu.analysis.dataflow import DataflowEngine
+
+        proj = self._proj(
+            x__a="import time\n\ndef slow():\n    time.sleep(1)\n",
+            x__b=("from ceph_tpu.x.a import slow\n\n"
+                  "def outer():\n    slow()\n"),
+        )
+        eng = DataflowEngine(proj)
+        hit = eng.may_block("ceph_tpu.x.b:outer")
+        assert hit is not None
+        reason, chain = hit
+        assert reason == "sleeps" and "slow" in chain
+
+    def test_device_summary_through_wrappers(self):
+        from ceph_tpu.analysis.dataflow import DataflowEngine
+
+        proj = self._proj(
+            x__c=("import jax\nimport jax.numpy as jnp\n\n"
+                  "@jax.jit\ndef k(x):\n    return x + 1\n\n"
+                  "def wrap(y):\n    return k(jnp.asarray(y))\n\n"
+                  "def fact():\n    @jax.jit\n"
+                  "    def kern(x):\n        return x\n    return kern\n\n"
+                  "def use(z):\n    return fact()(z)\n"),
+        )
+        eng = DataflowEngine(proj)
+        assert eng.summaries["ceph_tpu.x.c:wrap"].returns_device
+        assert eng.summaries["ceph_tpu.x.c:fact"].returns_device_fn
+        assert eng.summaries["ceph_tpu.x.c:use"].returns_device
+
+    def test_method_resolution_and_passthrough(self):
+        from ceph_tpu.analysis.dataflow import DataflowEngine
+
+        proj = self._proj(
+            x__d=("import jax.numpy as jnp\n\n"
+                  "def ident(v):\n    return v\n\n"
+                  "class Eng:\n"
+                  "    def make(self):\n"
+                  "        return jnp.zeros(4)\n"
+                  "    def get(self):\n"
+                  "        return ident(self.make())\n"),
+        )
+        eng = DataflowEngine(proj)
+        assert 0 in eng.summaries["ceph_tpu.x.d:ident"].passthrough
+        assert eng.summaries["ceph_tpu.x.d:Eng.get"].returns_device
+
+
 class TestWireRule:
     def test_bad_fixture(self):
         proj = fixture_project("wire_bad.py", "ceph_tpu/msg/_fixture.py")
@@ -184,6 +329,16 @@ class TestLiveTree:
                if not e.get("justification")
                or e["justification"].startswith("TODO")]
         assert not bad, f"baseline entries without justification: {bad}"
+
+    def test_baseline_integrity(self):
+        """No dead grandfather entries: every baselined (rule, file)
+        pair still exists in the catalog and the tree."""
+        from ceph_tpu.analysis.core import baseline_integrity
+
+        baseline = load_baseline(REPO / "ctlint_baseline.json")
+        rot = baseline_integrity(
+            baseline, Project.load(REPO), set(RULE_CATALOG))
+        assert rot == [], rot
 
     def test_catalog_covers_every_rule(self):
         for cls in ALL_RULES:

@@ -52,8 +52,25 @@ def main(argv=None) -> int:
         "--trace-only", action="store_true",
         help="print each (scenario, seed) trace hash and event list "
         "without touching a cluster (pure replay check)")
+    ap.add_argument(
+        "--lint", action="store_true",
+        help="ctlint preflight: abort the sweep unless tools/lint.py "
+        "is clean (no new findings, no stale/dead baseline entries) "
+        "— chaos evidence is only meaningful for a tree that honors "
+        "the static invariants it claims")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.lint:
+        import subprocess
+
+        lint = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "lint.py")
+        rc = subprocess.run([sys.executable, lint]).returncode
+        if rc != 0:
+            print(f"chaos_run: ctlint preflight failed (exit {rc}) — "
+                  f"fix/baseline findings before sweeping", file=sys.stderr)
+            return rc
 
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING,

@@ -30,11 +30,15 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO_ROOT))
 
 from ceph_tpu.analysis import (  # noqa: E402
+    Project,
     load_baseline,
     run_analysis,
     split_by_baseline,
 )
-from ceph_tpu.analysis.core import write_baseline  # noqa: E402
+from ceph_tpu.analysis.core import (  # noqa: E402
+    baseline_integrity,
+    write_baseline,
+)
 from ceph_tpu.analysis.rules import ALL_RULES, RULE_CATALOG  # noqa: E402
 
 BASELINE_PATH = REPO_ROOT / "ctlint_baseline.json"
@@ -68,7 +72,8 @@ def main(argv=None) -> int:
         return 0
 
     rules = [cls() for cls in ALL_RULES]
-    findings = run_analysis(args.root, rules=rules)
+    project = Project.load(args.root)
+    findings = run_analysis(args.root, rules=rules, project=project)
     if args.rule:
         findings = [
             f for f in findings
@@ -77,6 +82,9 @@ def main(argv=None) -> int:
 
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
     new, old, stale = split_by_baseline(findings, baseline)
+    # hard rot: baseline entries whose (rule, file) no longer exists —
+    # the stale-baseline preflight chaos/bench runs gate on
+    rot = baseline_integrity(baseline, project, set(RULE_CATALOG))
 
     if args.update_baseline:
         write_baseline(args.baseline, findings, baseline)
@@ -91,6 +99,20 @@ def main(argv=None) -> int:
             "new": [f.to_json() for f in new],
             "baselined": [f.to_json() for f in old],
             "stale_baseline": [list(k) for k in stale],
+            "baseline_rot": [
+                {"rule": k[0], "file": k[1], "message": k[2],
+                 "reason": why} for k, why in rot
+            ],
+            "catalog": dict(sorted(RULE_CATALOG.items())),
+            "summary": {
+                "files": len(project.files),
+                "rules": sorted(
+                    rid for cls in ALL_RULES for rid in cls.rules),
+                "findings": len(findings),
+                "new": len(new),
+                "baselined": len(old),
+                "stale": len(stale),
+            },
         }, indent=2))
     else:
         for f in new:
@@ -102,12 +124,14 @@ def main(argv=None) -> int:
         for k in stale:
             print(f"-- stale baseline entry (no longer fires): "
                   f"[{k[0]}] {k[1]}: {k[2]}")
-        if not new and not stale:
+        for k, why in rot:
+            print(f"-- dead baseline entry ({why}): [{k[0]}] {k[1]}")
+        if not new and not stale and not rot:
             print(f"ctlint clean: {len(findings)} finding"
                   f"{'s' if len(findings) != 1 else ''}, all baselined")
     if new:
         return 1
-    if stale:
+    if stale or rot:
         return 2
     return 0
 
