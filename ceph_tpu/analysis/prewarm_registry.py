@@ -113,4 +113,10 @@ ANALYTICS_COLUMNS: tuple[str, ...] = (
     # same ONE-launch digest
     "pgs_degraded",
     "pgs_misplaced",
+    # load-harness column (loadgen/driver.py): the driver's interval-
+    # mean op latency, ingested from its loadgen.* MgrClient session
+    # and served back via `mgr digest` for the client-vs-mgr
+    # cross-check — slot-reserved so transient metrics can never
+    # overflow-drop the series the check depends on
+    "load_lat_us",
 )

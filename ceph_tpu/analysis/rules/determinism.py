@@ -7,7 +7,9 @@ purity: the wall clock, the shared ``random`` module state, and
 iteration order over unordered sets (hash-randomized for str-keyed
 content, and a refactor hazard even for ints).
 
-Scope: ``ceph_tpu/chaos/schedule.py`` plus any module carrying a
+Scope: ``ceph_tpu/chaos/schedule.py`` and
+``ceph_tpu/loadgen/schedule.py`` (their committed trace hashes carry
+the same purity contract) plus any module carrying a
 ``# ctlint: pure-trace`` marker.
 
 - ``det-wallclock`` — ``time.time()``/``monotonic()``/
@@ -25,7 +27,10 @@ import ast
 from ceph_tpu.analysis.core import SEV_ERROR, Finding, Project, Rule
 from ceph_tpu.analysis.rules.common import attr_chain, call_name, last_name
 
-PURE_TRACE_PATHS = ("ceph_tpu/chaos/schedule.py",)
+PURE_TRACE_PATHS = (
+    "ceph_tpu/chaos/schedule.py",
+    "ceph_tpu/loadgen/schedule.py",
+)
 
 _WALLCLOCK = {
     "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",

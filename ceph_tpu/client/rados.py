@@ -82,12 +82,16 @@ class RadosClient:
     def __init__(self, client_id: int | None = None, auth=None,
                  handshake_timeout: float | None = None,
                  op_timeout: float = 120.0,
-                 trace_sample_rate: float = 1.0):
+                 trace_sample_rate: float = 1.0, conf=None):
+        from ceph_tpu.common import ConfigProxy
+
         self.id = client_id if client_id is not None else (os.getpid() << 8) | 1
         # per-op wall-clock budget across ALL resends (librados
         # rados_osd_op_timeout role): an op that can't complete within
         # it raises ETIMEDOUT instead of spinning through retries
         self.op_timeout = op_timeout
+        # client-side option view (objecter window sizes, batch caps)
+        self.conf = conf if conf is not None else ConfigProxy()
         _mkw = {}
         if handshake_timeout is not None:
             _mkw["handshake_timeout"] = handshake_timeout
@@ -112,6 +116,12 @@ class RadosClient:
         # watch registrations: cookie -> callback(notify_id, payload)
         # -> optional reply bytes (librados watch2/notify2)
         self._watches: dict[int, object] = {}
+        # the async submission engine (client/objecter.py): EVERY op —
+        # serial convenience calls included — rides it, so resends,
+        # map waits and timeout accounting are per-op by construction
+        from ceph_tpu.client.objecter import Objecter
+
+        self.objecter = Objecter(self)
 
     async def connect(self, mon_host: str, mon_port: int) -> None:
         await self.connect_multi([(mon_host, mon_port)])
@@ -160,6 +170,7 @@ class RadosClient:
         t = getattr(self, "_hunt_task", None)
         if t:
             t.cancel()
+        await self.objecter.shutdown()
         await self.messenger.shutdown()
 
     async def _on_reset(self, conn) -> None:
@@ -393,93 +404,20 @@ class RadosClient:
         await asyncio.sleep(cap * (0.5 + random.random() / 2))
 
     async def _submit(self, pool_id: int, op: MOSDOp) -> MOSDOpReply:
-        """op_submit/_calc_target/resend loop, under a client_op root
-        span whose TraceContext rides every (re)send — one client op,
-        one cluster-wide trace."""
-        if op.is_write() and not op.reqid:
-            # stable across resends (osd_reqid_t): the OSD deduplicates
-            # a retried non-idempotent op (append, compound vector) by
-            # this id instead of re-applying it
-            op.reqid = f"client.{self.id}:{next(self._tids)}"
-        with self.tracer.span(
-            "client_op", oid=op.oid, pool=pool_id,
-            write=op.is_write(), reqid=op.reqid or f"tid:{op.tid}",
-        ) as root:
-            op.trace = self.tracer.ctx_for(root)
-            reply = await self._submit_inner(pool_id, op)
-            root.tag(result=reply.result)
-            return reply
+        """Serial convenience path: submit through the objecter and
+        wait.  The engine owns op_submit/_calc_target/the resend loop
+        and the client_op root span (one client op, one cluster-wide
+        trace); timeout/backoff accounting is per-op there, so a slow
+        op can never charge a neighbor's deadline."""
+        comp = await self.objecter.submit(pool_id, op)
+        return await comp.wait()
 
-    async def _submit_inner(self, pool_id: int, op: MOSDOp) -> MOSDOpReply:
-        last_err = errno.EIO
-        loop = asyncio.get_running_loop()
-        deadline = loop.time() + self.op_timeout
-        for _try in range(MAX_RETRIES):
-            if loop.time() >= deadline:
-                raise RadosError(
-                    errno.ETIMEDOUT,
-                    f"op {op.oid!r} timed out after {self.op_timeout}s"
-                    f" ({_try} sends)")
-            om = self.osdmap
-            pool = om.get_pg_pool(pool_id)
-            if pool is None:
-                raise RadosError(errno.ENOENT, f"pool {pool_id} vanished")
-            # cache-tier overlay redirect (Objecter::_calc_target,
-            # src/osdc/Objecter.cc:2783 read_tier/write_tier): ops on
-            # an overlaid base pool target the cache pool instead
-            tier = pool.extra.get(
-                "write_tier" if op.is_write() else "read_tier")
-            if tier is not None:
-                tpool = om.get_pg_pool(int(tier))
-                if tpool is not None:
-                    pool = tpool
-            # unconditional: a retry after an overlay CHANGE must
-            # re-home to wherever this map says, not keep a stale
-            # redirect from the previous attempt
-            op.pool = pool.id
-            pg = object_to_pg(pool, op.oid)
-            _, _, _, primary = om.pg_to_up_acting_osds(pg)
-            if primary < 0:
-                await self._wait_new_map(om.epoch)
-                continue
-            addr = om.osd_addrs.get(primary)
-            if addr is None:
-                await self._wait_new_map(om.epoch)
-                continue
-            op.tid = next(self._tids)
-            op.epoch = om.epoch
-            fut: asyncio.Future = asyncio.get_running_loop().create_future()
-            self._op_waiters[op.tid] = fut
-            try:
-                conn = await self.messenger.connect_to(("osd", primary), *addr)
-                await conn.send_message(op)
-                reply: MOSDOpReply = await asyncio.wait_for(
-                    fut, min(OP_TIMEOUT, max(0.5, deadline - loop.time())))
-            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
-                log.debug("client: op to osd.%d failed (%r), waiting for map", primary, e)
-                await self._wait_new_map(om.epoch)
-                if self.osdmap is not None and self.osdmap.epoch <= om.epoch:
-                    # no newer map either (e.g. primary dead but not
-                    # yet reported): back off instead of hammering the
-                    # same dead address in a tight loop
-                    await self._backoff(_try)
-                last_err = errno.EIO
-                continue
-            finally:
-                self._op_waiters.pop(op.tid, None)
-            if reply.result == -errno.EAGAIN:
-                # peer had a different map — or a transiently busy
-                # object (recovery/reconcile in flight).  When the map
-                # is NOT newer the wait returns immediately, so back
-                # off (with jitter) or the retry budget burns in
-                # milliseconds while the cluster converges.
-                await self._wait_new_map(min(om.epoch, reply.epoch - 1))
-                if self.osdmap.epoch <= om.epoch:
-                    await self._backoff(_try)
-                last_err = errno.EAGAIN
-                continue
-            return reply
-        raise RadosError(last_err, f"op {op.oid!r} failed after {MAX_RETRIES} tries")
+    async def aio_submit(self, pool_id: int, op: MOSDOp):
+        """Async path (librados aio_operate): returns a
+        :class:`~ceph_tpu.client.objecter.Completion` once the op is
+        admitted through the in-flight window — admission is the
+        backpressure seam (objecter_inflight_ops/_op_bytes)."""
+        return await self.objecter.submit(pool_id, op)
 
 
 class ObjectOperation:
@@ -605,6 +543,10 @@ class IoCtx:
         self.snap_seq: int = 0
         self.snaps: list[int] = []
         self.read_snap: int = NOSNAP
+        # dmclock tenant tag stamped on every op from this handle (''
+        # = the OSD's built-in client class); the load harness sets it
+        # per simulated tenant to exercise mClock differentiation
+        self.qos_class: str = ""
 
     def dup(self) -> "IoCtx":
         """An independent handle on the same pool (librados ioctx
@@ -613,6 +555,7 @@ class IoCtx:
         io = IoCtx(self.client, self.pool_id)
         io.snap_seq, io.snaps = self.snap_seq, list(self.snaps)
         io.read_snap = self.read_snap
+        io.qos_class = self.qos_class
         return io
 
     def set_snap_context(self, seq: int, snaps: list[int]) -> None:
@@ -652,6 +595,7 @@ class IoCtx:
         m = MOSDOp(pool=self.pool_id, oid=oid, **kw)
         m.snap_seq, m.snaps = self.snap_seq, list(self.snaps)
         m.snapid = self.read_snap
+        m.qos_class = self.qos_class
         return m
 
     async def _op1(self, oid: str, what: str, **kw) -> MOSDOpReply:
@@ -668,6 +612,40 @@ class IoCtx:
         if reply.result != 0:
             raise RadosError(-reply.result, f"operate {oid!r}")
         return reply
+
+    # -- async I/O (librados aio_*): completions, not round trips ------
+
+    async def aio_operate(self, oid: str, op: ObjectOperation):
+        """Submit a compound vector without waiting for the reply:
+        returns a Completion (await ``.wait()`` or attach callbacks).
+        The call itself only blocks when the objecter's in-flight
+        window is full — the backpressure contract."""
+        return await self.client.aio_submit(
+            self.pool_id, self._msg(oid, ops=list(op.ops)))
+
+    async def aio_write_full(self, oid: str, data: bytes):
+        return await self.client.aio_submit(self.pool_id, self._msg(
+            oid, op=OP_WRITE_FULL, data=bytes(data)))
+
+    async def aio_write(self, oid: str, data: bytes, off: int):
+        return await self.client.aio_submit(self.pool_id, self._msg(
+            oid, op=OP_WRITE, off=off, data=bytes(data)))
+
+    async def aio_append(self, oid: str, data: bytes):
+        return await self.client.aio_submit(self.pool_id, self._msg(
+            oid, op=OP_APPEND, data=bytes(data)))
+
+    async def aio_read(self, oid: str, off: int = 0, length: int = 0):
+        return await self.client.aio_submit(self.pool_id, self._msg(
+            oid, op=OP_READ, off=off, length=length))
+
+    async def aio_stat(self, oid: str):
+        return await self.client.aio_submit(
+            self.pool_id, self._msg(oid, op=OP_STAT))
+
+    async def aio_remove(self, oid: str):
+        return await self.client.aio_submit(
+            self.pool_id, self._msg(oid, op=OP_DELETE))
 
     async def rollback(self, oid: str, snapid: int) -> None:
         """selfmanaged_snap_rollback: restore head from snap."""

@@ -388,6 +388,45 @@ declare(
            "per-function tainted-name cap in ctlint's dataflow "
            "engine (widening valve) — consumed by the analyzer via "
            "CEPH_TPU_CTLINT_TRANSFER_MAX_STATES", min=16),
+    # -- async client plane (client/objecter.py) ------------------------
+    Option("objecter_inflight_ops", int, 1024, LEVEL_ADVANCED,
+           "ops a client keeps in flight before aio submission "
+           "backpressures the submitter (the reference "
+           "objecter_inflight_ops throttle, src/osdc/Objecter.h)",
+           min=1),
+    Option("objecter_inflight_op_bytes", int, 100 << 20, LEVEL_ADVANCED,
+           "payload bytes a client keeps in flight before aio "
+           "submission backpressures (reference "
+           "objecter_inflight_op_bytes; an op larger than the whole "
+           "budget still runs alone)", min=1),
+    Option("objecter_batch_max_ops", int, 64, LEVEL_ADVANCED,
+           "ops to the same primary OSD coalesced into one wire burst "
+           "(back-to-back frames under a single send-lock hold) by "
+           "the objecter's per-OSD writer", min=1),
+    # -- mClock tenant classes (osd/opqueue.py) -------------------------
+    Option("osd_mclock_client_profiles", str, "", LEVEL_ADVANCED,
+           "extra dmclock client classes for tenant-tagged ops "
+           "(MOSDOp.qos_class): 'name:weight' or "
+           "'name:reservation/weight/limit' entries, comma-separated "
+           "(e.g. 'gold:30,bronze:3'); untagged ops ride the built-in "
+           "client class, unknown tags inherit its profile"),
+    # -- load harness (ceph_tpu/loadgen/) -------------------------------
+    Option("loadgen_handles", int, 8, LEVEL_ADVANCED,
+           "RadosClient handles the load driver shares among its "
+           "simulated clients (each handle is one messenger + mon "
+           "session; thousands of logical clients multiplex over "
+           "them)", min=1),
+    Option("loadgen_latency_tolerance", float, 0.25, LEVEL_ADVANCED,
+           "relative tolerance for the client-vs-mgr latency "
+           "cross-check: the load report's percentile over its own "
+           "interval means must agree with the mgr digest's "
+           "percentile of the same ingested series within this "
+           "fraction (plus the 1µs ingest quantization)",
+           min=0.0),
+    Option("loadgen_verify_sample", int, 64, LEVEL_ADVANCED,
+           "objects re-read and payload-verified after a load run "
+           "(self-describing headers catch corrupt/cross-object "
+           "acked writes); 0 disables the sweep", min=0),
 )
 
 

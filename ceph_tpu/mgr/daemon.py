@@ -712,6 +712,22 @@ class MgrDaemon:
                 "fallbacks": int(self.engine.stats.get("fallbacks", 0)),
             },
         }
+        load_clients = {}
+        for daemon, sess in self.sessions.items():
+            # load-harness telemetry sessions (loadgen/driver.py):
+            # surfaced in the digest so `mgr digest` serves the
+            # ingested client-side view back for cross-checking
+            if not daemon.startswith("loadgen."):
+                continue
+            load_clients[daemon] = {
+                "reports": sess.get("reports", 0),
+                "gauges": {k: round(float(v), 1)
+                           for k, v in sess.get("gauges", {}).items()},
+                "counters": {k: float(v) for k, v in
+                             sess.get("counters", {}).items()},
+            }
+        if load_clients:
+            digest["load_clients"] = load_clients
         prom = self.modules.get("prometheus")
         if prom is not None and prom.running:
             digest["prometheus"] = prom.text()

@@ -438,6 +438,21 @@ class CommandMixin:
                 return 0, "", json.dumps(self._mgr_map).encode()
             if prefix == "mgr stat":
                 return 0, "", json.dumps(self._mgr_stat()).encode()
+            if prefix == "mgr digest":
+                # the analytics/telemetry slice of the active mgr's
+                # last MMonMgrReport — what the load harness
+                # cross-checks its client-side percentiles against
+                # (over the wire, so the whole report->digest->mon
+                # chain is what gets verified)
+                d = self._mgr_digest or {}
+                return 0, "", json.dumps({
+                    "active": d.get("active"), "ts": d.get("ts"),
+                    "analytics": d.get("analytics", {}),
+                    "osd_perf": d.get("osd_perf", {}),
+                    "load_clients": d.get("load_clients", {}),
+                    "health": sorted(d.get("health", {})),
+                    "engine": d.get("engine", {}),
+                }).encode()
             if prefix == "mgr module ls":
                 from ceph_tpu.mgr.modules import MODULE_REGISTRY
 

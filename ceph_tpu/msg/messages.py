@@ -350,10 +350,14 @@ class MOSDOp(Message):
         data: bytes = b"", epoch: int = 0,
         ops: list[OSDOp] | None = None, reqid: str = "",
         snap_seq: int = 0, snaps: list[int] | None = None,
-        snapid: int | None = None,
+        snapid: int | None = None, qos_class: str = "",
     ):
         self.tid, self.pool, self.oid = tid, pool, oid
         self.epoch = epoch
+        # dmclock tenant tag: the OSD's mClock gate admits the op
+        # under this client class ('' = the built-in client class) —
+        # how multi-tenant QoS differentiation reaches the scheduler
+        self.qos_class = qos_class
         # write SnapContext (MOSDOp snapc: seq + existing snaps,
         # newest first) and read snap id (CEPH_NOSNAP = head)
         from ceph_tpu.osd.snaps import NOSNAP
@@ -398,6 +402,7 @@ class MOSDOp(Message):
         for s in self.snaps:
             enc.u64(s)
         enc.u64(self.snapid)
+        enc.str_(self.qos_class)
 
     @classmethod
     def decode_payload(cls, dec):
@@ -407,6 +412,7 @@ class MOSDOp(Message):
         msg.snap_seq = dec.u64()
         msg.snaps = [dec.u64() for _ in range(dec.u32())]
         msg.snapid = dec.u64()
+        msg.qos_class = dec.str_()
         return msg
 
 

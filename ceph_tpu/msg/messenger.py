@@ -177,6 +177,66 @@ class Connection:
                     self.writer, tag, segs, crypto=self.crypto
                 )
 
+    async def send_messages(self, msgs: list[Message]) -> None:
+        """Send a burst of messages back-to-back under ONE send-lock
+        hold (the objecter's per-OSD coalescing seam): frames hit the
+        wire consecutively with no interleaved waits, so a batch of
+        ops to the same primary costs one writer wakeup instead of N.
+        Netem/injection semantics stay per-message (a partitioned peer
+        drops each message exactly as single sends would)."""
+        if self._closed:
+            raise ConnectionError("connection closed")
+        shim = self.messenger.netem
+        if shim is not None and self.peer is not None:
+            kept = []
+            for m in msgs:
+                if await shim.on_send(self.messenger.entity, self.peer):
+                    kept.append(m)
+            msgs = kept
+        if not msgs:
+            return
+        n = self.messenger.inject_socket_failures
+        if n > 0:
+            self.messenger._inject_counter += len(msgs)
+            if self.messenger._inject_counter % n < len(msgs):
+                await self.close(notify=True)
+                raise ConnectionError("injected socket failure")
+        delay = self.messenger.inject_delay
+        if delay > 0:
+            await asyncio.sleep(delay)
+        tracer = self.messenger.tracer
+        async with self._send_lock:
+            for msg in msgs:
+                trace = getattr(msg, "trace", None)
+                span_cm = (
+                    tracer.span(
+                        "msg_send", ctx=trace, stage="net",
+                        msg=type(msg).__name__,
+                        peer=(f"{self.peer[0]}.{self.peer[1]}"
+                              if self.peer else "?"),
+                    )
+                    if tracer is not None and trace is not None
+                    and trace.sampled
+                    else contextlib.nullcontext()
+                )
+                with span_cm:
+                    self._seq += 1
+                    segs = encode_message(
+                        msg, self.messenger.entity, self._seq)
+                    tag = frames.Tag.MESSAGE
+                    if (
+                        self.compressor is not None
+                        and sum(len(s) for s in segs)
+                        >= self.messenger.compress_min_size
+                    ):
+                        segs = [
+                            self.compressor.compress(s) for s in segs
+                        ]
+                        tag = frames.Tag.MESSAGE_COMPRESSED
+                    await frames.write_frame(
+                        self.writer, tag, segs, crypto=self.crypto
+                    )
+
     async def _run(self) -> None:
         try:
             # frames that arrived interleaved with the connect-side

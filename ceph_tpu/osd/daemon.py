@@ -368,7 +368,7 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         # here; under saturation dequeue order follows dmclock tags so
         # clients outrank background work.  Sub-op service never
         # admits (see opqueue.py deadlock rule).
-        from ceph_tpu.osd.opqueue import MClockGate
+        from ceph_tpu.osd.opqueue import MClockGate, parse_qos_profiles
         from ceph_tpu.osd.scheduler import ClientProfile
 
         self.op_gate = MClockGate(
@@ -381,11 +381,22 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                 "best_effort": ClientProfile(weight=self.conf[
                     "osd_mclock_scheduler_background_best_effort_wgt"]),
             },
+            # per-class qos_* fairness counters land in this OSD's
+            # perf collection: `perf dump`, the prometheus exposition
+            # and MgrClient report deltas all see them for free
+            perf=self.perf,
+            tenant_profiles=parse_qos_profiles(
+                self.conf["osd_mclock_client_profiles"]),
         )
         self.conf.add_observer(
             ("osd_op_queue_max_inflight",),
             lambda ch: self.op_gate.set_max_inflight(
                 ch["osd_op_queue_max_inflight"]),
+        )
+        self.conf.add_observer(
+            ("osd_mclock_client_profiles",),
+            lambda ch: self.op_gate.set_tenant_profiles(
+                parse_qos_profiles(ch["osd_mclock_client_profiles"])),
         )
         self._map_event = asyncio.Event()
         self.stopping = False
@@ -466,6 +477,12 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         sock.register(
             "dump_traces", "recent spans (blkin/otel role)",
             lambda cmd: self.tracer.dump(),
+        )
+        sock.register(
+            "dump_qos", "mClock per-class fairness: profiles, "
+            "admitted/queued counts, park time and served cost per "
+            "dmclock client class (the tenant-differentiation proof)",
+            lambda cmd: self.op_gate.qos_dump(),
         )
         sock.register(
             "dump_decode_batch", "recovery-decode aggregator batching "
@@ -1965,9 +1982,16 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             # the queue leg of the cluster trace (stage=queue): joined
             # to the client's trace context when the op carries one, so
             # mClock admission wait is attributable per op
+            # tenant tag -> dmclock class (untagged ops ride the
+            # built-in client class); cost grows with payload so
+            # byte-heavy tenants charge their dmclock tags — and the
+            # qos_cost_* fairness counters — proportionally
+            klass = msg.qos_class or "client"
+            cost = 1.0 + sum(len(o.data) for o in msg.ops) / 65536.0
             q_sp = self.tracer.start_span(
-                "op_queue", ctx=msg.trace, stage="queue", oid=msg.oid)
-            async with self.op_gate.admit("client"):
+                "op_queue", ctx=msg.trace, stage="queue", oid=msg.oid,
+                klass=klass)
+            async with self.op_gate.admit(klass, cost=cost):
                 self.tracer.finish_span(q_sp)
                 tracked.mark_event("executing")
                 with self.tracer.span(

@@ -14,7 +14,8 @@ def _readme_artifacts() -> set[str]:
     with open(os.path.join(REPO, "README.md")) as f:
         text = f.read()
     return set(re.findall(
-        r"\b((?:BENCH|MULTICHIP|CHAOS)_[A-Za-z0-9_.]*\.json)\b", text))
+        r"\b((?:BENCH|MULTICHIP|CHAOS|LOAD)_[A-Za-z0-9_.]*\.json)\b",
+        text))
 
 
 def test_readme_cites_at_least_one_artifact():
@@ -83,7 +84,7 @@ def test_committed_artifacts_parse():
     """Every artifact in the tree is (line-delimited or plain) JSON."""
     for name in sorted(os.listdir(REPO)):
         if not re.fullmatch(
-            r"(?:BENCH|MULTICHIP|CHAOS)_[A-Za-z0-9_.]*\.json", name
+            r"(?:BENCH|MULTICHIP|CHAOS|LOAD)_[A-Za-z0-9_.]*\.json", name
         ):
             continue
         with open(os.path.join(REPO, name)) as f:
@@ -181,6 +182,57 @@ def test_chaos_event_plane_artifact():
             if n > 0:
                 assert entity in (obs.get("crash_entities") or []), r
     assert judged >= 16, "osd_thrash + disk-fault x 8 seeds expected"
+
+
+def test_load_artifact_green_and_replayable():
+    """The load harness's honesty contract: the README must cite a
+    committed LOAD artifact covering >= 2 profiles INCLUDING the
+    RMW-heavy EC one; every run green with client-side percentiles
+    present, the client-vs-mgr latency cross-check recorded AND
+    agreeing, cold_launches == 0 and host_transfers == 0 asserted
+    in-run, and a trace hash that re-derives bit-identically from
+    (seed, resolved profile)."""
+    from ceph_tpu.loadgen.schedule import (
+        generate_load,
+        resolve_profile,
+        trace_hash,
+    )
+
+    cited = sorted(
+        n for n in _readme_artifacts() if n.startswith("LOAD_"))
+    assert cited, "README must cite the committed LOAD artifact"
+    profiles_covered: set[str] = set()
+    for name in cited:
+        path = os.path.join(REPO, name)
+        assert os.path.exists(path), f"cited artifact {name} not committed"
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["schema"] == "ceph_tpu.loadgen/v1"
+        assert len(set(doc["profiles"])) >= 2, doc["profiles"]
+        assert doc["summary"]["all_green"], doc["summary"]
+        profiles_covered.update(doc["profiles"])
+        for r in doc["runs"]:
+            assert r["ok"], r
+            lat = r["latency"]["overall"]
+            for key in ("p50_us", "p95_us", "p99_us"):
+                assert lat[key] > 0, (r["profile"], key)
+            xc = r["client_vs_mgr"]
+            assert xc["agree"], xc
+            assert xc["client"] and xc["mgr"], xc
+            assert r["cold_launches"] == 0, r
+            assert r["host_transfers"] == 0, r
+            assert r["latency"]["errors"] == 0, r
+            assert r["verify"]["mismatches"] == 0
+            assert r["verify"]["lost"] == 0
+            # determinism: the committed trace hash re-derives
+            p = resolve_profile(
+                r["profile"], clients=r["clients"],
+                ops_per_client=r["ops_per_client"])
+            assert r["trace_hash"] == trace_hash(
+                generate_load(r["seed"], p)), (name, r["profile"])
+    assert "rmw_ec" in profiles_covered, (
+        "the RMW-heavy small-random-write EC profile must stay "
+        "artifact-proven")
 
 
 def test_chaos_artifact_traces_replay():

@@ -13,7 +13,7 @@ mini-cluster's command surface:
   ceph.py -m HOST:PORT osd perf
   ceph.py -m HOST:PORT pg scrub PGID | pg deep-scrub PGID
   ceph.py -m HOST:PORT df
-  ceph.py -m HOST:PORT mgr dump | mgr stat | mgr fail [NAME]
+  ceph.py -m HOST:PORT mgr dump | mgr stat | mgr digest | mgr fail [NAME]
   ceph.py -m HOST:PORT mgr module ls | mgr module enable NAME
           | mgr module disable NAME
   ceph.py -m HOST:PORT trace ls | trace show TRACE_ID
@@ -144,6 +144,9 @@ async def amain(args, extra: list[str]) -> int:
             code, rs, data = await client.command({"prefix": "mgr dump"})
         elif verb == "mgr" and extra[:1] == ["stat"]:
             code, rs, data = await client.command({"prefix": "mgr stat"})
+        elif verb == "mgr" and extra[:1] == ["digest"]:
+            code, rs, data = await client.command(
+                {"prefix": "mgr digest"})
         elif verb == "mgr" and extra[:1] == ["fail"]:
             cmd = {"prefix": "mgr fail"}
             if len(extra) > 1:
