@@ -391,8 +391,191 @@ def check_events(obs: dict) -> list[dict]:
     return out
 
 
+#: write-error codes a partitioned client may legally observe: a
+#: deadline firing (ETIMEDOUT), a transient bounce (EAGAIN), or the
+#: resend budget exhausting (EIO).  Anything else — and any HANG,
+#: which simply never records an error — is an objecter bug.
+LEGAL_PARTITION_ERRNOS = frozenset(
+    {errno.ETIMEDOUT, errno.EAGAIN, errno.EIO})
+
+
+def check_client_netem(obs: dict) -> list[dict]:
+    """The client-netem ack oracle (``obs`` is the runner's record):
+
+    - the trace must have scheduled client-link faults AND at least
+      one partition verdict must have actually BITTEN a client send
+      (``client_partitioned_sends`` — an armed rule nothing hit proves
+      nothing);
+    - every write the objecter FAILED must carry a legal partition-
+      facing errno (deadline ETIMEDOUT / EAGAIN / resend-budget EIO)
+      — a silent hang records no error and no ack, and is caught by
+      the workload never completing; an unexpected errno here is the
+      driver misclassifying a partition;
+    - zero lost/rolled-back ACKED writes is judged by check_history /
+      check_final_reads over the same run (ETIMEDOUT and resend-
+      duplicates are legal outcomes; silent loss is not).
+    """
+    out: list[dict] = []
+    if not obs.get("client_events"):
+        out.append({
+            "invariant": "no_client_event_scheduled",
+            "detail": "scenario expected client-link netem events, "
+                      "trace has none",
+        })
+        return out
+    stats = obs.get("netem") or {}
+    if not stats.get("client_partitioned_sends"):
+        out.append({
+            "invariant": "client_partition_never_fired",
+            "detail": "no client send ever hit an armed client-link "
+                      f"partition (netem: {stats})",
+        })
+    for w in obs.get("errored_writes") or []:
+        if w.get("errno") not in LEGAL_PARTITION_ERRNOS:
+            out.append({
+                "invariant": "illegal_client_error",
+                "detail": f"write {w.get('pool')}/{w.get('oid')} "
+                          f"v{w.get('version')} failed with errno="
+                          f"{w.get('errno')} ({w.get('error')}); legal"
+                          " under partition: ETIMEDOUT/EAGAIN/EIO",
+            })
+    return out
+
+
+def check_fullness(obs: dict) -> list[dict]:
+    """The fullness-pressure gating ladder (``obs`` is the fullness
+    watcher's record).  Every rung must have been OBSERVED live and
+    the whole ladder must clear after the drain:
+
+    - OSD_NEARFULL and OSD_BACKFILLFULL health raised (mon statfs
+      ingestion -> map bits -> health checks);
+    - backfill actually PAUSED at backfillfull: a remote reservation
+      answered REJECT_TOOFULL on the fullness branch
+      (recovery.py ``backfill_reject_toofull`` counter grew);
+    - OSD_FULL raised and a client write BOUNCED with ENOSPC while
+      the map carried the FULL bit;
+    - the local failsafe was never breached: no store's observed
+      usage ratio reached osd_failsafe_full_ratio (the gate exists so
+      the mon's full bit always engages first);
+    - after the drain the entire ladder CLEARED and the cluster
+      converged (convergence itself is check_converged's verdict).
+    """
+    out: list[dict] = []
+    for key, name in (
+        ("nearfull_raised", "OSD_NEARFULL"),
+        ("backfillfull_raised", "OSD_BACKFILLFULL"),
+        ("full_raised", "OSD_FULL"),
+    ):
+        if not obs.get(key):
+            out.append({
+                "invariant": "fullness_check_never_raised",
+                "detail": f"{name} never appeared in `ceph health` "
+                          "while the ladder was driven",
+            })
+    if not obs.get("backfill_rejects"):
+        out.append({
+            "invariant": "backfill_never_paused",
+            "detail": "no REJECT_TOOFULL reservation was observed "
+                      "while a backfillfull osd was a backfill target",
+        })
+    if not obs.get("enospc_bounced"):
+        out.append({
+            "invariant": "enospc_never_bounced",
+            "detail": "no client write bounced ENOSPC while the map "
+                      "carried a FULL bit",
+        })
+    peak = float(obs.get("failsafe_peak") or 0.0)
+    failsafe = float(obs.get("failsafe_ratio") or 1.0)
+    if peak >= failsafe:
+        out.append({
+            "invariant": "failsafe_breached",
+            "detail": f"observed usage ratio {peak:.3f} >= "
+                      f"osd_failsafe_full_ratio {failsafe:.3f}",
+        })
+    if not obs.get("ladder_cleared"):
+        out.append({
+            "invariant": "fullness_never_cleared",
+            "detail": "fullness health checks still raised after the "
+                      "drain and settle "
+                      f"(remaining: {obs.get('checks_at_settle')})",
+        })
+    return out
+
+
+def check_load(rec: dict, expected_tenants: list[str]) -> list[dict]:
+    """The composed chaos x load verdict (``rec`` is the load
+    harness's run record).  Production is thrash AND traffic at once,
+    so the harness's whole gate set must hold THROUGH the thrash:
+
+    - zero op errors and a fully-drained in-flight set (the objecter
+      retried every op through the cuts/kills to completion);
+    - the self-verifying payload sweep found zero lost/corrupt acked
+      writes;
+    - SLO percentiles present (client-side p50/p95/p99 computed over
+      real completions);
+    - the client-vs-mgr latency cross-check AGREES (the report plane
+      survived the thrash too);
+    - per-tenant ``qos_*`` fairness counters present for every
+      profile tenant (the mClock gate differentiated under pressure);
+    - cold_launches == 0 and host_transfers == 0 (also delta-checked
+      cluster-wide by check_cold_launches).
+    """
+    out: list[dict] = []
+    lat = (rec.get("latency") or {})
+    if lat.get("errors"):
+        out.append({
+            "invariant": "load_op_errors",
+            "detail": f"{lat['errors']} ops failed "
+                      f"(samples: {rec.get('error_samples')})",
+        })
+    if rec.get("undrained"):
+        out.append({
+            "invariant": "load_undrained",
+            "detail": f"{rec['undrained']} ops never completed",
+        })
+    v = rec.get("verify") or {}
+    if v.get("mismatches") or v.get("lost"):
+        out.append({
+            "invariant": "load_acked_write_lost",
+            "detail": f"payload sweep: {v}",
+        })
+    overall = lat.get("overall") or {}
+    if not all(overall.get(k, 0) > 0
+               for k in ("p50_us", "p95_us", "p99_us")):
+        out.append({
+            "invariant": "load_percentiles_missing",
+            "detail": f"latency overall row: {overall}",
+        })
+    if not (rec.get("client_vs_mgr") or {}).get("agree"):
+        out.append({
+            "invariant": "load_mgr_crosscheck_failed",
+            "detail": f"client_vs_mgr: {rec.get('client_vs_mgr')}",
+        })
+    qos = rec.get("qos") or {}
+    missing = [t for t in expected_tenants
+               if not (qos.get(t) or {}).get("admitted")]
+    if missing:
+        out.append({
+            "invariant": "load_qos_rows_missing",
+            "detail": f"tenants {missing} have no admitted ops in "
+                      f"the qos fairness rows ({sorted(qos)})",
+        })
+    if rec.get("cold_launches"):
+        out.append({
+            "invariant": "load_cold_launches",
+            "detail": f"{rec['cold_launches']} cold launches mid-load",
+        })
+    if rec.get("host_transfers"):
+        out.append({
+            "invariant": "load_host_transfers",
+            "detail": f"{rec['host_transfers']} implicit transfers",
+        })
+    return out
+
+
 #: checker registry: name -> callable, for reporting
 ALL_INVARIANTS = (
     "history", "final_reads", "converged", "quorum", "scrub",
     "disk_faults", "cold_launches", "mgr", "slow_osd", "events",
+    "client_netem", "fullness", "load",
 )

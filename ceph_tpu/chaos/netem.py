@@ -55,6 +55,11 @@ class Netem:
         self.stats = {
             "partitioned_sends": 0, "dropped_sends": 0,
             "delayed_sends": 0, "reordered_sends": 0,
+            # client-link verdicts counted separately: the client-netem
+            # oracle needs PROOF a partition/drop actually bit a
+            # client send, not just that a rule was armed
+            "client_partitioned_sends": 0, "client_dropped_sends": 0,
+            "client_delayed_sends": 0,
         }
 
     def _counters(self):
@@ -133,22 +138,29 @@ class Netem:
         partitioned link; sleeps for delay/reorder holds.  Runs BEFORE
         the connection's send lock, so a held message is genuinely
         overtaken by later sends on the same connection."""
+        client_link = src[0] == "client" or dst[0] == "client"
         for a, b in self._partitions:
             if (_match(a, src) and _match(b, dst)) or (
                 _match(b, src) and _match(a, dst)
             ):
                 self.stats["partitioned_sends"] += 1
+                if client_link:
+                    self.stats["client_partitioned_sends"] += 1
                 self._counters().inc("netem_partitioned_sends")
                 raise ConnectionError(
                     f"netem: {src} -> {dst} partitioned")
         for s, d in self._oneways:
             if _match(s, src) and _match(d, dst):
                 self.stats["dropped_sends"] += 1
+                if client_link:
+                    self.stats["client_dropped_sends"] += 1
                 self._counters().inc("netem_dropped_sends")
                 return False
         for (s, d), secs in list(self._delays.items()):
             if _match(s, src) and _match(d, dst):
                 self.stats["delayed_sends"] += 1
+                if client_link:
+                    self.stats["client_delayed_sends"] += 1
                 self._counters().inc("netem_delayed_sends")
                 await asyncio.sleep(secs)
         for (s, d), (every, hold) in list(self._reorders.items()):

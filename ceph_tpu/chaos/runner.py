@@ -63,7 +63,7 @@ SCENARIOS: dict[str, dict] = {
             {"name": "rep", "type": "replicated", "pg_num": 4,
              "size": 2, "snaps": True},
             {"name": "ec", "type": "erasure", "pg_num": 2,
-             "k": 2, "m": 1},
+             "k": 2, "m": 1, "snaps": True},
         ],
         "workload": {"objects": 3, "rounds": 3, "object_size": 8192},
     },
@@ -80,7 +80,7 @@ SCENARIOS: dict[str, dict] = {
             {"name": "rep", "type": "replicated", "pg_num": 4,
              "size": 2, "snaps": True},
             {"name": "ec", "type": "erasure", "pg_num": 2,
-             "k": 2, "m": 1},
+             "k": 2, "m": 1, "snaps": True},
         ],
         "workload": {"objects": 3, "rounds": 3, "object_size": 8192},
     },
@@ -181,6 +181,106 @@ SCENARIOS: dict[str, dict] = {
         "workload": {"objects": 4, "rounds": 6, "object_size": 8192,
                      "write_gap": 0.7},
     },
+    # client-plane netem: the async objecter (PR 10: per-op deadline/
+    # backoff/map-wait drivers, coalesced bursts, bounded windows)
+    # joins the blast radius for the first time — the workload client's
+    # messenger wears the shim, and the schedule cuts/drops/delays
+    # CLIENT<->OSD links (mon links stay up: the command plane is the
+    # observer).  One early client partition is pinned per trace
+    # (client_partition_at) so the ack oracle always has a partition
+    # that verifiably fired; drops run in BOTH directions — vanished
+    # requests drive the deadline/backoff beat, vanished ACKS of
+    # applied writes drive resend-dedup-by-reqid.  check_client_netem
+    # + the history/final-read oracles judge it: a partitioned client
+    # may see ETIMEDOUT or resend-duplicates, never a lost or
+    # rolled-back acked write.
+    "client-netem": {
+        "name": "client-netem",
+        "n_osds": 4, "n_mons": 1,
+        "client_netem": True,
+        "client_partition_at": 0.3,
+        "duration": 4.0, "n_events": 10,
+        "max_client_cuts": 1,
+        "mix": {"client_partition": 2.5, "client_drop": 2.0,
+                "client_delay": 1.5, "osd_kill": 0.5, "scrub": 0.5},
+        "pools": [
+            {"name": "rep", "type": "replicated", "pg_num": 4,
+             "size": 2, "snaps": True},
+            {"name": "ec", "type": "erasure", "pg_num": 2,
+             "k": 2, "m": 1, "snaps": True},
+        ],
+        # paced writers so the write stream SPANS the cut windows —
+        # acks must be earned through partitions, not before them
+        "workload": {"objects": 3, "rounds": 4, "object_size": 8192,
+                     "write_gap": 0.3},
+    },
+    # fullness-pressure: small-capacity BlockStore OSDs driven up the
+    # whole gating ladder WHILE recovery runs.  The scripted skeleton
+    # (schedule.py fullness_script) fills to nearfull, then
+    # backfillfull, THEN outs an osd so the triggered backfill meets
+    # REJECT_TOOFULL live (recovery.py backfillfull gate), then fills
+    # to full (client writes must bounce ENOSPC against the map's
+    # FULL bit), then drains.  The ratios are widened via conf so the
+    # ladder is robust to CRUSH imbalance on tiny stores — the
+    # SEMANTICS under test (statfs -> mon bits -> health/gating ->
+    # heal) are ratio-independent.  check_fullness demands every rung
+    # observed, the failsafe never breached, and the ladder CLEARED.
+    "fullness-pressure": {
+        "name": "fullness-pressure",
+        "n_osds": 5, "n_mons": 1,
+        "store": "blockstore",
+        "capacity_bytes": 4 << 20,
+        "ballast_size": 128 * 1024,
+        "ballast_pool": "rep",
+        "fullness_script": True,
+        "nearfull_fill": 0.50, "backfillfull_fill": 0.62,
+        "full_fill": 0.82,
+        "duration": 3.0, "n_events": 2,
+        "mix": {"scrub": 1.0, "deep_scrub": 1.0},
+        "conf": {
+            "mon_osd_nearfull_ratio": 0.45,
+            "mon_osd_backfillfull_ratio": 0.55,
+            "mon_osd_full_ratio": 0.80,
+            "osd_beacon_report_interval": 0.2,
+        },
+        "pools": [
+            {"name": "rep", "type": "replicated", "pg_num": 8,
+             "size": 2, "snaps": True},
+            {"name": "ec", "type": "erasure", "pg_num": 2,
+             "k": 2, "m": 1},
+        ],
+        "workload": {"objects": 2, "rounds": 2, "object_size": 8192},
+    },
+    # chaos x loadgen composition: a deterministic LOAD trace
+    # (ceph_tpu/loadgen) replayed THROUGH a thrash trace in one run —
+    # production is both at once.  The load harness attaches to the
+    # chaos cluster in external mode (rados/ec planes), streams its
+    # telemetry to the chaos mgr, and its full gate set — the
+    # self-verifying payload sweep, per-tenant qos_* fairness
+    # counters, SLO percentiles, client-vs-mgr cross-check,
+    # cold_launches == 0 and host_transfers == 0 — is judged TOGETHER
+    # with the chaos invariants (check_load + converged/quorum/scrub).
+    "compose_load": {
+        "name": "compose_load",
+        "n_osds": 4, "n_mons": 1, "n_mgrs": 1,
+        "duration": 4.0, "n_events": 8,
+        "mix": {"osd_kill": 2.0, "osd_out": 1.0, "delay": 1.5,
+                "reorder": 1.0, "scrub": 0.5, "balance": 0.5},
+        "load_profile": {"profile": "compose_smoke"},
+        "conf": {
+            "mgr_report_interval": 0.25, "mgr_digest_interval": 0.25,
+            "mgr_stats_max_metrics": 24,
+            "osd_mclock_client_profiles": "gold:20.0,bronze:2.0",
+        },
+        # the harness's own pools, pre-created here so the thrash
+        # events (scrub/repair/balance) target what the load hits
+        "pools": [
+            {"name": "lg-rep", "type": "replicated", "pg_num": 8,
+             "size": 2},
+            {"name": "lg-ec", "type": "erasure", "pg_num": 4,
+             "k": 2, "m": 1},
+        ],
+    },
     # monitor-plane chaos: restarts + osd kills over a 3-mon quorum,
     # plus pg_num splitting mid-storm
     "quorum_thrash": {
@@ -248,6 +348,19 @@ class ChaosCluster:
         # entity -> injected-death count (kills + self-escalations);
         # the check_events invariant demands a crash dump for each
         self.deaths: dict[str, int] = {}
+        # composed-mode load harness (run_scenario sets it; teardown
+        # must stop it before the daemons go away)
+        self.load_harness = None
+        # fullness-pressure state: ballast object names written by
+        # fill events (drain deletes them) + the watcher/fill
+        # observation record check_fullness judges
+        self._ballast_names: list[str] = []
+        self.fullness: dict = {
+            "nearfull_raised": False, "backfillfull_raised": False,
+            "full_raised": False, "enospc_bounced": False,
+            "backfill_rejects": 0.0, "failsafe_peak": 0.0,
+            "ladder_cleared": False,
+        }
         import tempfile
 
         # run-scoped crash_dir: every daemon persists dumps here and
@@ -281,7 +394,10 @@ class ChaosCluster:
 
         if self._store_dir is None:
             self._store_dir = tempfile.mkdtemp(prefix="chaos-disk-")
-        store = BlockStore(os.path.join(self._store_dir, f"osd{osd_id}"))
+        store = BlockStore(
+            os.path.join(self._store_dir, f"osd{osd_id}"),
+            capacity_bytes=int(
+                self.scenario.get("capacity_bytes", 1 << 40)))
         store.mount()
         self._stores[osd_id] = store
         return store
@@ -332,8 +448,13 @@ class ChaosCluster:
             await osd.start()
             self.osds.append(osd)
         self.client = RadosClient(client_id=8080)
-        # the workload's acks are the oracle: the client stays outside
-        # the blast radius (the thrasher never cuts the observer)
+        # the workload's acks are the oracle.  Classically the client
+        # stays OUTSIDE the blast radius; client-netem scenarios flip
+        # that — the client messenger wears the shim too, and the
+        # schedule's client_* verbs cut its OSD links (never its mon
+        # links: the command plane stays the observer)
+        if sc.get("client_netem"):
+            self.netem.attach(self.client.messenger)
         await self.client.connect_multi(list(self.monmap))
         for pool in sc.get("pools", []):
             if pool.get("type") == "erasure":
@@ -533,6 +654,42 @@ class ChaosCluster:
                 a.get("ttl"),
                 lambda: self.netem.heal_reorder(
                     tuple(a["src"]), tuple(a["dst"])))
+        elif kind == "client_partition":
+            peer = tuple(a["peer"])
+            self.netem.partition(("client", None), peer)
+            self._schedule_heal(
+                a.get("ttl"),
+                lambda: self.netem.heal_partition(
+                    ("client", None), peer))
+        elif kind == "heal_client_partition":
+            self.netem.heal_partition(("client", None), tuple(a["peer"]))
+        elif kind == "client_drop":
+            src, dst = ("client", None), tuple(a["peer"])
+            if a.get("to_client"):
+                src, dst = dst, src
+            self.netem.drop_oneway(src, dst)
+            self._schedule_heal(
+                a.get("ttl"),
+                lambda: self.netem.heal_oneway(src, dst))
+        elif kind == "heal_client_drop":
+            src, dst = ("client", None), tuple(a["peer"])
+            if a.get("to_client"):
+                src, dst = dst, src
+            self.netem.heal_oneway(src, dst)
+        elif kind == "client_delay":
+            # both directions: slow requests out AND slow acks back
+            links = ((("client", None), tuple(a["peer"])),
+                     (tuple(a["peer"]), ("client", None)))
+            for s_, d_ in links:
+                self.netem.delay(s_, d_, a["seconds"])
+            self._schedule_heal(
+                a.get("ttl"),
+                lambda: [self.netem.heal_delay(s_, d_)
+                         for s_, d_ in links])
+        elif kind == "fill":
+            await self._apply_fill(a["level"], float(a["ratio"]))
+        elif kind == "drain":
+            await self._apply_drain()
         elif kind == "netem_clear":
             self.netem.clear()
         elif kind in ("eio", "bitflip", "torn_write", "disk_dead",
@@ -601,6 +758,180 @@ class ChaosCluster:
         elif kind == "disk_heal":
             for op in self._DISK_FAULT_OPS:
                 FAULTS.clear(f"store.{op}.osd.{osd_id}")
+
+    # -- fullness-pressure machinery -----------------------------------
+
+    def _store_ratios(self, in_only: bool = False) -> dict[int, float]:
+        """Live used/total per OSD store (dead daemons skipped; the
+        scripted ladder never kills).  ``in_only`` restricts to up+in
+        members — the set backfill reservations can target."""
+        om = self.client.osdmap if self.client else None
+        out: dict[int, float] = {}
+        for osd in self.osds:
+            if osd is None:
+                continue
+            if in_only and om is not None and (
+                not om.is_up(osd.id) or om.is_out(osd.id)
+            ):
+                continue
+            try:
+                sf = osd.store.statfs()
+            except (OSError, NotImplementedError):
+                continue
+            total = sf.get("total", 0)
+            out[osd.id] = (sf.get("used", 0) / total) if total else 0.0
+        return out
+
+    async def _fullness_check_raised(self, check: str,
+                                     timeout: float = 12.0) -> bool:
+        """Poll `ceph health` until ``check`` appears (statfs beacons
+        -> mon full bits -> health is an async chain)."""
+        import json as _json
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                code, _rs, data = await self.client.command(
+                    {"prefix": "health"})
+                if code == 0 and data:
+                    if check in (_json.loads(data).get("checks") or {}):
+                        return True
+            except (OSError, ValueError, ConnectionError,
+                    asyncio.TimeoutError):
+                pass
+            await asyncio.sleep(0.15)
+        return False
+
+    def _ballast_candidates(self, pool_name: str, target: int):
+        """Yield unwritten ballast names whose PG acting set contains
+        ``target`` (placement computed client-side — fills STEER, so
+        tiny stores cross their thresholds without CRUSH-imbalance
+        overshooting any one of them)."""
+        from ceph_tpu.osd.daemon import object_to_pg
+
+        om = self.client.osdmap
+        pid = om.lookup_pg_pool_name(pool_name)
+        pl = om.get_pg_pool(pid) if pid >= 0 else None
+        if pl is None:
+            return
+        have = set(self._ballast_names)
+        for i in range(4096):
+            name = f"ballast-{i:05d}"
+            if name in have:
+                continue
+            pg = object_to_pg(pl, name)
+            _u, _up, acting, _pri = om.pg_to_up_acting_osds(pg)
+            if target in acting:
+                yield name
+
+    async def _apply_fill(self, level: str, ratio: float) -> None:
+        """Closed-loop ballast writer: push store usage until the
+        level's target is observed.  nearfull/full push the MOST-full
+        store over the line (one over-threshold osd raises the check
+        and gates writes); backfillfull pushes the LEAST-full store
+        up until EVERY up+in member is past the reservation gate.
+        Each write is aimed at a PG holding the chosen osd, so the
+        ladder is driven precisely — the TRACE stays pure, only this
+        application loop is adaptive (like wait_clean)."""
+        import errno as _errno
+
+        sc = self.scenario
+        pool = sc.get("ballast_pool", "rep")
+        size = int(sc.get("ballast_size", 128 * 1024))
+        # never push any store near the local failsafe: the ladder is
+        # proven against the widened conf ratios, with the failsafe
+        # margin held in reserve (check_fullness asserts the peak)
+        cap = float(sc.get("full_fill", 0.82)) + 0.06
+        io = self.client.ioctx(pool)
+        obs = self.fullness
+        for _ in range(400):
+            ratios = self._store_ratios(in_only=True)
+            if not ratios or max(ratios.values()) >= cap:
+                break
+            if level == "backfillfull":
+                if min(ratios.values()) >= ratio:
+                    break
+                target = min(ratios, key=ratios.get)
+            else:
+                if max(ratios.values()) >= ratio:
+                    break
+                target = max(ratios, key=ratios.get)
+            name = next(
+                self._ballast_candidates(pool, target), None)
+            if name is None:
+                break  # namespace exhausted for this placement
+            try:
+                await io.write_full(name, b"\xba" * size)
+                self._ballast_names.append(name)
+            except OSError as e:
+                if e.errno == _errno.ENOSPC:
+                    obs["enospc_bounced"] = True
+                    break
+                raise
+        check = {"nearfull": "OSD_NEARFULL",
+                 "backfillfull": "OSD_BACKFILLFULL",
+                 "full": "OSD_FULL"}[level]
+        if await self._fullness_check_raised(check):
+            obs[f"{level}_raised"] = True
+        if level == "full" and not obs["enospc_bounced"]:
+            await self._probe_enospc(io, pool, size)
+
+    async def _probe_enospc(self, io, pool_name: str,
+                            size: int) -> None:
+        """The ENOSPC proof: aim writes at PGs whose acting set
+        contains a map-FULL osd and require the bounce.  A write may
+        race the bit onto an OSD whose map lags one beacon — retry
+        over fresh candidates with a short grace."""
+        import errno as _errno
+
+        from ceph_tpu.osd.daemon import object_to_pg
+
+        om = self.client.osdmap
+        pid = om.lookup_pg_pool_name(pool_name)
+        pl = om.get_pg_pool(pid) if pid >= 0 else None
+        if pl is None:
+            return
+        full = {o for o in range(om.max_osd)
+                if om.exists(o) and om.is_full(o)}
+        if not full:
+            return
+        attempts = 0
+        for i in range(512):
+            name = f"ballast-probe-{i:03d}"
+            pg = object_to_pg(pl, name)
+            _u, _up, acting, _pri = om.pg_to_up_acting_osds(pg)
+            if not (full & set(acting)):
+                continue
+            try:
+                await io.write_full(name, b"\xbb" * size)
+                # raced the bit on the OSD's older map: the write
+                # landed — track it for the drain, grace, retry
+                self._ballast_names.append(name)
+            except OSError as e:
+                if e.errno == _errno.ENOSPC:
+                    self.fullness["enospc_bounced"] = True
+                return
+            attempts += 1
+            if attempts >= 8:
+                return
+            await asyncio.sleep(0.25)
+
+    async def _apply_drain(self) -> None:
+        """Delete every ballast object (deletes pass the full gate —
+        they are how an operator digs out) and let usage fall; the
+        settle phase then requires the ladder to CLEAR."""
+        sc = self.scenario
+        io = self.client.ioctx(sc.get("ballast_pool", "rep"))
+        import errno as _errno
+
+        for name in self._ballast_names:
+            try:
+                await io.remove(name)
+            except OSError as e:
+                if e.errno != _errno.ENOENT:
+                    log.warning("chaos: drain of %s failed: %s",
+                                name, e)
+        self._ballast_names = []
 
     def _schedule_heal(self, ttl, heal) -> None:
         if not ttl:
@@ -808,6 +1139,71 @@ async def _watch_slow_osd(cluster, targets, obs, perf_base) -> None:
         await asyncio.sleep(0.25)
 
 
+async def _watch_fullness(cluster, obs, perf_base) -> None:
+    """Fullness observer: while the ladder is driven, record the
+    peak usage ratio any store reaches (the failsafe-never-breached
+    proof), the REJECT_TOOFULL reservation count growing on the
+    backfillfull members (recovery.py backfill_reject_toofull — the
+    backfill-actually-paused proof), and any health rung the fill
+    handler's own bounded wait might have missed."""
+    import json as _json
+
+    while True:
+        ratios = cluster._store_ratios()
+        if ratios:
+            obs["failsafe_peak"] = max(
+                obs["failsafe_peak"], max(ratios.values()))
+        rejects = 0.0
+        for osd in cluster.osds:
+            if osd is None:
+                continue
+            rejects += (
+                osd.perf.dump().get("backfill_reject_toofull", 0.0)
+                - perf_base.get(osd.id, 0.0))
+        if rejects > obs["backfill_rejects"]:
+            obs["backfill_rejects"] = rejects
+        try:
+            code, _rs, data = await cluster.client.command(
+                {"prefix": "health"})
+            if code == 0 and data:
+                checks = _json.loads(data).get("checks") or {}
+                for level, check in (
+                    ("nearfull", "OSD_NEARFULL"),
+                    ("backfillfull", "OSD_BACKFILLFULL"),
+                    ("full", "OSD_FULL"),
+                ):
+                    if check in checks:
+                        obs[f"{level}_raised"] = True
+        except (OSError, ValueError, ConnectionError,
+                asyncio.TimeoutError):
+            pass
+        await asyncio.sleep(0.15)
+
+
+async def _settle_fullness(cluster, obs, time_scale: float) -> None:
+    """Post-drain verification: the whole ladder must CLEAR — no
+    fullness health check may survive the drain and settle."""
+    import json as _json
+
+    fullness_checks = {"OSD_NEARFULL", "OSD_BACKFILLFULL", "OSD_FULL"}
+    deadline = time.monotonic() + 30.0 * time_scale
+    checks: list = []
+    while time.monotonic() < deadline:
+        try:
+            code, _rs, data = await cluster.client.command(
+                {"prefix": "health"})
+            if code == 0 and data:
+                checks = sorted(_json.loads(data).get("checks") or {})
+                if not (set(checks) & fullness_checks):
+                    obs["ladder_cleared"] = True
+                    return
+        except (OSError, ValueError, ConnectionError,
+                asyncio.TimeoutError):
+            pass
+        await asyncio.sleep(0.3)
+    obs["checks_at_settle"] = checks
+
+
 async def _watch_events(cluster, obs) -> None:
     """Event-plane observer: sample the active mgr's progress module
     while the thrash runs, recording each event's fraction sequence
@@ -943,21 +1339,57 @@ async def run_scenario(
     }
     watch_task: asyncio.Task | None = None
     events_watch_task: asyncio.Task | None = None
+    fullness_watch_task: asyncio.Task | None = None
     try:
         await cluster.start()
         cold_before = _cold_launch_snapshot()
         from ceph_tpu.common.fault_injector import disk_fault_counters
 
         df_before = dict(disk_fault_counters().dump())
-        wl_conf = scenario.get("workload", {})
-        workload = Workload(
-            cluster.client, scenario.get("pools", []),
-            objects=wl_conf.get("objects", 3),
-            rounds=wl_conf.get("rounds", 3),
-            object_size=wl_conf.get("object_size", 8192),
-            write_gap=wl_conf.get("write_gap", 0.0) * time_scale,
-        )
-        wl_task = asyncio.ensure_future(workload.run())
+        workload = None
+        wl_task = None
+        load_task = None
+        if scenario.get("load_profile"):
+            # chaos x loadgen composition: the deterministic LOAD
+            # trace IS the workload — the harness attaches to this
+            # cluster in external mode and the thrash replays through
+            # its open-loop arrival process
+            from ceph_tpu.loadgen.driver import LoadHarness
+            from ceph_tpu.loadgen.schedule import resolve_profile
+
+            lp = dict(scenario["load_profile"])
+            profile = resolve_profile(
+                lp.get("profile", "compose_smoke"),
+                clients=lp.get("clients"),
+                ops_per_client=lp.get("ops_per_client"))
+            load_harness = cluster.load_harness = LoadHarness(
+                profile, seed, time_scale=time_scale,
+                monmap=list(cluster.monmap), conf=cluster._conf(),
+                qos_osds=cluster.osds)
+            await load_harness.start()
+            load_task = asyncio.ensure_future(load_harness.run())
+            # thrash begins once the namespaces are prefilled: setup
+            # is not the production window under test
+            await load_harness.prefill_done.wait()
+        else:
+            wl_conf = scenario.get("workload", {})
+            workload = Workload(
+                cluster.client, scenario.get("pools", []),
+                objects=wl_conf.get("objects", 3),
+                rounds=wl_conf.get("rounds", 3),
+                object_size=wl_conf.get("object_size", 8192),
+                write_gap=wl_conf.get("write_gap", 0.0) * time_scale,
+            )
+            wl_task = asyncio.ensure_future(workload.run())
+
+        if scenario.get("fullness_script"):
+            perf_base = {
+                osd.id: osd.perf.dump().get(
+                    "backfill_reject_toofull", 0.0)
+                for osd in cluster.osds if osd is not None
+            }
+            fullness_watch_task = asyncio.ensure_future(
+                _watch_fullness(cluster, cluster.fullness, perf_base))
 
         slow_obs: dict | None = None
         if scenario.get("watch_slow_osd"):
@@ -999,7 +1431,12 @@ async def run_scenario(
             if delay > 0:
                 await asyncio.sleep(delay)
             await cluster.apply_event(ev)
-        history = await wl_task
+        history = None
+        load_rec = None
+        if wl_task is not None:
+            history = await wl_task
+        if load_task is not None:
+            load_rec = await load_task
 
         if scenario.get("self_heal"):
             # drain in-flight disk-fault escalations before capturing
@@ -1019,9 +1456,17 @@ async def run_scenario(
             violations["converged"] = [{
                 "invariant": "not_converged", "detail": str(e)}]
         violations["quorum"] = await cluster.await_quorum_agreement()
-        violations["history"] = inv.check_history(history)
-        final = await workload.final_reads()
-        violations["final_reads"] = inv.check_final_reads(history, final)
+        if workload is not None:
+            violations["history"] = inv.check_history(history)
+            final = await workload.final_reads()
+            violations["final_reads"] = inv.check_final_reads(
+                history, final)
+        if load_rec is not None:
+            expected_tenants = sorted(
+                cluster.load_harness.profile.get("tenants") or {})
+            violations["load"] = inv.check_load(
+                load_rec, expected_tenants)
+            result["load"] = load_rec
         reports = await cluster.deep_scrub_sweep()
         if scenario.get("self_heal") and inv.check_scrub_reports(reports):
             # disk-fault mode: injected rot the run hasn't absorbed yet
@@ -1045,7 +1490,8 @@ async def run_scenario(
                 # damage still referenced at rest: background repairs
                 # may be in flight, or a clone needs one more pass
                 await cluster.repair_sweep()
-                await workload.final_reads()
+                if workload is not None:
+                    await workload.final_reads()
                 await asyncio.sleep(0.5 * time_scale)
                 fsck_reports = cluster.fsck_sweep()
                 if not inv.check_disk_faults(fsck_reports):
@@ -1102,6 +1548,45 @@ async def run_scenario(
                     if e),
                 "unmuted_checks": events_obs.get("unmuted_checks", []),
             }
+        if scenario.get("client_netem"):
+            # the client-netem ack oracle: a partition verifiably bit
+            # a client send, every failed write carries a legal errno
+            # — while check_history/check_final_reads above already
+            # judged no acked write lost or rolled back
+            client_kinds = ("client_partition", "client_drop",
+                            "client_delay")
+            errored = [w for w in history.writes
+                       if w.get("error") is not None]
+            violations["client_netem"] = inv.check_client_netem({
+                "client_events": sum(
+                    1 for e in events if e.kind in client_kinds),
+                "netem": dict(cluster.netem.stats),
+                "errored_writes": errored,
+            })
+            import errno as _errno
+
+            result["client_netem_obs"] = {
+                "client_partitioned_sends": cluster.netem.stats[
+                    "client_partitioned_sends"],
+                "client_dropped_sends": cluster.netem.stats[
+                    "client_dropped_sends"],
+                "client_delayed_sends": cluster.netem.stats[
+                    "client_delayed_sends"],
+                "errored_writes": len(errored),
+                "timeouts": sum(
+                    1 for w in errored
+                    if w.get("errno") == _errno.ETIMEDOUT),
+            }
+        if scenario.get("fullness_script"):
+            await _settle_fullness(cluster, cluster.fullness,
+                                   time_scale)
+            if fullness_watch_task is not None:
+                fullness_watch_task.cancel()
+            cluster.fullness["failsafe_ratio"] = cluster._conf()[
+                "osd_failsafe_full_ratio"]
+            violations["fullness"] = inv.check_fullness(
+                cluster.fullness)
+            result["fullness_obs"] = dict(cluster.fullness)
         violations["cold_launches"] = inv.check_cold_launches(
             cold_before, _cold_launch_snapshot())
 
@@ -1115,7 +1600,11 @@ async def run_scenario(
             "ok": ok,
             "events_applied": cluster.events_applied,
             "event_errors": len(cluster.event_errors),
-            "workload": history.summary(),
+            "workload": (
+                history.summary() if history is not None else {
+                    "load_ops": load_rec.get("ops_completed", 0)
+                    if load_rec else 0,
+                }),
             "netem": dict(cluster.netem.stats),
             "disk_faults": {
                 k: v - df_before.get(k, 0)
@@ -1134,6 +1623,13 @@ async def run_scenario(
             watch_task.cancel()
         if events_watch_task is not None:
             events_watch_task.cancel()
+        if fullness_watch_task is not None:
+            fullness_watch_task.cancel()
+        if cluster.load_harness is not None:
+            try:
+                await cluster.load_harness.stop()
+            except Exception:
+                log.exception("chaos: load harness teardown failed")
         await cluster.stop()
 
 

@@ -63,6 +63,23 @@ EVENT_KINDS = (
     # untouched, and report streams must resume after failover)
     "mgr_kill",       # stop a manager daemon (active or standby)
     "mgr_revive",     # restart a killed manager (fresh gid)
+    # client-link netem verbs (the PR-10 objecter's resend/backoff/
+    # deadline/map-wait paths under REAL partitions — the workload
+    # client joins the blast radius; its recorded completions are the
+    # ack-aware oracle)
+    "client_partition",       # symmetric cut client <-> peer entity
+    "heal_client_partition",  # heal one active client cut
+    "client_drop",    # one-way silent drop on a client link (either
+                      # direction: vanished requests or vanished acks
+                      # — the resend-dedup-by-reqid case)
+    "heal_client_drop",       # heal one active client drop
+    "client_delay",   # fixed per-send latency on a client link
+    # fullness-pressure verbs (the nearfull->backfillfull->full->heal
+    # ladder driven live against small-capacity stores; application is
+    # closed-loop — the runner writes/deletes ballast until the target
+    # ratio is observed — but the TRACE stays pure in (seed, scenario))
+    "fill",           # write ballast until every up osd >= args[ratio]
+    "drain",          # delete ballast until usage falls below nearfull
 )
 
 
@@ -106,6 +123,8 @@ class _TraceState:
         self.disk_faulted: set[int] = set()  # osds with ANY store fault
         self.last_damage = -1e9  # t of the last AT-REST damage event
         self.mgr_alive = set(range(n_mgrs))  # manager daemons running
+        self.client_cuts: list[tuple] = []   # active client partitions
+        self.client_drops: list[tuple] = []  # active client one-way drops
 
 
 def _entity_pool(rng: random.Random, scenario: dict) -> list[tuple]:
@@ -116,6 +135,17 @@ def _entity_pool(rng: random.Random, scenario: dict) -> list[tuple]:
     if scenario.get("n_mons", 1) > 1:
         ents += [("mon", r) for r in range(scenario["n_mons"])]
     return ents
+
+
+def _client_peer(rng: random.Random, scenario: dict) -> tuple:
+    """The far end of a client-link netem rule: one specific OSD, or
+    — about a quarter of draws — the ("osd", None) wildcard cutting
+    the client off from the WHOLE data plane at once (mon links stay
+    up: the session/command plane is the observer, never the target —
+    the oracle judges the objecter's data path)."""
+    if rng.random() < 0.25:
+        return ("osd", None)
+    return ("osd", rng.randrange(scenario["n_osds"]))
 
 
 def generate_schedule(seed: int, scenario: dict) -> list[ChaosEvent]:
@@ -165,6 +195,51 @@ def generate_schedule(seed: int, scenario: dict) -> list[ChaosEvent]:
         st.disk_faulted.add(victim)
         emit(round(float(lead_at), 3), "slow_disk", osd=victim,
              delay=float(scenario.get("slow_disk_delay", 0.5)))
+
+    # client-netem scenarios pin ONE guaranteed early client partition
+    # (the acceptance oracle demands a partition that verifiably
+    # FIRED in every trace): the pinned cut always takes the
+    # ("osd", None) wildcard — a specific osd may lead no PG, and a
+    # cut nothing sends through proves nothing.  Only the ttl derives
+    # from the seed; mix-drawn cuts keep their seed-varied peers.
+    # the pinned cut lives OUTSIDE the mix budget (its own slot,
+    # healed by ttl + trace end): letting a mix-drawn cut budget-pop
+    # it could heal it milliseconds after it armed, and the oracle
+    # would rightly flag a partition that never bit a send
+    lead_cut = scenario.get("client_partition_at")
+    pinned_cut = None
+    if lead_cut is not None:
+        pinned_cut = ("osd", None)
+        emit(round(float(lead_cut), 3), "client_partition",
+             peer=list(pinned_cut),
+             ttl=round(rng.uniform(0.4, 1.0), 3))
+
+    # fullness-pressure scenarios pin the whole gating ladder as a
+    # scripted skeleton (like slow_disk_at: the ladder must ALWAYS
+    # progress, only its timing and the outed victim vary with the
+    # seed).  Order is the invariant under test: nearfull first, then
+    # backfillfull BEFORE the osd_out so the triggered backfill meets
+    # REJECT_TOOFULL live (recovery.py backfillfull gate), then full
+    # (client writes must bounce ENOSPC), then drain + heal.  The
+    # fill/drain application is closed-loop in the runner; the trace —
+    # order, targets, victim — is pure in (seed, scenario).
+    if scenario.get("fullness_script"):
+        t_f = round(0.2 + rng.uniform(0.0, 0.3), 3)
+        emit(t_f, "fill", level="nearfull",
+             ratio=float(scenario.get("nearfull_fill", 0.86)))
+        t_f = round(t_f + 0.3 + rng.uniform(0.0, 0.3), 3)
+        emit(t_f, "fill", level="backfillfull",
+             ratio=float(scenario.get("backfillfull_fill", 0.91)))
+        victim = rng.randrange(n_osds)
+        t_f = round(t_f + 0.2 + rng.uniform(0.0, 0.2), 3)
+        st.in_set.discard(victim)
+        emit(t_f, "osd_out", osd=victim)
+        t_f = round(t_f + 0.3 + rng.uniform(0.0, 0.3), 3)
+        emit(t_f, "fill", level="full",
+             ratio=float(scenario.get("full_fill", 0.955)))
+        t_f = round(t_f + 0.4 + rng.uniform(0.0, 0.4), 3)
+        emit(t_f, "drain")
+        # the generic trace-end wholeness below emits the osd_in
 
     for t in times:
         kind = rng.choices(kinds, weights=weights)[0]
@@ -298,9 +373,43 @@ def generate_schedule(seed: int, scenario: dict) -> list[ChaosEvent]:
                  every=rng.choice([2, 3, 5]),
                  hold=round(rng.uniform(0.005, 0.03), 4),
                  ttl=round(rng.uniform(0.3, 1.5), 3))
+        elif kind == "client_partition":
+            max_client = scenario.get("max_client_cuts", 1)
+            if len(st.client_cuts) >= max_client:
+                cut = st.client_cuts.pop(
+                    rng.randrange(len(st.client_cuts)))
+                emit(t, "heal_client_partition", peer=list(cut))
+                continue
+            peer = _client_peer(rng, scenario)
+            st.client_cuts.append(peer)
+            emit(t, "client_partition", peer=list(peer),
+                 ttl=round(rng.uniform(0.3, 1.0), 3))
+        elif kind == "client_drop":
+            max_client = scenario.get("max_client_cuts", 1)
+            if len(st.client_drops) >= max_client:
+                link = st.client_drops.pop(
+                    rng.randrange(len(st.client_drops)))
+                emit(t, "heal_client_drop", peer=list(link[0]),
+                     to_client=link[1])
+                continue
+            peer = _client_peer(rng, scenario)
+            # direction matters: dropping client->osd loses requests
+            # (deadline/backoff beat); dropping osd->client loses ACKS
+            # of APPLIED writes (the resend must dedup by reqid)
+            to_client = rng.random() < 0.5
+            st.client_drops.append((peer, to_client))
+            emit(t, "client_drop", peer=list(peer), to_client=to_client,
+                 ttl=round(rng.uniform(0.3, 0.8), 3))
+        elif kind == "client_delay":
+            peer = _client_peer(rng, scenario)
+            emit(t, "client_delay", peer=list(peer),
+                 seconds=round(rng.uniform(0.005, 0.05), 4),
+                 ttl=round(rng.uniform(0.3, 1.5), 3))
         elif kind == "netem_clear":
             st.partitions.clear()
             st.oneways.clear()
+            st.client_cuts.clear()
+            st.client_drops.clear()
             emit(t, "netem_clear")
     # the trace always ends whole: every dead osd revives, every outed
     # osd returns, every cut heals — the runner's convergence invariant
@@ -310,6 +419,13 @@ def generate_schedule(seed: int, scenario: dict) -> list[ChaosEvent]:
         emit(t_end, "heal_partition", a=list(cut[0]), b=list(cut[1]))
     for link in st.oneways:
         emit(t_end, "heal_oneway", src=list(link[0]), dst=list(link[1]))
+    for peer in st.client_cuts:
+        emit(t_end, "heal_client_partition", peer=list(peer))
+    if pinned_cut is not None:
+        emit(t_end, "heal_client_partition", peer=list(pinned_cut))
+    for peer, to_client in st.client_drops:
+        emit(t_end, "heal_client_drop", peer=list(peer),
+             to_client=to_client)
     emit(t_end, "netem_clear")
     for osd in sorted(st.disk_faulted):
         # every fault-touched disk heals at trace end: sticky-dead
@@ -325,4 +441,11 @@ def generate_schedule(seed: int, scenario: dict) -> list[ChaosEvent]:
     for mgr in sorted(set(range(scenario.get("n_mgrs", 0)))
                       - st.mgr_alive):
         emit(t_end, "mgr_revive", mgr=mgr)
+    # scripted-ladder scenarios interleave pinned events with mix
+    # draws: a STABLE sort restores replay order.  Gated — legacy
+    # scenarios' committed trace hashes encode their emission order
+    # (e.g. the degraded-disk slow_disk lead precedes earlier-t mix
+    # draws) and must replay bit-identically forever.
+    if scenario.get("fullness_script"):
+        events.sort(key=lambda e: e.t)
     return events

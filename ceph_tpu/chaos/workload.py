@@ -69,10 +69,12 @@ class History:
     def now(self) -> int:
         return next(self._clock)
 
-    def record_write(self, pool, oid, version, start, ack, error=None):
+    def record_write(self, pool, oid, version, start, ack, error=None,
+                     errno=None):
         self.writes.append({
             "pool": pool, "oid": oid, "version": version,
             "start": start, "ack": ack, "error": error,
+            "errno": errno,
         })
 
     def record_read(self, pool, oid, start, end, version=None,
@@ -85,8 +87,13 @@ class History:
     def record_snap(self, pool, oid, snapid, expect_version):
         self.snaps.append({
             "pool": pool, "oid": oid, "snapid": snapid,
-            "expect_version": expect_version,
+            "expect_version": expect_version, "removed": False,
         })
+
+    def mark_snap_removed(self, pool, oid, snapid):
+        for s in self.snaps:
+            if (s["pool"], s["oid"], s["snapid"]) == (pool, oid, snapid):
+                s["removed"] = True
 
     def summary(self) -> dict:
         acked = sum(1 for w in self.writes if w["ack"] is not None)
@@ -133,9 +140,13 @@ class Workload:
     async def _writer(self, pool: dict, oid: str) -> None:
         h = self.history
         io = self.client.ioctx(pool["name"]).dup()
-        snaps_on = pool.get("snaps") and pool.get("type") != "erasure"
+        # snaps run on EC pools too: snap-frozen-content under thrash
+        # is exactly where EC COW clones (shard-granular) can diverge
+        # from the replicated path (thrash-erasure-code + snaps role)
+        snaps_on = pool.get("snaps")
         last_acked = 0
         snap_ids: list[int] = []
+        snap_of: dict[int, int] = {}  # snapid -> round it froze
         for v in range(1, self.rounds + 1):
             data = payload_for(pool["name"], oid, v, self.object_size)
             start = h.now()
@@ -143,7 +154,8 @@ class Workload:
                 await io.write_full(oid, data)
             except OSError as e:
                 h.record_write(pool["name"], oid, v, start, None,
-                               error=str(e))
+                               error=str(e),
+                               errno=getattr(e, "errno", None))
                 continue
             h.record_write(pool["name"], oid, v, start, h.now())
             last_acked = v
@@ -155,9 +167,31 @@ class Workload:
                     snap_ids.insert(0, snapid)
                     io.set_snap_context(snapid, list(snap_ids))
                     h.record_snap(pool["name"], oid, snapid, last_acked)
+                    snap_of[snapid] = last_acked
                 except OSError as e:
                     log.debug("chaos workload: snap failed: %s", e)
             await asyncio.sleep(self.write_gap)
+        if snaps_on and snap_ids and self._snap_remove_for(oid):
+            # snap REMOVE under thrash (half the objects, derived from
+            # the oid): trim must reap the clone without disturbing the
+            # head — the post-settle deep scrub judges the debris and
+            # the removed snap leaves the frozen-content oracle
+            victim_snap = snap_ids[-1]  # the oldest recorded snap
+            try:
+                await io.selfmanaged_snap_remove(victim_snap)
+                snap_ids.remove(victim_snap)
+                io.set_snap_context(
+                    snap_ids[0] if snap_ids else victim_snap,
+                    list(snap_ids))
+                h.mark_snap_removed(pool["name"], oid, victim_snap)
+            except OSError as e:
+                log.debug("chaos workload: snap remove failed: %s", e)
+
+    @staticmethod
+    def _snap_remove_for(oid: str) -> bool:
+        """Deterministic half of the objects exercise snap removal
+        (the other half keeps its snap for the frozen-content read)."""
+        return sum(oid.encode()) % 2 == 0
 
     async def _reader(self, pool: dict) -> None:
         h = self.history
@@ -225,6 +259,8 @@ class Workload:
                     rec["error"] = f"errno={getattr(e, 'errno', None)}"
                 out.append(rec)
         for snap in self.history.snaps:
+            if snap.get("removed"):
+                continue  # trimmed under thrash: no content to freeze
             io = self.client.ioctx(snap["pool"]).dup()
             io.snap_set_read(snap["snapid"])
             rec = {

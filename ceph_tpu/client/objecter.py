@@ -269,7 +269,13 @@ class Objecter:
                     log.debug("objecter: op to osd.%d failed (%r), "
                               "waiting for map", primary, e)
                     rec.attempt += 1
-                    await client._wait_new_map(om.epoch)
+                    # never outwait the op deadline: a partitioned
+                    # client must fire ETIMEDOUT on time, not after a
+                    # full map-wait round on top of it
+                    await client._wait_new_map(
+                        om.epoch,
+                        timeout=min(10.0, max(
+                            0.1, rec.deadline - loop.time())))
                     if (client.osdmap is not None
                             and client.osdmap.epoch <= om.epoch):
                         # no newer map (e.g. primary dead, unreported):
@@ -286,7 +292,9 @@ class Objecter:
                     # back off with jitter
                     rec.attempt += 1
                     await client._wait_new_map(
-                        min(om.epoch, reply.epoch - 1))
+                        min(om.epoch, reply.epoch - 1),
+                        timeout=min(10.0, max(
+                            0.1, rec.deadline - loop.time())))
                     if client.osdmap.epoch <= om.epoch:
                         await client._backoff(rec.attempt)
                     last_err = errno.EAGAIN

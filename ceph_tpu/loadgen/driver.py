@@ -72,7 +72,8 @@ class LoadHarness:
     """One (profile, seed) load run end to end."""
 
     def __init__(self, profile: dict, seed: int, *,
-                 time_scale: float = 1.0, monmap=None, conf=None):
+                 time_scale: float = 1.0, monmap=None, conf=None,
+                 qos_osds=None):
         from ceph_tpu.common import ConfigProxy
 
         self.profile = profile
@@ -80,6 +81,14 @@ class LoadHarness:
         self.time_scale = time_scale
         self.external_monmap = list(monmap) if monmap else None
         self.conf = conf if conf is not None else ConfigProxy()
+        # external-attach mode (chaos x load composition): the caller's
+        # in-process OSD daemons, for the qos fairness rows only —
+        # NEVER owned, never stopped here.  The list is shared and may
+        # mutate (thrash kills/revives) while we read it.
+        self.qos_osds = qos_osds
+        # set once prefill + warmup finish and the trace replay is
+        # about to start — the chaos runner gates its thrash on this
+        self.prefill_done = asyncio.Event()
         self.handles: list = []
         self.mons: list = []
         self.mgrs: list = []
@@ -484,6 +493,7 @@ class LoadHarness:
         prefilled = await self.prefill()
         await self.await_warmup()
         cold_before = _cold_snapshot()
+        self.prefill_done.set()
         by_client: dict[int, list] = {}
         for op in ops:
             by_client.setdefault(op.client, []).append(op)
@@ -594,9 +604,12 @@ class LoadHarness:
 
     def _qos_rows(self) -> dict:
         """Aggregate per-class mClock fairness across the embedded
-        OSDs (perf-dump twin rows; empty against external clusters)."""
+        OSDs — or, in composed chaos mode, the attached cluster's
+        daemons (empty against truly external clusters)."""
         agg: dict[str, dict] = {}
-        for o in self.osds:
+        osds = list(self.osds) + [
+            o for o in (self.qos_osds or []) if o is not None]
+        for o in osds:
             for klass, row in o.op_gate.qos_dump()["classes"].items():
                 a = agg.setdefault(klass, {
                     "admitted": 0, "queued": 0, "wait_us": 0,

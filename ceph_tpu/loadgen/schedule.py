@@ -94,6 +94,25 @@ PROFILES: dict[str, dict] = {
         "tenants": {"gold": 0.5, "bronze": 0.5},
         "n_osds": 5,
     },
+    # the chaos-composition smoke: a small RADOS + EC-RMW mix sized
+    # so one (scenario, seed) chaos run can replay it THROUGH a
+    # thrash trace (tools/chaos_run.py --profile / the compose_load
+    # scenario) without dominating the sweep's wall clock
+    "compose_smoke": {
+        "name": "compose_smoke",
+        "clients": 40,
+        "ops_per_client": 5,
+        "arrival_rate": 4.0,
+        "start_spread": 1.0,
+        "zipf_objects": 32,
+        "zipf_s": 1.1,
+        "object_size": 8192,
+        "small_sizes": (512, 1024, 2048),
+        "streams": {"rados_write": 3.0, "rados_read": 4.0,
+                    "ec_write": 1.5, "ec_read": 1.5},
+        "tenants": {"gold": 0.5, "bronze": 0.5},
+        "n_osds": 4,
+    },
     # pure RADOS closed-namespace mix — the cheap smoke profile
     "rados_rw": {
         "name": "rados_rw",

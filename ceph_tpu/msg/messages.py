@@ -551,9 +551,16 @@ class MOSDECSubOpWriteReply(Message):
     def __init__(
         self, tid: int = 0, pg: pg_t = pg_t(0, 0), shard: int = 0,
         from_osd: int = 0, result: int = 0, epoch: int = 0,
+        floored: bool = False,
     ):
         self.tid, self.pg, self.shard = tid, pg, shard
         self.from_osd, self.result, self.epoch = from_osd, result, epoch
+        # this apply pinned the replica's log-contiguity floor (it
+        # rejoined mid-traffic and skipped a version window): the
+        # primary must queue a recovery pass NOW — with no later map
+        # change there is no other trigger, and the member's earlier
+        # objects stay stale until scrub finds them
+        self.floored = floored
 
     def encode_payload(self, enc):
         enc.u64(self.tid)
@@ -561,12 +568,14 @@ class MOSDECSubOpWriteReply(Message):
         enc.i32(self.from_osd)
         enc.i32(self.result)
         enc.u32(self.epoch)
+        enc.bool_(self.floored)
 
     @classmethod
     def decode_payload(cls, dec):
         tid = dec.u64()
         pg, shard = _dec_pg(dec)
-        return cls(tid, pg, shard, dec.i32(), dec.i32(), dec.u32())
+        return cls(tid, pg, shard, dec.i32(), dec.i32(), dec.u32(),
+                   dec.bool_())
 
 
 class MOSDECSubOpRead(Message):
@@ -719,10 +728,13 @@ class MOSDRepOpReply(Message):
 
     def __init__(
         self, tid: int = 0, pg: pg_t = pg_t(0, 0), from_osd: int = 0,
-        result: int = 0, epoch: int = 0,
+        result: int = 0, epoch: int = 0, floored: bool = False,
     ):
         self.tid, self.pg, self.from_osd = tid, pg, from_osd
         self.result, self.epoch = result, epoch
+        # see MOSDECSubOpWriteReply.floored — same contract for the
+        # replicated sub-op path
+        self.floored = floored
 
     def encode_payload(self, enc):
         enc.u64(self.tid)
@@ -730,12 +742,14 @@ class MOSDRepOpReply(Message):
         enc.i32(self.from_osd)
         enc.i32(self.result)
         enc.u32(self.epoch)
+        enc.bool_(self.floored)
 
     @classmethod
     def decode_payload(cls, dec):
         tid = dec.u64()
         pg, _ = _dec_pg(dec)
-        return cls(tid, pg, dec.i32(), dec.i32(), dec.u32())
+        return cls(tid, pg, dec.i32(), dec.i32(), dec.u32(),
+                   dec.bool_())
 
 
 # -- recovery push (src/messages/MOSDPGPush.h) ------------------------------
@@ -872,6 +886,7 @@ class MOSDPGInfo(Message):
         entries: list[bytes] | None = None,
         objects: list[tuple[str, bytes]] | None = None, epoch: int = 0,
         past_acting: bytes = b"", merge_pending: bool = False,
+        missing: list[str] | None = None, contig_floor: bytes = b"",
     ):
         from ceph_tpu.osd.pglog import ZERO
 
@@ -888,6 +903,19 @@ class MOSDPGInfo(Message):
         # merge (its listing may include objects other members' logs
         # cannot order) — the primary must not stray-reap this pass
         self.merge_pending = merge_pending
+        # the member's SELF-AUDITED missing set (reference pg_missing_t
+        # via PGLog::rebuild_missing_set_with_repair): oids its own log
+        # names at versions its store does not serve.  last_update
+        # alone cannot carry this — log entries travel without data
+        # (adoption while briefly primary, MOSDPGLog sync), so a
+        # member can be log-current yet object-stale, invisible to the
+        # primary's missing_from() scoping (the stale-shard flake).
+        self.missing = missing or []
+        # encoded eversion key ("epoch.version") of this member's
+        # log-contiguity floor, empty when contiguous: a gapped log's
+        # last_update must not be trusted past this point (PGLog
+        # contig_floor — the missed-window marker)
+        self.contig_floor = contig_floor
 
     def encode_payload(self, enc):
         enc.u64(self.tid)
@@ -905,6 +933,10 @@ class MOSDPGInfo(Message):
         enc.u32(self.epoch)
         enc.bytes_(self.past_acting)
         enc.bool_(self.merge_pending)
+        enc.u32(len(self.missing))
+        for oid in self.missing:
+            enc.str_(oid)
+        enc.bytes_(self.contig_floor)
 
     @classmethod
     def decode_payload(cls, dec):
@@ -915,8 +947,13 @@ class MOSDPGInfo(Message):
         lt = _dec_ev(dec)
         entries = [dec.bytes_() for _ in range(dec.u32())]
         objects = [(dec.str_(), dec.bytes_()) for _ in range(dec.u32())]
+        epoch = dec.u32()
+        past_acting = dec.bytes_()
+        merge_pending = dec.bool_()
+        missing = [dec.str_() for _ in range(dec.u32())]
         return cls(tid, pg, shard, from_osd, lu, lt, entries, objects,
-                   dec.u32(), dec.bytes_(), dec.bool_())
+                   epoch, past_acting, merge_pending, missing,
+                   dec.bytes_())
 
 
 class MOSDPGLog(Message):
@@ -928,7 +965,7 @@ class MOSDPGLog(Message):
     def __init__(
         self, tid: int = 0, pg: pg_t = pg_t(0, 0), shard: int = -1,
         from_osd: int = 0, entries: list[bytes] | None = None, epoch: int = 0,
-        tail=None,
+        tail=None, clear_floor: bool = False,
     ):
         from ceph_tpu.osd.pglog import ZERO
 
@@ -938,6 +975,10 @@ class MOSDPGLog(Message):
         # sender's log_tail: lets a backfilled peer know its own log has
         # a gap below this point
         self.tail = tail if tail is not None else ZERO
+        # primary-verified heal: every object through the receiver's
+        # contiguity gap was reconciled and the entries shipped here
+        # FILL its content holes — the receiver may clear its floor
+        self.clear_floor = clear_floor
 
     def encode_payload(self, enc):
         enc.u64(self.tid)
@@ -948,6 +989,7 @@ class MOSDPGLog(Message):
             enc.bytes_(e)
         enc.u32(self.epoch)
         _enc_ev(enc, self.tail)
+        enc.bool_(self.clear_floor)
 
     @classmethod
     def decode_payload(cls, dec):
@@ -955,7 +997,8 @@ class MOSDPGLog(Message):
         pg, shard = _dec_pg(dec)
         from_osd = dec.i32()
         entries = [dec.bytes_() for _ in range(dec.u32())]
-        return cls(tid, pg, shard, from_osd, entries, dec.u32(), _dec_ev(dec))
+        return cls(tid, pg, shard, from_osd, entries, dec.u32(),
+                   _dec_ev(dec), dec.bool_())
 
 
 class MOSDPGLogAck(Message):

@@ -308,6 +308,12 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         # verified it under the current map (completeness, not just
         # map up-ness)
         self._clean_epoch: dict[tuple[int, int], int] = {}
+        # (pool, ps) -> (epoch, acting tuple) of the last PRIMED
+        # interval: a primary must adopt the acting set's log state
+        # before serving ops in a new interval (peering-before-active,
+        # see _prime_interval)
+        self._primed_intervals: dict[tuple[int, int], tuple] = {}
+        self._prime_locks: dict[tuple[int, int], asyncio.Lock] = {}
         # past_intervals-lite (reference src/osd/osd_types.h:3270
         # PastIntervals): per local PG, the acting sets of recent map
         # intervals since the pg was last clean — recovery consults
@@ -1072,6 +1078,72 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             self._pg_logs[c] = lg
         return lg
 
+    async def _prime_interval(self, pool, pg, acting) -> bool:
+        """Adopt the acting peers' pg-log state before this primary
+        serves its first op of a NEW interval (the reference's
+        peering-before-active contract, PG::activate).
+
+        Without it, a revived primary whose log missed the degraded
+        window mints its next version from a stale last_update — the
+        counter re-use lands INSIDE the window its peers already hold
+        (e.g. peers at 10'6, stale primary mints 11'3), which
+        (a) re-bases the version stream, (b) looks contiguous to gap
+        detection, and (c) makes every log's last_update equal so
+        missing_from() scopes nothing: the stale shard survives until
+        scrub.  Adopting first makes the mint collision-free AND
+        leaves the adopted entries in the log, where the self-audit
+        (log-vs-store) flags the primary's own missing objects for
+        the next recovery pass.
+
+        Returns False (caller bounces EAGAIN) while an acting peer is
+        unreachable — serving ops without its log state is exactly
+        the hole being closed.  Re-primes only when the ACTING SET
+        changes; same-set epochs refresh for free."""
+        key = (pool.id, pool.raw_pg_to_pg(pg).ps)
+        cached = self._primed_intervals.get(key)
+        act = tuple(acting)
+        if cached is not None and cached[1] == act:
+            if cached[0] != self.epoch:
+                self._primed_intervals[key] = (self.epoch, act)
+            return True
+        lock = self._prime_locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            cached = self._primed_intervals.get(key)
+            if cached is not None and cached[1] == act:
+                return True
+            epoch0 = self.epoch
+            pairs = self._pg_members(pool, acting)
+            mine = next((s for s, o in pairs if o == self.id), None)
+            if mine is None:
+                return False  # not a member under this view
+            c = self._shard_coll(pool, pg, mine)
+            lg = self._pg_log(c)
+            for s, o in pairs:
+                if o == self.id:
+                    continue
+                try:
+                    info = await self._pg_query(
+                        pool, pg, s, o, since=lg.info.last_update)
+                except (OSError, asyncio.TimeoutError, ConnectionError):
+                    return False  # unseen peer state: stay inactive
+                if info.last_update > lg.info.last_update:
+                    t = Transaction()
+                    self._ensure_coll(t, c)
+                    for raw in info.entries:
+                        e = pg_log_entry_t.decode(raw)
+                        if e.version > lg.info.last_update:
+                            lg.append(t, e)
+                    lg.trim(t, self._log_keep)
+                    if not t.empty():
+                        if getattr(self.store, "blocking_commit", False):
+                            await asyncio.to_thread(
+                                self.store.queue_transaction, t)
+                        else:
+                            self.store.queue_transaction(t)
+            if self.epoch == epoch0:
+                self._primed_intervals[key] = (epoch0, act)
+            return self.epoch == epoch0
+
     def _next_version(
         self, c: coll_t, epoch: int | None = None
     ) -> eversion_t | None:
@@ -1085,12 +1157,22 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         NEWER epoch (e.g. adopted from the next interval's primary):
         this op must be re-admitted under the newer map (caller replies
         EAGAIN) — minting into a foreign epoch could collide with that
-        primary's versions."""
-        lu = self._pg_log(c).info.last_update
+        primary's versions.
+
+        The counter is RESERVED at mint time (PGLog.reserved_version):
+        concurrent ops to different objects must never mint the same
+        eversion — the second append would silently swallow the
+        first's log entry (its object then has no log evidence and no
+        recovery pass can ever scope it).  An in-flight mint that dies
+        with the daemon just skips a counter — a detectable gap."""
+        lg = self._pg_log(c)
+        lu = lg.info.last_update
         e = self.epoch if epoch is None else epoch
-        if lu.epoch > e:
+        if lu.epoch > e or lg.reserved_version.epoch > e:
             return None
-        return eversion_t(e, lu.version + 1)
+        v = eversion_t(e, max(lu.version, lg.reserved_version.version) + 1)
+        lg.reserved_version = v
+        return v
 
     def _object_version(self, c: coll_t, o: ghobject_t) -> eversion_t:
         try:
@@ -2071,6 +2153,16 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         if primary != self.id:
             # client raced a map change; tell it to retry on a newer map
             return MOSDOpReply(tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
+        # peering-before-active: a primary serving its first op of a
+        # new interval must adopt the acting set's log state first —
+        # else a revived primary mints versions from its STALE
+        # last_update, re-basing the version stream over the
+        # degraded-window writes its peers hold (counter collision:
+        # undetectable as a gap, invisible to missing_from — the
+        # stale-shard flake's deepest root).  Bounce until primed.
+        if not await self._prime_interval(pool, pg, acting):
+            return MOSDOpReply(
+                tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
         # versions mint under the epoch primacy was verified at, even
         # if the map advances mid-op (see _next_version)
         admit_epoch = self.epoch
@@ -2522,14 +2614,77 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                 lg.trim(t, self._log_keep)
         return t
 
+    async def _rep_replicated_at(
+        self, pool, pg, pairs, oid: str, logged_v, lg,
+    ) -> bool:
+        """True when every acting member verifiably serves ``oid`` at
+        >= ``logged_v`` — or verifiably lacks it while the newest
+        logged op for the oid is a DELETE (absence is then the
+        replicated state, not a hole).  An unreachable member is
+        UNVERIFIED, never vouched for: the dup reply's 0 is a commit
+        claim, and claiming it for redundancy nobody can see is how
+        acked writes end up one-copy on a size-2 pool."""
+        latest_op = None
+        for v in sorted(lg.entries, reverse=True):
+            if lg.entries[v].oid == oid:
+                latest_op = lg.entries[v].op
+                break
+        for s, o2 in pairs:
+            if o2 == self.id:
+                c = self._shard_coll(pool, pg, s)
+                go = ghobject_t(oid)
+                present = self.store.exists(c, go)
+                ver = self._object_version(c, go) if present else ZERO
+            else:
+                try:
+                    payload, attrs = await self._probe_shard(
+                        pool, pg, s, o2, oid)
+                except (OSError, asyncio.TimeoutError, ConnectionError):
+                    return False
+                present = payload is not None
+                ver = (_v_parse((attrs or {}).get(VERSION_ATTR))
+                       if present else ZERO)
+            if present:
+                if ver < logged_v:
+                    return False
+            elif latest_op != DELETE:
+                return False
+        return True
+
     async def _rep_write_vector(self, pool, pg, acting, msg,
                                 admit_epoch: int | None = None) -> MOSDOpReply:
         c = self._shard_coll(pool, pg, NO_SHARD)
         o = ghobject_t(msg.oid)
         lg = self._pg_log(c)
         if msg.reqid and msg.reqid in lg.reqids:
-            # duplicate of an applied op: answer without re-applying
-            return MOSDOpReply(tid=msg.tid, result=0, epoch=self.epoch)
+            # duplicate of an applied op — but the retry exists
+            # BECAUSE something failed, and a fan-out that died
+            # mid-replication may have left a replica stale.  Verify
+            # every acting member actually serves the logged version
+            # before vouching for the commit (the EC dup path's PR-3
+            # discipline, now on the replicated path too: vouching
+            # blind acked writes whose redundancy was still degraded
+            # and left the stale-copy flake for scrub to find).
+            logged_v = lg.reqids[msg.reqid]
+            pairs = self._pg_members(pool, acting)
+            if await self._rep_replicated_at(
+                    pool, pg, pairs, msg.oid, logged_v, lg):
+                return MOSDOpReply(
+                    tid=msg.tid, result=0, epoch=self.epoch)
+            try:
+                await self._reconcile_object(
+                    pool, pg, pairs, msg.oid, have_lock=True)
+            except Exception:
+                log.exception(
+                    "osd.%d: dup-retry reconcile of %s failed",
+                    self.id, msg.oid)
+            if await self._rep_replicated_at(
+                    pool, pg, pairs, msg.oid, logged_v, lg):
+                return MOSDOpReply(
+                    tid=msg.tid, result=0, epoch=self.epoch)
+            self._queue_object_repair(pool, pg, msg.oid)
+            return MOSDOpReply(
+                tid=msg.tid, result=-errno.EAGAIN, epoch=self.epoch)
         # make_writeable: clone-on-write under a newer SnapContext
         from ceph_tpu.msg.messages import OSDOp
 
@@ -2607,6 +2762,10 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                 elif rep.result != 0:
                     return MOSDOpReply(
                         tid=msg.tid, result=rep.result, epoch=self.epoch)
+                elif getattr(rep, "floored", False):
+                    # replica pinned its contiguity floor mid-traffic:
+                    # queue a recovery pass (no map change will)
+                    self._queue_pg_pass(pool, pg)
             if lost:
                 # partial replication: the primary applied + logged but
                 # a replica never confirmed.  Reconcile NOW under the
@@ -2669,8 +2828,15 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                 )
         except OSError as e:
             result = -(e.errno or errno.EIO)
+        # report a pinned contiguity floor so the primary queues a
+        # recovery pass (see MOSDECSubOpWriteReply.floored)
+        floored = False
+        if result == 0 and msg.version > ZERO:
+            lg = self._pg_log(self._shard_coll(pool, msg.pg, NO_SHARD))
+            floored = (lg.contig_floor is not None
+                       and lg.info.last_update == msg.version)
         await msg.conn.send_message(MOSDRepOpReply(
             tid=msg.tid, pg=msg.pg, from_osd=self.id, result=result,
-            epoch=self.epoch,
+            epoch=self.epoch, floored=floored,
         ))
 

@@ -165,6 +165,11 @@ class ECBackendMixin:
                     estale = True
                 elif rep.result != 0 and first_err == 0:
                     first_err = rep.result
+                if getattr(rep, "floored", False):
+                    # the replica just pinned its contiguity floor: it
+                    # rejoined mid-traffic and its EARLIER objects are
+                    # stale with no map change left to trigger a pass
+                    self._queue_pg_pass(pool, pg)
             if lost:
                 # PARTIAL fan-out: some shard never confirmed while
                 # others may already hold this version.  Repair NOW,
@@ -650,13 +655,25 @@ class ECBackendMixin:
                 t.rmattr(c, o, name)
         if version > ZERO:
             lg = self._pg_log(c)
+            prior = self._object_version(c, o)
+            entry = pg_log_entry_t(
+                DELETE if delete else MODIFY, oid, version, prior,
+                reqid,
+            )
             if version > lg.info.last_update:
-                prior = self._object_version(c, o)
-                lg.append(t, pg_log_entry_t(
-                    DELETE if delete else MODIFY, oid, version, prior,
-                    reqid,
-                ))
-                lg.trim(t, self._log_keep)
+                lg.append(t, entry)
+            else:
+                # OUT-OF-ORDER commit: concurrent ops to different
+                # objects race their store commits, and a later-minted
+                # version can land first.  The entry must still be
+                # RECORDED (fill, not append): silently dropping it
+                # left the object with no log evidence — invisible to
+                # missing_from() on every future pass, the last root
+                # of the stale-shard flake (chaos x load found: a
+                # replica that missed exactly such a write could never
+                # be scoped for it).
+                lg.fill(t, entry)
+            lg.trim(t, self._log_keep)
         return t
 
     async def _ec_head_state(self, pool, pg, acting, oid):
@@ -740,7 +757,9 @@ class ECBackendMixin:
             if osd != CRUSH_ITEM_NONE and self.osdmap.is_up(osd)
         }
         if len(avail) < k:
-            raise ECFetchError(errno.EIO)
+            # not enough UP members to read right now: transient — the
+            # client retries through the remap, never a medium error
+            raise ECFetchError(errno.EAGAIN)
         async def read_one(s, o):
             return s, await self._read_shard_quiet(
                 pool, pg, s, o, oid, off=chunk_off, length=chunk_len,
@@ -753,6 +772,7 @@ class ECBackendMixin:
         got: dict[int, tuple] = {}
         enoent = 0
         saw_eio = False
+        saw_transient = False
         try:
             for fut in asyncio.as_completed(tasks):
                 shard, (payload, attrs, eno) = await fut
@@ -761,6 +781,8 @@ class ECBackendMixin:
                         enoent += 1
                     elif eno == errno.EIO:
                         saw_eio = True
+                    elif eno == errno.EHOSTUNREACH:
+                        saw_transient = True
                     continue
                 got[shard] = (payload, attrs or {})
                 # complete as soon as k shards agree on the newest
@@ -792,6 +814,8 @@ class ECBackendMixin:
                 self._queue_object_repair(pool, pg, oid)
         if enoent and enoent == len(tasks) - len(got):
             raise ECFetchError(errno.ENOENT)
+        if saw_transient:
+            raise ECFetchError(errno.EAGAIN)
         raise ECFetchError(errno.EIO)
 
     async def _ec_fetch(
@@ -896,6 +920,13 @@ class ECBackendMixin:
             raise ECFetchError(errno.ENOENT)
         if any(e == errno.EIO for e in excluded.values()):
             self._queue_object_repair(pool, pg, oid)
+        if any(e in (errno.EHOSTUNREACH, errno.ESTALE)
+               for e in excluded.values()):
+            # unreachable or stale-mid-recovery shards made the object
+            # unreadable RIGHT NOW — a transient the client retries
+            # (reference primaries park such ops on waiting_for_degraded
+            # instead of failing them), not a verified medium error
+            raise ECFetchError(errno.EAGAIN)
         raise ECFetchError(errno.EIO)
 
     async def _ec_read_vector(
@@ -990,14 +1021,17 @@ class ECBackendMixin:
         self, pool, pg, shard, osd, oid, *, off: int = 0, length: int = 0,
         extents: list[tuple[int, int]] | None = None, snap: int = NOSNAP,
     ):
-        """_read_shard with transport failures mapped to EIO."""
+        """_read_shard with transport failures mapped to EHOSTUNREACH
+        — DISTINCT from a medium-error EIO: a dead/cut peer is a
+        transient the client should retry (EAGAIN at the op layer),
+        not verified damage to decode around and background-repair."""
         try:
             return await self._read_shard(
                 pool, pg, shard, osd, oid, off=off, length=length,
                 extents=extents, snap=snap,
             )
         except (OSError, asyncio.TimeoutError, ConnectionError):
-            return None, None, errno.EIO
+            return None, None, errno.EHOSTUNREACH
 
     async def _read_shard(
         self, pool, pg, shard, osd, oid, *, off: int = 0, length: int = 0,
@@ -1190,9 +1224,19 @@ class ECBackendMixin:
                     )
         except OSError as e:
             result = -(e.errno or errno.EIO)
+        # did THIS apply pin the contiguity floor?  (this member
+        # rejoined mid-traffic and skipped a version window) — tell
+        # the primary in the reply so it queues a recovery pass NOW:
+        # without a later map change nothing else would scope the
+        # member's stale objects before scrub finds them
+        floored = False
+        if result == 0 and msg.version > ZERO:
+            lg = self._pg_log(self._shard_coll(pool, msg.pg, msg.shard))
+            floored = (lg.contig_floor is not None
+                       and lg.info.last_update == msg.version)
         await msg.conn.send_message(MOSDECSubOpWriteReply(
             tid=msg.tid, pg=msg.pg, shard=msg.shard, from_osd=self.id,
-            result=result, epoch=self.epoch,
+            result=result, epoch=self.epoch, floored=floored,
         ))
 
     async def _handle_sub_read(self, msg: MOSDECSubOpRead) -> None:

@@ -25,6 +25,7 @@ from ceph_tpu.store import ObjectStore, Transaction, coll_t, ghobject_t
 PGMETA_OID = "_pgmeta_"
 INFO_KEY = "info"
 LOG_KEY_PREFIX = "log."
+FLOOR_KEY = "contig_floor"
 
 MODIFY = 1
 DELETE = 2
@@ -147,6 +148,25 @@ class PGLog:
         # window shrinks to the log length across a restart — the same
         # bounded-dup contract the reference's dups list provides)
         self.reqids: "OrderedDict[str, eversion_t]" = OrderedDict()
+        # highest version counter handed out by _next_version but not
+        # yet appended (IN-MEMORY: an in-flight mint dies with the
+        # daemon and its counter is simply skipped — a detectable gap).
+        # Without the reservation, two concurrent ops to DIFFERENT
+        # objects both read last_update before either append lands
+        # (the fan-out round-trip sits in between) and mint the SAME
+        # eversion — the loser's log entry is silently swallowed by
+        # the winner's, leaving its object with no log evidence
+        # (chaos x load composition-found version-mint collision).
+        self.reserved_version: eversion_t = ZERO
+        # contiguity floor (PERSISTED): the last_update this log held
+        # when a NON-CONTIGUOUS entry was first appended (pg version
+        # counters are dense, so a skipped counter means ops this
+        # member never saw — a member revived mid-traffic starts
+        # applying new sub-ops and its last_update leapfrogs the
+        # missed window).  While set, last_update must NOT be trusted
+        # as "has everything up to here": peering scopes this member
+        # from the floor instead.  None = contiguous (normal).
+        self.contig_floor: eversion_t | None = None
 
     # -- mutation ------------------------------------------------------
 
@@ -159,18 +179,64 @@ class PGLog:
 
     def append(self, t: Transaction, entry: pg_log_entry_t) -> None:
         """Record one op; caller folds ``t`` into the data transaction
-        so log and data commit atomically."""
+        so log and data commit atomically.
+
+        A non-contiguous append (version counter skips — this member
+        missed ops while the pg moved on) pins the contiguity floor at
+        the pre-append last_update: the missed window's entries will
+        never arrive (appends are forward-only), so last_update alone
+        would silently vouch for state this member does not hold —
+        the stale-shard scrub flake's root mechanism."""
         assert entry.version > self.info.last_update, (
             entry.version, self.info.last_update,
         )
+        kv = {
+            LOG_KEY_PREFIX + entry.version.key(): entry.encode(),
+        }
+        if (entry.version.version > self.info.last_update.version + 1
+                and self.contig_floor is None):
+            self.contig_floor = self.info.last_update
+            kv[FLOOR_KEY] = self.contig_floor.key().encode()
         self.entries[entry.version] = entry
         self.info.last_update = entry.version
         self._track_reqid(entry)
+        kv[INFO_KEY] = self.info.encode()
         t.touch(self.cid, self.meta)
-        t.omap_setkeys(self.cid, self.meta, {
-            LOG_KEY_PREFIX + entry.version.key(): entry.encode(),
-            INFO_KEY: self.info.encode(),
-        })
+        t.omap_setkeys(self.cid, self.meta, kv)
+
+    def fill(self, t: Transaction, entry: pg_log_entry_t) -> None:
+        """Insert a history entry a gapped log missed (post-recovery
+        log sync): unlike append, versions at or below last_update
+        are accepted — they fill CONTENT holes, so if this member is
+        ever primary its missing_from() computations see the whole
+        history instead of silently skipping the window it missed."""
+        if entry.version in self.entries:
+            return
+        self.entries[entry.version] = entry
+        self._track_reqid(entry)
+        kv = {LOG_KEY_PREFIX + entry.version.key(): entry.encode()}
+        if entry.version > self.info.last_update:
+            self.info.last_update = entry.version
+            kv[INFO_KEY] = self.info.encode()
+        t.touch(self.cid, self.meta)
+        t.omap_setkeys(self.cid, self.meta, kv)
+
+    def effective_last_update(self) -> eversion_t:
+        """What this log can VOUCH for: last_update, unless a
+        contiguity gap pinned the floor lower."""
+        if self.contig_floor is not None:
+            return min(self.contig_floor, self.info.last_update)
+        return self.info.last_update
+
+    def clear_contig_floor(self, t: Transaction) -> None:
+        """Primary-verified: every object through the gap was
+        reconciled (a full recovery pass completed), so last_update
+        may be trusted again."""
+        if self.contig_floor is None:
+            return
+        self.contig_floor = None
+        t.touch(self.cid, self.meta)
+        t.omap_rmkeys(self.cid, self.meta, [FLOOR_KEY])
 
     def rollback_divergent(
         self, t: Transaction, oid: str, to: "eversion_t"
@@ -311,6 +377,12 @@ class PGLog:
         omap = store.omap_get(self.cid, self.meta)
         if INFO_KEY in omap:
             self.info = pg_info_t.decode(omap[INFO_KEY])
+        if FLOOR_KEY in omap:
+            try:
+                ep, _, ver = omap[FLOOR_KEY].decode().partition(".")
+                self.contig_floor = eversion_t(int(ep), int(ver))
+            except ValueError:
+                self.contig_floor = ZERO  # unreadable: trust nothing
         self.entries = {}
         for key, raw in omap.items():
             if key.startswith(LOG_KEY_PREFIX):

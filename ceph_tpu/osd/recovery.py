@@ -178,6 +178,14 @@ class RecoveryMixin:
                     self.recovery_stats["reservation_rejects"] += 1
                     await self._release_remotes(pg, granted)
                     granted.clear()
+                    # a TOOFULL rejecter may be full of exactly the
+                    # logged deletes this pass would replay onto it:
+                    # run the delete-replay OUTSIDE the reservation
+                    # gate so the peer can dig itself out and GRANT
+                    # the next round (fullness-chaos-found deadlock;
+                    # reference recovery deletes are never
+                    # reservation- or fullness-gated)
+                    await self._recover_pg_deletes(pool, pg, acting)
                     await asyncio.sleep(retry)
                 else:
                     return
@@ -245,7 +253,10 @@ class RecoveryMixin:
                 # store toward FULL (reference REJECT_TOOFULL path,
                 # doc/dev/osd_internals/backfill_reservation.rst) —
                 # the primary backs off and retries; log-based
-                # recovery of existing objects is unaffected
+                # recovery of existing objects is unaffected.  The
+                # counter is the fullness-pressure scenario's live
+                # proof that backfill actually paused here.
+                self.perf.inc("backfill_reject_toofull")
                 await msg.conn.send_message(MBackfillReserve(
                     tid=msg.tid, op=MBackfillReserve.REJECT_TOOFULL,
                     pool=msg.pool, ps=msg.ps, from_osd=self.id,
@@ -292,6 +303,39 @@ class RecoveryMixin:
                 (s, o) for s, o in enumerate(acting) if o != CRUSH_ITEM_NONE
             ]
         return [(NO_SHARD, o) for o in acting if o != CRUSH_ITEM_NONE]
+
+    async def _recover_pg_deletes(
+        self, pool: PgPool, pg: pg_t, acting: list[int],
+    ) -> None:
+        """Replay logged deletes WITHOUT holding backfill
+        reservations (the reference's recovery-delete semantics:
+        MOSDPGRecoveryDelete flows while backfill waits, and deletes
+        pass every fullness gate — they are how a peer digs itself
+        out).  Found by the fullness-pressure chaos scenario: a
+        member that missed a drain while out rejoins over the
+        backfillfull ratio, every reservation to it is rejected
+        TOOFULL, and without this pass the stale objects holding its
+        space are never removed — recovery deadlocks on the very
+        space it would free."""
+        pairs = self._pg_members(pool, acting)
+        if self.id not in [o for _, o in pairs]:
+            return
+        my_shard = next(s for s, o in pairs if o == self.id)
+        lg = self._pg_log(self._shard_coll(pool, pg, my_shard))
+        latest: dict[str, pg_log_entry_t] = {}
+        for v in sorted(lg.entries):
+            latest[lg.entries[v].oid] = lg.entries[v]
+        for e in latest.values():
+            if e.op != DELETE:
+                continue
+            try:
+                await self._reconcile_object(pool, pg, pairs, e.oid)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception(
+                    "osd.%d: delete replay of %s/%s failed",
+                    self.id, pg, e.oid)
 
     async def _recover_pg(self, pool: PgPool, pg: pg_t, acting: list[int]) -> bool:
         """Peering-lite + recovery for one PG this OSD leads.
@@ -388,15 +432,31 @@ class RecoveryMixin:
                 self.store.queue_transaction(t)
 
         # scope; prior intervals force the backfill enumeration — the
-        # data may live entirely on members our log knows nothing about
-        scope: set[str] | None = None if (gapped or prior) else set()
+        # data may live entirely on members our log knows nothing
+        # about.  Our OWN contiguity gap forces it too: a primary
+        # whose log missed a window cannot compute truthful missing
+        # sets from it (it would silently skip the gap's oids).
+        scope: set[str] | None = (
+            None if (gapped or prior or lg.contig_floor is not None)
+            else set())
         if scope is not None:
             for info in peer_infos.values():
-                miss = lg.missing_from(info.last_update)
+                # a gapped peer's last_update overstates what it
+                # holds: scope it from its contiguity floor instead
+                miss = lg.missing_from(self._peer_effective_lu(info))
                 if miss is None:
                     scope = None
                     break
                 scope |= set(miss.items)
+        if scope is not None:
+            # members' self-audited missing sets, plus our own: a
+            # log-current member can still be OBJECT-stale (entries
+            # adopted/synced without data — _self_audit_missing), and
+            # last_update scoping is blind to it
+            for info in peer_infos.values():
+                scope |= set(getattr(info, "missing", ()) or ())
+            scope |= set(
+                self._self_audit_missing(pool, pg, my_shard, lg))
         if ahead and scope is not None:
             # entries adopted above may name objects my own shard lacks
             for raw in max(ahead, key=lambda i: i.last_update).entries:
@@ -549,17 +609,42 @@ class RecoveryMixin:
             if isinstance(r, BaseException):
                 raise r
             all_ok &= r
-        # log sync
-        for (s, o), info in peer_infos.items():
-            if info.last_update >= lg.info.last_update:
-                continue
-            entries = [
-                e.encode() for e in lg.entries_after(info.last_update)
-            ]
-            try:
-                await self._pg_log_send(pool, pg, s, o, entries, lg.info.log_tail)
-            except (OSError, asyncio.TimeoutError, ConnectionError):
-                continue
+        # log sync — ONLY after a fully verified pass.  A lagging
+        # peer's last_update IS the next pass's missing-set evidence:
+        # syncing the log while an object push failed (member still
+        # booting through a near-instant kill+revive) hands the peer
+        # entries without data, the retry pass computes an EMPTY
+        # missing set from the now-current last_update, and the
+        # member stays one version stale until scrub flags it — the
+        # long-standing ~1/16 stale-shard flake, root-caused by the
+        # chaos x load composition runs (the reference never has this
+        # hole because MOSDPGLog populates a PERSISTED per-peer
+        # missing set; here last_update carries that burden, so it
+        # must stay honest).
+        if all_ok:
+            for (s, o), info in peer_infos.items():
+                eff = self._peer_effective_lu(info)
+                floored = bool(getattr(info, "contig_floor", b""))
+                if eff >= lg.info.last_update and not floored:
+                    continue
+                entries = [
+                    e.encode() for e in lg.entries_after(eff)
+                ]
+                try:
+                    # clear_floor: this pass verified every object on
+                    # this peer AND the entries above fill its gap
+                    await self._pg_log_send(
+                        pool, pg, s, o, entries, lg.info.log_tail,
+                        clear_floor=True)
+                except (OSError, asyncio.TimeoutError, ConnectionError):
+                    continue
+            if lg.contig_floor is not None:
+                # our own gap is verified too: every object this log
+                # names was reconciled across the acting set
+                t_fl = Transaction()
+                lg.clear_contig_floor(t_fl)
+                if not t_fl.empty():
+                    self.store.queue_transaction(t_fl)
         # only a FULLY verified pass (every object confirmed on every
         # target) may forget the prior intervals — a swallowed push
         # failure must keep the old home reachable for the retry
@@ -684,12 +769,15 @@ class RecoveryMixin:
             for (s, o), (present, _v, _a) in state.items():
                 if present:
                     await self._recovery_delete(pool, pg, s, o, oid, guard)
-            return True
+            return not unprobed  # an unseen member may still hold it
 
         all_state = {**prior_state, **state}
         versions = [v for (p, v, _a) in all_state.values() if p]
         if not versions:
-            return True  # nothing anywhere to recover from
+            # nothing REACHABLE to recover from — but an unprobed
+            # member's state is unseen, not absent: only full
+            # coverage may declare the object whole
+            return not unprobed
         vmax = max(versions)
         sources = {
             s: o for (s, o), (p, v, _a) in all_state.items()
@@ -700,22 +788,40 @@ class RecoveryMixin:
             if not p or v < vmax
         ]
         clone_ok = True
-        if not is_ec and sources:
+        if sources:
             # clone objects are immutable COW copies that never appear
             # in per-name reconciliation: a member rebuilt after data
             # loss gets the head (and its SnapSet) pushed but would
             # serve ENOENT for every snap read — sync any clone the
-            # authoritative SnapSet lists (chaos-engine-found gap)
+            # authoritative SnapSet lists (chaos-engine-found gap;
+            # the EC variant ALSO must run before the head pushes
+            # below, while a COW-missing member's frozen content is
+            # still its head — see _sync_clones_ec)
             src_attrs0 = next(
                 a for (s, o), (p, v, a) in all_state.items()
                 if p and v == vmax
             )
-            clone_ok = await self._sync_clones(
-                pool, pg, pairs, oid, next(iter(sources.items())),
-                src_attrs0,
-            )
+            if is_ec:
+                clone_ok = await self._sync_clones_ec(
+                    pool, pg, pairs, oid, src_attrs0, state,
+                    prior_pairs=prior_pairs)
+            else:
+                clone_ok = await self._sync_clones(
+                    pool, pg, pairs, oid, next(iter(sources.items())),
+                    src_attrs0, prior_pairs=prior_pairs,
+                )
         if not targets:
-            return clone_ok
+            # every PROBED member serves vmax — but success here must
+            # mean "verifiably reached every target", and an
+            # unreachable acting member is an unverified target, not a
+            # non-target.  Returning True with members unprobed was
+            # the stale-shard flake: a write-path reconcile racing a
+            # near-instant kill+revive probed around the dead member,
+            # declared the object whole, skipped the background
+            # repair queue — and the member stayed one version stale
+            # until the next scrub flagged it (no data loss; the
+            # probed quorum held the acked version throughout).
+            return clone_ok and not unprobed
         log.info(
             "osd.%d: recovering %s/%s to %s on %s", self.id, pg, oid,
             vmax, targets,
@@ -735,7 +841,7 @@ class RecoveryMixin:
                 self._push(pool, pg, s, o, oid, payload, src_attrs)
                 for s, o in targets
             ), return_exceptions=True)  # a dead target must not abort
-            return clone_ok and not any(  # the rest of the recovery pass
+            return clone_ok and not unprobed and not any(
                 isinstance(r, BaseException) for r in results)
         ec = self._ec_for(pool)
         sinfo = self._sinfo(ec)
@@ -1034,12 +1140,62 @@ class RecoveryMixin:
                        force=force_push)
             for s, o in targets
         ), return_exceptions=True)  # dead targets retry on the next pass
-        return not any(isinstance(r, BaseException) for r in results)
+        return not unprobed and not any(
+            isinstance(r, BaseException) for r in results)
 
     #: reserved push-attr key carrying a clone's snap id (clone pushes
     #: reuse the MOSDPGPush frame; the receiver pops this and files the
     #: payload under ghobject(oid, snap=...) instead of the head)
     CLONE_PUSH_ATTR = "__clone_snap__"
+
+    def _queue_pg_pass(self, pool, pg: pg_t) -> None:
+        """A sub-op reply reported a freshly-pinned contiguity floor:
+        the replica rejoined mid-traffic and skipped a version window,
+        so its earlier objects are stale — and with no map change
+        coming, nothing else would run the pass that scopes them (the
+        floor/audit machinery only helps a pass that RUNS).  Queue a
+        bounded background recovery pass for the pg now.  Deduplicated
+        per (pool, ps)."""
+        key = (pool.id, pool.raw_pg_to_pg(pg).ps)
+        pend = getattr(self, "_pg_pass_pending", None)
+        if pend is None:
+            pend = self._pg_pass_pending = set()
+        if key in pend:
+            return
+        pend.add(key)
+
+        async def _run() -> None:
+            try:
+                for attempt in range(20):
+                    if self.stopping:
+                        return
+                    await asyncio.sleep(min(0.2 * (attempt + 1), 1.0))
+                    om = self.osdmap
+                    cur_pool = om.get_pg_pool(pool.id) if om else None
+                    if cur_pool is None:
+                        return
+                    cur_pg = pg_t(pool.id, key[1])
+                    _u, _up, acting, primary = om.pg_to_up_acting_osds(
+                        cur_pg, folded=True)
+                    if primary != self.id:
+                        return  # the new primary's own pass covers it
+                    epoch = self.epoch
+                    try:
+                        await self._recover_pg_reserved(
+                            cur_pool, cur_pg, acting, epoch)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        continue
+                    if self._clean_epoch.get(key, -1) >= epoch:
+                        return
+                log.warning(
+                    "osd.%d: floored-replica pass for %s never "
+                    "completed", self.id, key)
+            finally:
+                pend.discard(key)
+
+        self._spawn_repair_task(_run())
 
     def _queue_object_repair(self, pool, pg, oid: str) -> None:
         """A write-path repair failed (links cut mid-thrash, member
@@ -1100,6 +1256,7 @@ class RecoveryMixin:
     async def _sync_clones(
         self, pool, pg, pairs, oid: str,
         src_pair: tuple[int, int], src_attrs: dict,
+        prior_pairs: list | None = None,
     ) -> bool:
         """Replicated pools: ensure every acting member holds every
         clone the authoritative head's SnapSet lists.  Clones are
@@ -1121,6 +1278,13 @@ class RecoveryMixin:
         s_src, o_src = src_pair
         ok = True
         for cl in ss.clones:
+            if cl.id in pool.removed_snaps:
+                # the snap was removed: its clones are trimmer
+                # territory (a member may have reaped while another
+                # holds a straggler) — syncing reaped debris would
+                # either resurrect it or wedge the pass retrying a
+                # source nobody has
+                continue
             payload = attrs = None
             if o_src == self.id:
                 c = self._shard_coll(pool, pg, s_src)
@@ -1132,8 +1296,32 @@ class RecoveryMixin:
                 payload, attrs, _e = await self._read_shard_quiet(
                     pool, pg, s_src, o_src, oid, snap=cl.id)
             if payload is None:
-                # the source lost this clone too: nothing to sync from;
-                # a prior-interval member may still serve it next pass
+                # the chosen source lost this clone: any CURRENT or
+                # PRIOR-interval member still holding it serves
+                # instead (a remap may have left the only copy on the
+                # old acting set — the same fallback head recovery
+                # gets via prior_pairs)
+                for s2, o2 in list(pairs) + list(prior_pairs or ()):
+                    if o2 in (CRUSH_ITEM_NONE, self.id):
+                        continue
+                    try:
+                        payload, attrs, _e = await self._read_shard_quiet(
+                            pool, pg, s2, o2, oid, snap=cl.id)
+                    except (OSError, asyncio.TimeoutError,
+                            ConnectionError):
+                        continue
+                    if payload is not None:
+                        break
+                else:
+                    payload = None
+                if payload is None and o_src != self.id:
+                    c2 = self._shard_coll(pool, pg, s_src)
+                    co2 = ghobject_t(oid, snap=cl.id, shard=s_src)
+                    if self.store.exists(c2, co2):
+                        payload = bytes(self.store.read(c2, co2))
+                        attrs = dict(self.store.getattrs(c2, co2))
+            if payload is None:
+                # nowhere to sync from yet: retry on a later pass
                 ok = False
                 continue
             for s, o in pairs:
@@ -1166,6 +1354,139 @@ class RecoveryMixin:
                         snap=cl.id)
                 except (OSError, asyncio.TimeoutError, ConnectionError):
                     ok = False
+        return ok
+
+    async def _sync_clones_ec(
+        self, pool, pg, pairs, oid: str, src_attrs: dict,
+        state: dict, prior_pairs: list | None = None,
+    ) -> bool:
+        """EC pools: ensure every acting member holds its shard of
+        every clone the authoritative head's SnapSet lists.  Two
+        repair sources, tried in order:
+
+        1. **file-head-as-clone**: a member that missed the COW write
+           entirely (down during the thrash window) still holds the
+           FROZEN content as its head — its head version equals the
+           clone's version attr (clones copy head attrs at COW time).
+           Copy its head into the clone slot BEFORE the head
+           roll-forward overwrites it: this replays make_writeable at
+           recovery time, exactly what the member would have done had
+           it seen the write.
+        2. **decode-from-k**: >= k members hold their clone shards —
+           rebuild the missing member's shard and push it
+           (clone pushes ride MOSDPGPush with the snap id).
+
+        A clone with fewer than k shards anywhere and no filing
+        candidate is unrecoverable snap data — logged, never wedging
+        head convergence (the chaos snap invariant stays the judge).
+        """
+        import errno
+
+        from ceph_tpu.osd.snaps import SNAPS_ATTR, SS_ATTR, SnapSet
+
+        raw = (src_attrs or {}).get(SS_ATTR)
+        if not raw:
+            return True
+        ss = SnapSet.from_bytes(raw)
+        if not ss.clones:
+            return True
+        ec = self._ec_for(pool)
+        sinfo = self._sinfo(ec)
+        k = ec.get_data_chunk_count()
+        ok = True
+        for cl in ss.clones:
+            if cl.id in pool.removed_snaps:
+                continue  # reaped by the trimmer (see _sync_clones)
+            have: dict[int, "np.ndarray"] = {}
+            have_attrs: dict | None = None
+            frozen_v = None
+            miss: list[tuple[int, int]] = []
+            for s, o in pairs:
+                payload, attrs, perr = await self._read_shard_quiet(
+                    pool, pg, s, o, oid, snap=cl.id)
+                if payload is not None:
+                    have[s] = np.frombuffer(payload, np.uint8)
+                    if have_attrs is None:
+                        have_attrs = dict(attrs or {})
+                        frozen_v = _v_parse(
+                            (attrs or {}).get(VERSION_ATTR))
+                elif perr in (errno.ENOENT,):
+                    miss.append((s, o))
+                else:
+                    ok = False  # unreachable member: retry next pass
+            if not miss:
+                continue
+            # prior-interval members as clone SOURCES (never targets):
+            # a freshly-backfilled member got the HEAD pushed but its
+            # clone shard only ever existed on the old acting set
+            for s, o in prior_pairs or ():
+                if s in have:
+                    continue
+                try:
+                    payload, attrs, _e = await self._read_shard_quiet(
+                        pool, pg, s, o, oid, snap=cl.id)
+                except (OSError, asyncio.TimeoutError, ConnectionError):
+                    continue
+                if payload is not None:
+                    have[s] = np.frombuffer(payload, np.uint8)
+                    if have_attrs is None:
+                        have_attrs = dict(attrs or {})
+                        frozen_v = _v_parse(
+                            (attrs or {}).get(VERSION_ATTR))
+            filed: set[tuple[int, int]] = set()
+            if frozen_v is not None:
+                for s, o in miss:
+                    st = state.get((s, o))
+                    if not (st and st[0] and st[1] == frozen_v):
+                        continue
+                    payload, attrs, _e = await self._read_shard_quiet(
+                        pool, pg, s, o, oid)
+                    if payload is None:
+                        continue
+                    at = dict(attrs or {})
+                    at.pop(SS_ATTR, None)  # clones carry snaps, not SS
+                    if have_attrs and SNAPS_ATTR in have_attrs:
+                        at[SNAPS_ATTR] = have_attrs[SNAPS_ATTR]
+                    try:
+                        await self._push(pool, pg, s, o, oid, payload,
+                                         at, snap=cl.id)
+                        filed.add((s, o))
+                    except (OSError, asyncio.TimeoutError,
+                            ConnectionError):
+                        ok = False
+            remaining = [m for m in miss if m not in filed]
+            if not remaining:
+                continue
+            if len(have) >= k:
+                try:
+                    rebuilt = await ecutil.decode_shards_async(
+                        sinfo, ec, dict(have),
+                        {s for s, _o in remaining},
+                        service=self.encode_service,
+                        aggregator=self.decode_aggregator,
+                    )
+                except Exception:
+                    log.exception(
+                        "osd.%d: clone %s/%s@%d decode failed",
+                        self.id, pg, oid, cl.id)
+                    ok = False
+                    continue
+                for s, o in remaining:
+                    if s not in rebuilt:
+                        continue
+                    try:
+                        await self._push(
+                            pool, pg, s, o, oid,
+                            rebuilt[s].tobytes(),
+                            dict(have_attrs or {}), snap=cl.id)
+                    except (OSError, asyncio.TimeoutError,
+                            ConnectionError):
+                        ok = False
+            else:
+                log.warning(
+                    "osd.%d: clone %s/%s@%d has %d/%d shards and no "
+                    "filing candidate: snap unrecoverable",
+                    self.id, pg, oid, cl.id, len(have), k)
         return ok
 
     async def _recovery_delete(
@@ -1203,12 +1524,28 @@ class RecoveryMixin:
             clear_merge=clear_merge,
         ), tid)
 
-    async def _pg_log_send(self, pool, pg, shard, osd, entries, tail) -> None:
+    async def _pg_log_send(self, pool, pg, shard, osd, entries, tail,
+                           clear_floor: bool = False) -> None:
         tid = next(self._tids)
         await self._sub_op(osd, MOSDPGLog(
             tid=tid, pg=pg, shard=shard, from_osd=self.id,
             entries=entries, epoch=self.epoch, tail=tail,
+            clear_floor=clear_floor,
         ), tid)
+
+    @staticmethod
+    def _peer_effective_lu(info) -> eversion_t:
+        """What a peer's log can VOUCH for: its last_update, floored
+        by its reported contiguity gap (see PGLog.contig_floor)."""
+        lu = info.last_update
+        raw = getattr(info, "contig_floor", b"") or b""
+        if not raw:
+            return lu
+        try:
+            ep, _, ver = raw.decode().partition(".")
+            return min(eversion_t(int(ep), int(ver)), lu)
+        except ValueError:
+            return ZERO  # unreadable floor: trust nothing
 
     def _spawn_peering(self, coro) -> None:
         """Run a peering handler as its own task, strongly referenced
@@ -1237,6 +1574,35 @@ class RecoveryMixin:
         while (self.epoch < epoch and loop.time() < deadline
                and not self.stopping):
             await asyncio.sleep(0.05)
+
+    def _self_audit_missing(self, pool, pg, shard, lg) -> list[str]:
+        """Oids this member's OWN log claims at versions its store
+        does not serve (reference pg_missing_t, rebuilt log-vs-store).
+        Log entries travel without object data — adoption while
+        briefly primary, post-pass MOSDPGLog sync — so last_update can
+        run ahead of the store; this audit is the persisted truth the
+        peering exchange must carry (root cause of the stale-shard
+        scrub flake: a log-current/object-stale member was invisible
+        to the primary's missing_from scoping).  Bounded by the
+        trimmed log length; store reads are local."""
+        c = self._shard_coll(pool, pg, shard)
+        latest: dict[str, pg_log_entry_t] = {}
+        for v in sorted(lg.entries):
+            latest[lg.entries[v].oid] = lg.entries[v]
+        out: list[str] = []
+        for oid, e in latest.items():
+            o = ghobject_t(oid, shard=shard)
+            try:
+                if e.op == DELETE:
+                    continue  # absence is the logged state
+                if not self.store.collection_exists(c) \
+                        or not self.store.exists(c, o):
+                    out.append(oid)
+                elif self._object_version(c, o) < e.version:
+                    out.append(oid)
+            except OSError:
+                out.append(oid)  # unreadable counts as missing
+        return out
 
     async def _handle_pg_query(self, msg: MOSDPGQuery) -> None:
         await self._wait_for_epoch(msg.epoch)
@@ -1270,6 +1636,9 @@ class RecoveryMixin:
             entries=entries, objects=objects, epoch=self.epoch,
             past_acting=_json.dumps(chain).encode() if chain else b"",
             merge_pending=self._merge_pending(c, lg),
+            missing=self._self_audit_missing(pool, msg.pg, msg.shard, lg),
+            contig_floor=(lg.contig_floor.key().encode()
+                          if lg.contig_floor is not None else b""),
         ))
 
     async def _handle_pg_log(self, msg: MOSDPGLog) -> None:
@@ -1282,8 +1651,15 @@ class RecoveryMixin:
         lg.set_tail(t, msg.tail)
         for raw in msg.entries:
             e = pg_log_entry_t.decode(raw)
-            if e.version > lg.info.last_update:
-                lg.append(t, e)
+            if e.version > msg.tail:
+                # fill, not append: a gapped log heals by receiving
+                # the entries it MISSED (at or below last_update) as
+                # well as the new tail — see PGLog.fill
+                lg.fill(t, e)
+        if msg.clear_floor:
+            # the primary verified every object through our gap and
+            # shipped the entries above: last_update is truthful again
+            lg.clear_contig_floor(t)
         lg.trim(t, self._log_keep)
         if not t.empty():
             self.store.queue_transaction(t)

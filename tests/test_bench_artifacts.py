@@ -235,6 +235,103 @@ def test_load_artifact_green_and_replayable():
         "artifact-proven")
 
 
+def test_chaos_production_weirdness_artifact():
+    """The production-weirdness matrix (r13): >= 9 scenarios x >= 8
+    seeds all green, including the three new fronts —
+
+    - **client-netem**: the ack-aware oracle judged green with PROOF a
+      client-link partition verifiably fired in every run (an armed
+      rule nothing hit proves nothing);
+    - **fullness-pressure**: every rung of the gating ladder observed
+      live (NEARFULL/BACKFILLFULL health, backfill paused on
+      REJECT_TOOFULL, ENOSPC bounce at FULL), the failsafe never
+      breached, and the ladder cleared after the drain;
+    - **compose_load**: a deterministic load trace replayed THROUGH
+      the thrash trace with the harness's whole gate set green
+      (payload sweep, per-tenant QoS rows, SLO percentiles, mgr
+      cross-check, cold_launches == 0, host_transfers == 0)."""
+    cited = _chaos_artifacts()
+    assert any("r13" in n for n in cited), (
+        "CHAOS_r13 (production-weirdness matrix) must stay cited")
+    name = next(n for n in sorted(cited) if "r13" in n)
+    with open(os.path.join(REPO, name)) as f:
+        doc = json.load(f)
+    assert len(doc["scenarios"]) >= 9, doc["scenarios"]
+    for required in ("client-netem", "fullness-pressure",
+                     "compose_load"):
+        assert required in doc["scenarios"], required
+    assert len(doc["seeds"]) >= 8
+    assert doc["summary"]["all_green"], doc["summary"]
+    judged = {"client-netem": 0, "fullness-pressure": 0,
+              "compose_load": 0}
+    for r in doc["runs"]:
+        assert r["ok"], r
+        if r["scenario"] == "client-netem":
+            judged["client-netem"] += 1
+            assert r["invariants"]["client_netem"]["ok"], r
+            obs = r.get("client_netem_obs", {})
+            assert obs.get("client_partitioned_sends", 0) > 0, r
+        elif r["scenario"] == "fullness-pressure":
+            judged["fullness-pressure"] += 1
+            assert r["invariants"]["fullness"]["ok"], r
+            obs = r.get("fullness_obs", {})
+            for key in ("nearfull_raised", "backfillfull_raised",
+                        "full_raised", "enospc_bounced",
+                        "ladder_cleared"):
+                assert obs.get(key), (key, r)
+            assert obs.get("backfill_rejects", 0) > 0, r
+            assert obs.get("failsafe_peak", 1.0) < obs.get(
+                "failsafe_ratio", 0.0), r
+        elif r["scenario"] == "compose_load":
+            judged["compose_load"] += 1
+            assert r["invariants"]["load"]["ok"], r
+            load = r.get("load", {})
+            assert load.get("ok"), load
+            assert load.get("verify", {}).get("mismatches") == 0
+            assert load.get("client_vs_mgr", {}).get("agree"), load
+            assert load.get("cold_launches") == 0
+            assert load.get("host_transfers") == 0
+            assert any(row.get("admitted")
+                       for row in (load.get("qos") or {}).values()), load
+    for scenario, n in judged.items():
+        assert n >= 8, (scenario, n)
+
+
+def test_composed_load_artifact_under_thrash():
+    """chaos x loadgen composition committed as a LOAD artifact: at
+    least one cited LOAD artifact must carry runs with a ``chaos``
+    block — a load trace replayed THROUGH a thrash trace — covering
+    >= 2 profiles including the RMW-heavy EC one, with the chaos
+    trace hash re-deriving bit-identically from (scenario, seed)."""
+    from ceph_tpu.chaos.runner import SCENARIOS
+    from ceph_tpu.chaos.schedule import generate_schedule, trace_hash
+
+    cited = sorted(
+        n for n in _readme_artifacts() if n.startswith("LOAD_"))
+    composed: list[tuple[str, dict]] = []
+    for name in cited:
+        with open(os.path.join(REPO, name)) as f:
+            doc = json.load(f)
+        for r in doc["runs"]:
+            if r.get("chaos"):
+                composed.append((name, r))
+    assert composed, (
+        "a cited LOAD artifact must carry composed (chaos) runs")
+    profiles = {r["profile"] for _n, r in composed}
+    assert len(profiles) >= 2, profiles
+    assert "rmw_ec" in profiles, (
+        "the RMW-heavy EC profile must run under thrash too")
+    for name, r in composed:
+        assert r["ok"], (name, r.get("profile"), r.get("seed"))
+        ch = r["chaos"]
+        assert ch.get("invariants_ok"), (name, ch)
+        assert ch.get("events_applied", 0) > 0, (name, ch)
+        sc = SCENARIOS.get(ch.get("scenario"))
+        assert sc is not None, ch
+        assert ch["trace_hash"] == trace_hash(
+            generate_schedule(r["seed"], sc)), (name, ch)
+
+
 def test_chaos_artifact_traces_replay():
     """Determinism guard: regenerating every artifact run's schedule
     from (scenario, seed) must reproduce its recorded trace hash

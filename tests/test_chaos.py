@@ -400,7 +400,12 @@ class TestPayloadCodec:
 # -- live smoke: every builtin scenario, one seed each ---------------------
 
 class TestChaosSmoke:
-    @pytest.mark.parametrize("scenario", sorted(SCENARIOS))
+    # compose_load boots the loadgen harness on top of the cluster —
+    # the slow sweep + the CHAOS/LOAD artifact guards carry it; the
+    # fast smoke keeps tier-1's wall clock bounded
+    @pytest.mark.parametrize(
+        "scenario",
+        sorted(n for n in SCENARIOS if n != "compose_load"))
     def test_scenario_seed0_green(self, scenario):
         from ceph_tpu.chaos.runner import run_scenario
 
@@ -442,3 +447,188 @@ class TestChaosSweepSlow:
         finally:
             loop.close()
         assert r["ok"], r["invariants"]
+
+
+# -- production-weirdness checkers (client-netem / fullness / load) --------
+
+class TestClientNetemChecker:
+    def _obs(self, **kw):
+        base = {
+            "client_events": 3,
+            "netem": {"client_partitioned_sends": 4,
+                      "client_dropped_sends": 1,
+                      "client_delayed_sends": 2},
+            "errored_writes": [],
+        }
+        base.update(kw)
+        return base
+
+    def test_clean_obs_passes(self):
+        from ceph_tpu.chaos import invariants as inv
+
+        assert inv.check_client_netem(self._obs()) == []
+
+    def test_no_scheduled_events_flagged(self):
+        from ceph_tpu.chaos import invariants as inv
+
+        out = inv.check_client_netem(self._obs(client_events=0))
+        assert [v["invariant"] for v in out] == [
+            "no_client_event_scheduled"]
+
+    def test_armed_but_unfired_partition_flagged(self):
+        from ceph_tpu.chaos import invariants as inv
+
+        out = inv.check_client_netem(self._obs(
+            netem={"client_partitioned_sends": 0}))
+        assert any(v["invariant"] == "client_partition_never_fired"
+                   for v in out)
+
+    def test_legal_and_illegal_errnos(self):
+        import errno as _errno
+
+        from ceph_tpu.chaos import invariants as inv
+
+        legal = [
+            {"pool": "rep", "oid": "o", "version": 2,
+             "errno": _errno.ETIMEDOUT, "error": "timed out"},
+            {"pool": "rep", "oid": "o", "version": 3,
+             "errno": _errno.EAGAIN, "error": "busy"},
+        ]
+        assert inv.check_client_netem(
+            self._obs(errored_writes=legal)) == []
+        bad = [{"pool": "rep", "oid": "o", "version": 4,
+                "errno": _errno.ENOENT, "error": "vanished"}]
+        out = inv.check_client_netem(self._obs(errored_writes=bad))
+        assert any(v["invariant"] == "illegal_client_error"
+                   for v in out)
+
+
+class TestFullnessChecker:
+    def _obs(self, **kw):
+        base = {
+            "nearfull_raised": True, "backfillfull_raised": True,
+            "full_raised": True, "enospc_bounced": True,
+            "backfill_rejects": 2.0, "failsafe_peak": 0.84,
+            "failsafe_ratio": 0.97, "ladder_cleared": True,
+        }
+        base.update(kw)
+        return base
+
+    def test_full_ladder_passes(self):
+        from ceph_tpu.chaos import invariants as inv
+
+        assert inv.check_fullness(self._obs()) == []
+
+    def test_each_rung_required(self):
+        from ceph_tpu.chaos import invariants as inv
+
+        for key, inv_name in (
+            ("nearfull_raised", "fullness_check_never_raised"),
+            ("backfillfull_raised", "fullness_check_never_raised"),
+            ("full_raised", "fullness_check_never_raised"),
+            ("enospc_bounced", "enospc_never_bounced"),
+            ("ladder_cleared", "fullness_never_cleared"),
+        ):
+            out = inv.check_fullness(self._obs(**{key: False}))
+            assert any(v["invariant"] == inv_name for v in out), key
+        out = inv.check_fullness(self._obs(backfill_rejects=0))
+        assert any(v["invariant"] == "backfill_never_paused"
+                   for v in out)
+
+    def test_failsafe_breach_flagged(self):
+        from ceph_tpu.chaos import invariants as inv
+
+        out = inv.check_fullness(self._obs(failsafe_peak=0.98))
+        assert any(v["invariant"] == "failsafe_breached" for v in out)
+
+
+class TestLoadChecker:
+    def _rec(self, **kw):
+        base = {
+            "latency": {"errors": 0, "overall": {
+                "p50_us": 900.0, "p95_us": 4000.0, "p99_us": 9000.0}},
+            "undrained": 0,
+            "verify": {"checked": 32, "mismatches": 0, "lost": 0},
+            "client_vs_mgr": {"agree": True},
+            "qos": {"gold": {"admitted": 50}, "bronze": {"admitted": 70}},
+            "cold_launches": 0, "host_transfers": 0,
+        }
+        base.update(kw)
+        return base
+
+    def test_green_record_passes(self):
+        from ceph_tpu.chaos import invariants as inv
+
+        assert inv.check_load(self._rec(), ["bronze", "gold"]) == []
+
+    def test_each_gate_required(self):
+        from ceph_tpu.chaos import invariants as inv
+
+        cases = [
+            (dict(latency={"errors": 3, "overall": {
+                "p50_us": 1.0, "p95_us": 1.0, "p99_us": 1.0}}),
+             "load_op_errors"),
+            (dict(undrained=2), "load_undrained"),
+            (dict(verify={"checked": 8, "mismatches": 1, "lost": 0}),
+             "load_acked_write_lost"),
+            (dict(client_vs_mgr={"agree": False}),
+             "load_mgr_crosscheck_failed"),
+            (dict(qos={"gold": {"admitted": 9}}),
+             "load_qos_rows_missing"),
+            (dict(cold_launches=1), "load_cold_launches"),
+            (dict(host_transfers=2), "load_host_transfers"),
+        ]
+        for patch, name in cases:
+            out = inv.check_load(self._rec(**patch), ["bronze", "gold"])
+            assert any(v["invariant"] == name for v in out), name
+
+
+class TestClientNetemCounters:
+    def test_client_link_verdicts_counted_separately(self):
+        """The client-netem oracle needs PROOF a rule bit a CLIENT
+        send: per-kind counters split client links out."""
+        from ceph_tpu.chaos.netem import Netem
+
+        async def drive():
+            netem = Netem()
+            netem.partition(("client", None), ("osd", None))
+            with pytest.raises(ConnectionError):
+                await netem.on_send(("client", 8), ("osd", 2))
+            with pytest.raises(ConnectionError):
+                await netem.on_send(("osd", 2), ("client", 8))
+            netem.clear()
+            netem.drop_oneway(("osd", None), ("client", None))
+            assert not await netem.on_send(("osd", 1), ("client", 8))
+            # an osd<->osd link under the same shim counts only the
+            # generic buckets
+            netem.clear()
+            netem.partition(("osd", 0), ("osd", 1))
+            with pytest.raises(ConnectionError):
+                await netem.on_send(("osd", 0), ("osd", 1))
+            return netem.stats
+
+        stats = asyncio.new_event_loop().run_until_complete(drive())
+        assert stats["client_partitioned_sends"] == 2
+        assert stats["client_dropped_sends"] == 1
+        assert stats["partitioned_sends"] == 3
+        assert stats["dropped_sends"] == 1
+
+
+class TestWorkloadSnapRecords:
+    def test_snap_removal_marks_record_and_skips_final_read(self):
+        from ceph_tpu.chaos.workload import History, Workload
+
+        h = History()
+        h.record_snap("ec", "o1", 7, 2)
+        h.record_snap("ec", "o2", 8, 2)
+        h.mark_snap_removed("ec", "o1", 7)
+        assert [s["removed"] for s in h.snaps] == [True, False]
+
+    def test_snap_remove_partition_is_deterministic(self):
+        from ceph_tpu.chaos.workload import Workload
+
+        picks = {oid: Workload._snap_remove_for(oid)
+                 for oid in (f"ec-obj{i}" for i in range(8))}
+        assert picks == {oid: Workload._snap_remove_for(oid)
+                        for oid in picks}
+        assert any(picks.values()) and not all(picks.values())

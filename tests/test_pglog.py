@@ -196,3 +196,73 @@ class TestMergeFrom:
             (e for e in tgt.entries.values() if e.oid == "y"),
             key=lambda e: e.version)
         assert newest.op == DELETE
+
+
+class TestContigFloor:
+    """The log-contiguity floor: pg version counters are dense, so an
+    append that skips counters means ops this member never saw — its
+    last_update must stop vouching past the gap (the stale-shard
+    flake's persisted evidence)."""
+
+    def test_contiguous_appends_keep_no_floor(self, store):
+        log = PGLog(C)
+        for v in range(1, 4):
+            applied(log, store, pg_log_entry_t(MODIFY, f"o{v}", ev(1, v)))
+        assert log.contig_floor is None
+        assert log.effective_last_update() == ev(1, 3)
+
+    def test_gap_pins_floor_at_pre_append_last_update(self, store):
+        log = PGLog(C)
+        applied(log, store, pg_log_entry_t(MODIFY, "a", ev(1, 1)))
+        applied(log, store, pg_log_entry_t(MODIFY, "a", ev(1, 2)))
+        # counters 3..4 happened elsewhere while this member was down
+        applied(log, store, pg_log_entry_t(MODIFY, "b", ev(2, 5)))
+        assert log.contig_floor == ev(1, 2)
+        assert log.effective_last_update() == ev(1, 2)
+        assert log.info.last_update == ev(2, 5)
+        # a second gap never LOWERS an existing floor
+        applied(log, store, pg_log_entry_t(MODIFY, "c", ev(2, 9)))
+        assert log.contig_floor == ev(1, 2)
+
+    def test_floor_survives_reload(self, store):
+        log = PGLog(C)
+        applied(log, store, pg_log_entry_t(MODIFY, "a", ev(1, 1)))
+        applied(log, store, pg_log_entry_t(MODIFY, "b", ev(2, 4)))
+        assert log.contig_floor == ev(1, 1)
+        fresh = PGLog(C)
+        fresh.load(store)
+        assert fresh.contig_floor == ev(1, 1)
+        assert fresh.info.last_update == ev(2, 4)
+
+    def test_clear_floor_persists(self, store):
+        log = PGLog(C)
+        applied(log, store, pg_log_entry_t(MODIFY, "a", ev(1, 1)))
+        applied(log, store, pg_log_entry_t(MODIFY, "b", ev(2, 4)))
+        t = Transaction()
+        log.clear_contig_floor(t)
+        store.queue_transaction(t)
+        assert log.contig_floor is None
+        fresh = PGLog(C)
+        fresh.load(store)
+        assert fresh.contig_floor is None
+
+    def test_fill_inserts_missed_history(self, store):
+        """fill() accepts entries at or below last_update — the
+        post-recovery log sync hands a gapped member the window it
+        missed, so its own future missing_from() sees whole history."""
+        log = PGLog(C)
+        applied(log, store, pg_log_entry_t(MODIFY, "a", ev(1, 1)))
+        applied(log, store, pg_log_entry_t(MODIFY, "b", ev(2, 4)))
+        t = Transaction()
+        log.fill(t, pg_log_entry_t(MODIFY, "hole", ev(1, 2), reqid="r2"))
+        log.fill(t, pg_log_entry_t(MODIFY, "hole", ev(2, 3)))
+        store.queue_transaction(t)
+        assert ev(1, 2) in log.entries and ev(2, 3) in log.entries
+        assert log.info.last_update == ev(2, 4)  # unchanged
+        assert log.reqids.get("r2") == ev(1, 2)  # dup window learns it
+        fresh = PGLog(C)
+        fresh.load(store)
+        assert ev(1, 2) in fresh.entries
+        # a behind-peer delta now includes the once-missing window
+        miss = log.missing_from(ev(1, 1))
+        assert "hole" in miss.items

@@ -46,6 +46,19 @@ def main(argv=None) -> int:
         "--time-scale", type=float, default=1.0,
         help="stretch/compress the virtual event timeline")
     ap.add_argument(
+        "--profile", default=None,
+        help="chaos x load COMPOSITION: replay these loadgen "
+        "profile(s) (comma-separated) THROUGH the thrash trace of "
+        "each scenario/seed in one run; --out then writes a "
+        "loadgen-schema artifact whose runs carry a chaos block "
+        "(default scenario: compose_load)")
+    ap.add_argument(
+        "--clients", type=int, default=None,
+        help="with --profile: override the profile's client count")
+    ap.add_argument(
+        "--ops", type=int, default=None,
+        help="with --profile: override ops per client")
+    ap.add_argument(
         "--out", default=None,
         help="write the aggregate artifact JSON here")
     ap.add_argument(
@@ -75,6 +88,9 @@ def main(argv=None) -> int:
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    if args.profile is not None:
+        return _run_composed(args)
 
     names = (
         sorted(SCENARIOS) if args.scenarios == "all"
@@ -118,6 +134,74 @@ def main(argv=None) -> int:
             f.write("\n")
         print(f"wrote {args.out}")
     return 0 if s["all_green"] else 1
+
+
+def _run_composed(args) -> int:
+    """chaos x load composition: for each profile x seed, run the
+    composed scenario (its thrash trace + the profile's load trace in
+    ONE run) and emit a loadgen-schema artifact whose runs carry the
+    chaos verdicts — the production-is-both-at-once proof."""
+    from ceph_tpu.chaos.runner import SCENARIOS, run_sweep
+    from ceph_tpu.loadgen.report import build_artifact
+
+    base_name = (args.scenarios if args.scenarios != "all"
+                 else "compose_load")
+    if "," in base_name or base_name not in SCENARIOS:
+        print(f"chaos_run: --profile needs ONE composed scenario "
+              f"(got {base_name!r})", file=sys.stderr)
+        return 2
+    seeds = ([args.seed] if args.seed is not None
+             else list(range(args.seeds)))
+    load_recs = []
+    for prof in [p for p in args.profile.split(",") if p]:
+        sc = dict(SCENARIOS[base_name])
+        sc["load_profile"] = {
+            "profile": prof, "clients": args.clients,
+            "ops_per_client": args.ops,
+        }
+        art = run_sweep([base_name], seeds, time_scale=args.time_scale,
+                        scenarios={base_name: sc})
+        for run in art["runs"]:
+            rec = run.get("load")
+            if rec is None:
+                rec = {"profile": prof, "seed": run["seed"],
+                       "ok": False,
+                       "error": run.get("crash", "no load record")}
+            else:
+                rec = dict(rec)
+            rec["chaos"] = {
+                "scenario": run["scenario"],
+                "trace_hash": run.get("trace_hash"),
+                "events_applied": run.get("events_applied"),
+                "invariants_ok": run.get("ok", False),
+                "netem": run.get("netem", {}),
+            }
+            # a composed run is green only when BOTH planes are
+            rec["ok"] = bool(rec.get("ok")) and bool(run.get("ok"))
+            load_recs.append(rec)
+            lat = (rec.get("latency") or {}).get("overall", {})
+            print(f"{prof:<14} seed={rec['seed']:<3} "
+                  f"{'green' if rec['ok'] else 'RED':<6} "
+                  f"ops={rec.get('ops_completed', '?')} "
+                  f"p99={lat.get('p99_us', '?')}us "
+                  f"chaos_events={rec['chaos']['events_applied']} "
+                  f"trace={str(rec['chaos']['trace_hash'])[:12]}")
+            if not rec["ok"] and not run.get("ok"):
+                bad = run.get("crash") or {
+                    k: v["violations"]
+                    for k, v in run.get("invariants", {}).items()
+                    if not v["ok"]
+                }
+                print(f"  -> {json.dumps(bad, default=str)[:400]}")
+    doc = build_artifact(load_recs)
+    green = doc["summary"]["green"]
+    print(f"\n{green}/{doc['summary']['total']} composed runs green")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True, default=str)
+            f.write("\n")
+        print(f"wrote {args.out}")
+    return 0 if doc["summary"]["all_green"] else 1
 
 
 if __name__ == "__main__":
