@@ -24,7 +24,15 @@ hand-built violating histories without booting a cluster (the
 - :func:`check_cold_launches` — the decode/scrub batchers minted ZERO
   cold XLA launches during chaos (recovery under failure must run on
   prewarmed shapes; a compile in the I/O path is a perf regression
-  the thrash would otherwise hide).
+  the thrash would otherwise hide);
+- :func:`check_domains` — CRUSH actually separated shards across
+  failure domains: pre-kill snapshots show no PG of a rack-domain
+  pool mapped two shards into one rack, and whole-rack loss left
+  every PG >= k data shards / >= 1 replica to serve from;
+- :func:`check_backfill` — the soak run genuinely exercised the
+  backfill path: the ``backfill_started``/``backfill_completed``
+  perf-counter pair moved, and when an interrupt was scripted at
+  least one pass was cut short and re-run to completion.
 """
 
 from __future__ import annotations
@@ -573,9 +581,94 @@ def check_load(rec: dict, expected_tenants: list[str]) -> list[dict]:
     return out
 
 
+def check_domains(obs: list[dict], expect_kill: bool = True) -> list[dict]:
+    """Judge the failure-domain snapshots taken before correlated kills.
+
+    Each record is a :meth:`ChaosCluster._domains_snapshot` — taken at
+    the instant a rack/host kill fires, BEFORE the members die, so the
+    placement it captures is the one the acked writes relied on.  Two
+    claims per rack-domain pool:
+
+    - separation: CRUSH put at most ONE shard/replica of any PG into
+      any single rack (``max_shards_per_domain <= 1``) — otherwise a
+      whole-rack loss could take out two shards of the same stripe and
+      the durability story is fiction;
+    - survivability: after deleting every OSD of the killed rack(s),
+      every PG still holds >= ``need`` shards (k for EC, 1 replica for
+      replicated), so every acked write stays readable through the
+      correlated loss.
+    """
+    out: list[dict] = []
+    if expect_kill and not obs:
+        out.append({
+            "invariant": "domains_no_kill_observed",
+            "detail": "rack_script scenario recorded no rack/host kill "
+                      "snapshots — the correlated-failure beat never fired",
+        })
+    for rec in obs:
+        for name, p in (rec.get("pools") or {}).items():
+            if p.get("max_shards_per_domain", 0) > 1:
+                out.append({
+                    "invariant": "domains_not_separated",
+                    "detail": f"pool {name}: {p['max_shards_per_domain']} "
+                              f"shards of one PG share a rack "
+                              f"(kill={rec.get('killed_racks')})",
+                })
+            surv = p.get("min_surviving_shards")
+            if surv is not None and surv < p.get("need", 1):
+                out.append({
+                    "invariant": "domains_insufficient_survivors",
+                    "detail": f"pool {name}: only {surv} shard(s) survive "
+                              f"rack loss {rec.get('killed_racks')}, "
+                              f"need {p.get('need', 1)}",
+                })
+    return out
+
+
+def check_backfill(obs: dict) -> list[dict]:
+    """Judge a soak run's backfill evidence.
+
+    ``obs`` is a cluster-wide delta of the ``backfill_started`` /
+    ``backfill_completed`` perf counters across the run (the counters
+    are process-global, so daemon restarts do not reset them).  A
+    soak run exists to force the backfill path — trim pressure must
+    have pushed the log tail past the revived member — so:
+
+    - ``backfill_started > 0``: recovery actually took the backfill
+      branch (if log-delta recovery sufficed, the trim pressure or
+      outage length is miscalibrated and the scenario proves nothing);
+    - ``backfill_completed > 0``: at least one pass converged;
+    - with an interrupt scripted, ``started > completed``: the
+      mid-transfer kill landed inside a pass (the cut-short pass
+      starts but never completes; the re-run after revive does both).
+    """
+    out: list[dict] = []
+    started = obs.get("backfill_started", 0)
+    completed = obs.get("backfill_completed", 0)
+    if started <= 0:
+        out.append({
+            "invariant": "backfill_never_ran",
+            "detail": "backfill_started delta == 0: recovery never took "
+                      "the backfill path despite soak trim pressure",
+        })
+    if completed <= 0:
+        out.append({
+            "invariant": "backfill_never_completed",
+            "detail": f"backfill_completed delta == 0 "
+                      f"(started={started}): no pass converged",
+        })
+    if obs.get("interrupt_scripted") and started <= completed:
+        out.append({
+            "invariant": "backfill_never_interrupted",
+            "detail": f"started={started} <= completed={completed}: the "
+                      f"scripted mid-transfer kill missed every pass",
+        })
+    return out
+
+
 #: checker registry: name -> callable, for reporting
 ALL_INVARIANTS = (
     "history", "final_reads", "converged", "quorum", "scrub",
     "disk_faults", "cold_launches", "mgr", "slow_osd", "events",
-    "client_netem", "fullness", "load",
+    "client_netem", "fullness", "load", "domains", "backfill",
 )

@@ -298,6 +298,109 @@ SCENARIOS: dict[str, dict] = {
         ],
         "workload": {"objects": 3, "rounds": 3, "object_size": 8192},
     },
+    # rack-scale correlated failure: a real CRUSH topology (4 racks x
+    # 1 host x 2 osds) with rack failure-domain rules on BOTH pool
+    # types — the replicated pool rides the pre-registered
+    # chaos_rack_rule, the EC pool's profile carries
+    # crush-failure-domain=rack — and the scripted skeleton kills a
+    # WHOLE rack at once, dwells, revives, then kills one host in a
+    # different rack.  check_domains proves (pre-kill) that CRUSH put
+    # at most one shard of any PG in any rack and that every PG
+    # retained >= k shards / >= 1 replica through whole-rack loss;
+    # the history/final-read oracles prove every acked write survived.
+    "rack-loss": {
+        "name": "rack-loss",
+        "n_osds": 8, "n_mons": 1, "n_mgrs": 1,
+        "watch_events": True,
+        "topology": {"racks": 4, "hosts_per_rack": 1,
+                     "osds_per_host": 2},
+        "rack_script": True,
+        "host_kill_after": True,
+        "rack_dwell": 1.6,
+        "duration": 5.0, "n_events": 5,
+        "mix": {"scrub": 1.0, "deep_scrub": 0.5, "delay": 0.5},
+        "conf": {
+            "mgr_report_interval": 0.2, "mgr_digest_interval": 0.2,
+            "mgr_module_tick_interval": 0.15,
+            "mgr_progress_complete_grace": 1.0,
+        },
+        "pools": [
+            {"name": "rep", "type": "replicated", "pg_num": 4,
+             "size": 3, "failure_domain": "rack", "snaps": True},
+            {"name": "ec", "type": "erasure", "pg_num": 2,
+             "k": 2, "m": 1, "failure_domain": "rack"},
+        ],
+        # paced writers so acks are earned THROUGH the rack outage,
+        # not banked before it
+        "workload": {"objects": 3, "rounds": 6, "object_size": 8192,
+                     "write_gap": 0.5},
+    },
+    # control-plane blast radius: mon/mgr/mds links wear netem rules
+    # (delay/partition/drop toward the osd plane) while the data-plane
+    # workload runs.  The scripted skeleton guarantees one beat per
+    # plane; the mix draws more.  The oracle: the data plane is
+    # UNTOUCHED (history/final reads clean), the cluster converges,
+    # and mgr report streams resume.
+    "control-net": {
+        "name": "control-net",
+        "n_osds": 4, "n_mons": 3, "n_mgrs": 1,
+        "control_netem": True,
+        "duration": 4.0, "n_events": 8,
+        "mix": {"mon_netem": 2.0, "mgr_netem": 1.5, "mds_netem": 0.5,
+                "osd_kill": 0.5, "scrub": 0.5},
+        "pools": [
+            {"name": "rep", "type": "replicated", "pg_num": 4,
+             "size": 2, "snaps": True},
+            {"name": "ec", "type": "erasure", "pg_num": 2,
+             "k": 2, "m": 1},
+        ],
+        "workload": {"objects": 3, "rounds": 4, "object_size": 8192,
+                     "write_gap": 0.3},
+    },
+    # long-soak log-trim chaos: aggressive osd_min/max_pg_log_entries
+    # keep every pg log tiny while paced writers churn well past the
+    # trim horizon during a LONG scripted outage — so the revived
+    # member genuinely predates every surviving log tail and recovery
+    # MUST take the backfill path (not the log delta).  A second kill
+    # then lands while that backfill runs (osd_recovery_sleep paces
+    # the pass so the interrupt verifiably catches it mid-transfer);
+    # check_backfill demands the backfill_started/backfill_completed
+    # counter pair prove backfill ran, was interrupted, and still
+    # converged — with zero lost/stale reads and cold_launches == 0.
+    "soak-trim-backfill": {
+        "name": "soak-trim-backfill",
+        "n_osds": 5, "n_mons": 1, "n_mgrs": 1,
+        "watch_events": True,
+        "soak_script": True,
+        "soak_interrupt": "target",
+        "soak_outage": 5.0,
+        "duration": 10.0, "n_events": 4,
+        "mix": {"scrub": 1.0, "deep_scrub": 0.5},
+        "conf": {
+            "osd_min_pg_log_entries": 8,
+            "osd_max_pg_log_entries": 16,
+            # serialize reconciles and pace each one: pushes then land
+            # every 0.3s across the pass, so the gated interrupt kill
+            # reliably strikes BETWEEN pushes and fails the remainder
+            # (max_active 4 would finish every push in the first few
+            # ms and leave only sleeps for the kill to hit)
+            "osd_recovery_sleep": 0.3,
+            "osd_recovery_max_active": 1,
+            "mgr_report_interval": 0.2, "mgr_digest_interval": 0.2,
+            "mgr_module_tick_interval": 0.15,
+            "mgr_progress_complete_grace": 1.0,
+        },
+        "pools": [
+            {"name": "rep", "type": "replicated", "pg_num": 2,
+             "size": 2, "snaps": True},
+            {"name": "ec", "type": "erasure", "pg_num": 2,
+             "k": 2, "m": 1},
+        ],
+        # many paced writers: the stream must SPAN the whole outage so
+        # the trim horizon provably passes the down member's log
+        "workload": {"objects": 8, "rounds": 24, "object_size": 4096,
+                     "write_gap": 0.33},
+    },
 }
 
 
@@ -355,6 +458,16 @@ class ChaosCluster:
         # fill events (drain deletes them) + the watcher/fill
         # observation record check_fullness judges
         self._ballast_names: list[str] = []
+        # failure-domain placement snapshots: one record per
+        # rack/host kill, taken BEFORE the kill lands (check_domains
+        # judges that CRUSH separated shards across domains while the
+        # doomed rack was still up, and that every PG retained enough
+        # shards to survive whole-rack loss)
+        self.domains_obs: list[dict] = []
+        # baseline for the backfill-interrupt gate: perf counters are
+        # process-global, so sweep runs sharing this process must
+        # judge in-flight passes against a per-run snapshot
+        self._backfill_gate_base: tuple[float, float] = (0.0, 0.0)
         self.fullness: dict = {
             "nearfull_raised": False, "backfillfull_raised": False,
             "full_raised": False, "enospc_bounced": False,
@@ -412,8 +525,32 @@ class ChaosCluster:
         from ceph_tpu.osd.daemon import OSDDaemon
 
         sc = self.scenario
+        self._backfill_gate_base = self._backfill_totals()
         crush = CrushMap()
-        B.build_hierarchy(crush, osds_per_host=1, n_hosts=sc["n_osds"])
+        topo = sc.get("topology")
+        if topo:
+            # rack-scale failure domains: root -> rack -> host -> osd,
+            # with a pre-registered rack-separated replicated rule the
+            # mon's pool-create honors by name (EC pools get their
+            # failure domain through the profile's
+            # crush-failure-domain key instead)
+            per_host = int(topo.get("osds_per_host", 1))
+            hosts_per_rack = int(topo.get("hosts_per_rack", 1))
+            n_racks = int(topo["racks"])
+            if n_racks * hosts_per_rack * per_host != sc["n_osds"]:
+                raise ValueError(
+                    f"topology {topo} does not cover n_osds="
+                    f"{sc['n_osds']}")
+            root = B.build_rack_hierarchy(
+                crush, osds_per_host=per_host,
+                hosts_per_rack=hosts_per_rack, n_racks=n_racks)
+            rid = B.add_simple_rule(
+                crush, root.id, crush.type_id(
+                    topo.get("failure_domain", "rack")))
+            crush.rule_names["chaos_rack_rule"] = rid
+        else:
+            B.build_hierarchy(
+                crush, osds_per_host=1, n_hosts=sc["n_osds"])
         self._crush_template = crush
         n_mons = sc.get("n_mons", 1)
         self.mons = [
@@ -459,13 +596,24 @@ class ChaosCluster:
         for pool in sc.get("pools", []):
             if pool.get("type") == "erasure":
                 prof = f"chaos-{pool['name']}"
-                await self.client.ec_profile_set(prof, {
+                profile = {
                     "plugin": "jax", "k": str(pool.get("k", 2)),
                     "m": str(pool.get("m", 1)),
-                })
+                }
+                if pool.get("failure_domain"):
+                    # the profile drives create_ec_rule: one shard
+                    # per rack/host, the rack-loss scenario's proof
+                    profile["crush-failure-domain"] = (
+                        pool["failure_domain"])
+                await self.client.ec_profile_set(prof, profile)
                 await self.client.pool_create(
                     pool["name"], pg_num=pool.get("pg_num", 2),
                     pool_type="erasure", erasure_code_profile=prof)
+            elif pool.get("failure_domain"):
+                # replicated pools ride the pre-registered rack rule
+                await self.client.pool_create(
+                    pool["name"], pg_num=pool.get("pg_num", 4),
+                    size=pool.get("size", 2), rule="chaos_rack_rule")
             else:
                 await self.client.pool_create(
                     pool["name"], pg_num=pool.get("pg_num", 4),
@@ -539,54 +687,102 @@ class ChaosCluster:
                     "error": f"{type(e).__name__}: {e}",
                 })
 
+    async def _kill_osd(self, osd_id: int) -> None:
+        osd = self.osds[osd_id]
+        if osd is not None:
+            # an injected kill IS an unclean death: the daemon
+            # persists a crash dump the way a SIGKILL'd reference
+            # daemon leaves one for ceph-crash to post
+            if not osd.stopping:
+                osd.record_crash(
+                    reason="chaos: injected daemon kill")
+                self._note_death(f"osd.{osd_id}")
+            # keep the store: revive is a daemon restart (the
+            # reference thrasher's revive keeps the disk too).
+            # Wiping here would let TWO sequential kills destroy
+            # more shards than m — the second kill lands before the
+            # first revive's rebuild finishes, and that is operator
+            # data loss, not a cluster bug
+            self._stashed_stores = getattr(self, "_stashed_stores", {})
+            self._stashed_stores[osd_id] = osd.store
+            await osd.stop()
+            self.osds[osd_id] = None
+
+    async def _revive_osd(self, osd_id: int) -> None:
+        cur = self.osds[osd_id]
+        if cur is not None and cur.stopping:
+            # the daemon died on its own (read-error-ledger disk
+            # escalation — its _escalate path already wrote the
+            # crash dump): stash its store and treat it as killed
+            # so the revive below restarts it
+            self._note_death(f"osd.{osd_id}")
+            self._stashed_stores = getattr(self, "_stashed_stores", {})
+            self._stashed_stores[osd_id] = cur.store
+            self.osds[osd_id] = None
+        if self.osds[osd_id] is None:
+            from ceph_tpu.osd.daemon import OSDDaemon
+
+            store = getattr(self, "_stashed_stores", {}).pop(
+                osd_id, None)
+            osd = OSDDaemon(osd_id, list(self.monmap), store=store,
+                            conf=self._conf())
+            self.netem.attach(osd.messenger)
+            await osd.start()
+            self.osds[osd_id] = osd
+            # missed-write catch-up recovery (log replay / decode
+            # toward the restarted member) runs from the new map;
+            # data-LOSS rebuilds are exercised by osd_out remaps
+            # (backfill + EC decode onto fresh members)
+
     async def _apply(self, ev) -> None:
         a = ev.args
         kind = ev.kind
         if kind == "osd_kill":
-            osd = self.osds[a["osd"]]
-            if osd is not None:
-                # an injected kill IS an unclean death: the daemon
-                # persists a crash dump the way a SIGKILL'd reference
-                # daemon leaves one for ceph-crash to post
-                if not osd.stopping:
-                    osd.record_crash(
-                        reason="chaos: injected daemon kill")
-                    self._note_death(f"osd.{a['osd']}")
-                # keep the store: revive is a daemon restart (the
-                # reference thrasher's revive keeps the disk too).
-                # Wiping here would let TWO sequential kills destroy
-                # more shards than m — the second kill lands before the
-                # first revive's rebuild finishes, and that is operator
-                # data loss, not a cluster bug
-                self._stashed_stores = getattr(self, "_stashed_stores", {})
-                self._stashed_stores[a["osd"]] = osd.store
-                await osd.stop()
-                self.osds[a["osd"]] = None
+            if a.get("await_backfill"):
+                await self._await_backfill_inflight()
+            await self._kill_osd(a["osd"])
         elif kind == "osd_revive":
-            cur = self.osds[a["osd"]]
-            if cur is not None and cur.stopping:
-                # the daemon died on its own (read-error-ledger disk
-                # escalation — its _escalate path already wrote the
-                # crash dump): stash its store and treat it as killed
-                # so the revive below restarts it
-                self._note_death(f"osd.{a['osd']}")
-                self._stashed_stores = getattr(self, "_stashed_stores", {})
-                self._stashed_stores[a["osd"]] = cur.store
-                self.osds[a["osd"]] = None
-            if self.osds[a["osd"]] is None:
-                from ceph_tpu.osd.daemon import OSDDaemon
-
-                store = getattr(self, "_stashed_stores", {}).pop(
-                    a["osd"], None)
-                osd = OSDDaemon(a["osd"], list(self.monmap), store=store,
-                                conf=self._conf())
-                self.netem.attach(osd.messenger)
-                await osd.start()
-                self.osds[a["osd"]] = osd
-                # missed-write catch-up recovery (log replay / decode
-                # toward the restarted member) runs from the new map;
-                # data-LOSS rebuilds are exercised by osd_out remaps
-                # (backfill + EC decode onto fresh members)
+            await self._revive_osd(a["osd"])
+        elif kind in ("rack_kill", "host_kill"):
+            # correlated loss: every member of one failure domain dies
+            # in the same beat.  check_domains snapshots the acting
+            # sets FIRST — the proof CRUSH separated shards across
+            # domains must predate the kill it survives
+            if self.scenario.get("topology"):
+                self.domains_obs.append(self._domains_snapshot(
+                    killed=list(a["osds"]), kind=kind))
+            for o in a["osds"]:
+                await self._kill_osd(o)
+        elif kind == "rack_revive":
+            for o in a["osds"]:
+                await self._revive_osd(o)
+        elif kind in ("mon_netem", "mgr_netem", "mds_netem"):
+            ent = {
+                "mon_netem": ("mon", a.get("rank", 0)),
+                "mgr_netem": ("mgr", a.get("mgr", 0)),
+                "mds_netem": ("mds", a.get("mds", 0)),
+            }[kind]
+            wild = ("osd", None)
+            mode = a.get("mode", "delay")
+            if mode == "partition":
+                self.netem.partition(ent, wild)
+                self._schedule_heal(
+                    a.get("ttl"),
+                    lambda: self.netem.heal_partition(ent, wild))
+            elif mode == "drop":
+                self.netem.drop_oneway(wild, ent)
+                self._schedule_heal(
+                    a.get("ttl"),
+                    lambda: self.netem.heal_oneway(wild, ent))
+            else:
+                # both directions: slow outbound AND inbound links
+                links = ((ent, wild), (wild, ent))
+                for s_, d_ in links:
+                    self.netem.delay(s_, d_, a.get("seconds", 0.02))
+                self._schedule_heal(
+                    a.get("ttl"),
+                    lambda: [self.netem.heal_delay(s_, d_)
+                             for s_, d_ in links])
         elif kind == "osd_out":
             await self._command({"prefix": "osd out", "id": str(a["osd"])})
         elif kind == "osd_in":
@@ -758,6 +954,100 @@ class ChaosCluster:
         elif kind == "disk_heal":
             for op in self._DISK_FAULT_OPS:
                 FAULTS.clear(f"store.{op}.osd.{osd_id}")
+
+    # -- backfill-interrupt machinery -----------------------------------
+
+    def _backfill_totals(self) -> tuple[float, float]:
+        """Cluster-wide (backfill_started, backfill_completed) sums.
+        The counters are process-global, so a baseline snapshot is
+        taken at cluster start and deltas are judged against it."""
+        from ceph_tpu.common.metrics import get_perf_counters
+        s = c = 0.0
+        for i in range(self.scenario["n_osds"]):
+            d = get_perf_counters(f"osd.{i}").dump()
+            s += d.get("backfill_started", 0.0)
+            c += d.get("backfill_completed", 0.0)
+        return s, c
+
+    async def _await_backfill_inflight(self, timeout: float = 10.0) -> None:
+        """Hold a scripted interrupt kill until a backfill pass is
+        verifiably in flight (started > completed, judged against the
+        run's baseline) so the kill lands MID-TRANSFER instead of
+        racing the revived member's boot.  Every completed pass bumps
+        both counters equally, so a positive delta means a pass is
+        running right now.  This gates DELIVERY of one trace event on
+        cluster state — the trace itself (times, kinds, args, hash)
+        stays pure in (seed, scenario).  On timeout the kill proceeds
+        anyway and check_backfill reports the miss honestly."""
+        base_s, base_c = self._backfill_gate_base
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while loop.time() < deadline:
+            s, c = self._backfill_totals()
+            if (s - base_s) > (c - base_c):
+                return
+            await asyncio.sleep(0.02)
+        log.warning("await_backfill: no pass in flight after %.1fs — "
+                    "killing anyway", timeout)
+
+    # -- failure-domain machinery ---------------------------------------
+
+    def _rack_of(self, osd_id: int) -> int:
+        """Topology scenarios place osd ids densely: rack r holds
+        osds [r*per_rack, (r+1)*per_rack)."""
+        topo = self.scenario["topology"]
+        per_rack = (int(topo.get("osds_per_host", 1))
+                    * int(topo.get("hosts_per_rack", 1)))
+        return osd_id // per_rack
+
+    def _domains_snapshot(self, killed: list[int],
+                          kind: str = "rack_kill") -> dict:
+        """Pre-kill placement evidence for check_domains: for every
+        rack-failure-domain pool, how CRUSH spread each PG's acting
+        set across racks, and how many shards survive once the doomed
+        rack goes dark."""
+        from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+        from ceph_tpu.osd.types import pg_t
+
+        om = self.client.osdmap
+        killed_racks = sorted({self._rack_of(o) for o in killed})
+        rec: dict = {
+            "kind": kind, "killed_osds": sorted(killed),
+            "killed_racks": killed_racks, "pools": {},
+        }
+        for pool in self.scenario.get("pools", []):
+            if pool.get("failure_domain") != "rack":
+                continue
+            pid = om.lookup_pg_pool_name(pool["name"])
+            if pid < 0:
+                continue
+            pl = om.pools[pid]
+            need = (pool.get("k", 2)
+                    if pool.get("type") == "erasure" else 1)
+            worst = 0
+            min_surviving = None
+            for ps in range(pl.pg_num):
+                _u, _up, acting, _pri = om.pg_to_up_acting_osds(
+                    pg_t(pid, ps), folded=True)
+                members = [o for o in acting if o != CRUSH_ITEM_NONE]
+                per: dict[int, int] = {}
+                for o in members:
+                    r = self._rack_of(o)
+                    per[r] = per.get(r, 0) + 1
+                if per:
+                    worst = max(worst, max(per.values()))
+                surv = sum(1 for o in members
+                           if self._rack_of(o) not in killed_racks)
+                min_surviving = (surv if min_surviving is None
+                                 else min(min_surviving, surv))
+            rec["pools"][pool["name"]] = {
+                "type": pool.get("type", "replicated"),
+                "pg_num": pl.pg_num,
+                "max_shards_per_domain": worst,
+                "min_surviving_shards": min_surviving,
+                "need": need,
+            }
+        return rec
 
     # -- fullness-pressure machinery -----------------------------------
 
@@ -1180,6 +1470,56 @@ async def _watch_fullness(cluster, obs, perf_base) -> None:
         await asyncio.sleep(0.15)
 
 
+def _dump_wedge_state(cluster) -> None:
+    """Convergence timed out: snapshot every live OSD's recovery-side
+    state so a wedge is diagnosable from the run log alone — which pg
+    each daemon still considers unclean, who holds reservation slots,
+    and where the recovery task is parked (a silent reservation
+    livelock leaves NO log lines; this is the only witness)."""
+    from ceph_tpu.osd.pgutil import pg_t
+
+    for osd in cluster.osds:
+        if osd is None:
+            continue
+        task = getattr(osd, "_recovery_task", None)
+        frames: list[str] = []
+        state = "none"
+        if task is not None:
+            if not task.done():
+                state = "running"
+                for f in task.get_stack(limit=6):
+                    frames.append(
+                        f"{f.f_code.co_filename.rsplit('/', 1)[-1]}:"
+                        f"{f.f_lineno}:{f.f_code.co_name}")
+            elif task.cancelled():
+                state = "cancelled"
+            elif task.exception() is not None:
+                state = f"raised:{task.exception()!r}"
+            else:
+                state = "done"
+        prim: list[str] = []
+        om = osd.osdmap
+        if om is not None:
+            for pid, pool in om.pools.items():
+                for ps in range(pool.pg_num):
+                    _, _, acting, p = om.pg_to_up_acting_osds(
+                        pg_t(pid, ps), folded=True)
+                    prim.append(f"{pid}.{ps}:p{p}a{acting}")
+        log.error(
+            "wedge osd.%d: epoch=%d recovering=%s clean_epoch=%s "
+            "local_slots=%s remote_slots=%s remote_grants=%s "
+            "recovery_task=%s stack=%s map=%s",
+            osd.id, osd.epoch, sorted(osd._recovering_pgs),
+            dict(osd._clean_epoch),
+            getattr(osd.local_reserver, "in_use", "?"),
+            getattr(osd.remote_reserver, "in_use", "?"),
+            sorted(osd._remote_grants),
+            state,
+            " <- ".join(frames) or "-",
+            " ".join(prim),
+        )
+
+
 async def _settle_fullness(cluster, obs, time_scale: float) -> None:
     """Post-drain verification: the whole ladder must CLEAR — no
     fullness health check may survive the drain and settle."""
@@ -1346,6 +1686,19 @@ async def run_scenario(
         from ceph_tpu.common.fault_injector import disk_fault_counters
 
         df_before = dict(disk_fault_counters().dump())
+        backfill_base: dict | None = None
+        if scenario.get("soak_script"):
+            # perf collections are process-global (a revived daemon
+            # re-attaches to the same counters), so delta-checking
+            # across the run is restart-proof
+            from ceph_tpu.common.metrics import get_perf_counters
+
+            backfill_base = {
+                name: sum(
+                    get_perf_counters(f"osd.{i}").dump().get(name, 0.0)
+                    for i in range(scenario["n_osds"]))
+                for name in ("backfill_started", "backfill_completed")
+            }
         workload = None
         wl_task = None
         load_task = None
@@ -1455,6 +1808,7 @@ async def run_scenario(
         except TimeoutError as e:
             violations["converged"] = [{
                 "invariant": "not_converged", "detail": str(e)}]
+            _dump_wedge_state(cluster)
         violations["quorum"] = await cluster.await_quorum_agreement()
         if workload is not None:
             violations["history"] = inv.check_history(history)
@@ -1587,6 +1941,32 @@ async def run_scenario(
             violations["fullness"] = inv.check_fullness(
                 cluster.fullness)
             result["fullness_obs"] = dict(cluster.fullness)
+        if scenario.get("topology"):
+            # rack-scale failure domains: the pre-kill placement
+            # snapshots must prove CRUSH separated shards across
+            # racks AND that every PG retained enough shards to
+            # survive the whole-rack loss it was about to take
+            violations["domains"] = inv.check_domains(
+                cluster.domains_obs,
+                expect_kill=bool(scenario.get("rack_script")))
+            result["domains_obs"] = list(cluster.domains_obs)
+        if backfill_base is not None:
+            from ceph_tpu.common.metrics import get_perf_counters
+
+            backfill_obs = {
+                name: sum(
+                    get_perf_counters(f"osd.{i}").dump().get(name, 0.0)
+                    for i in range(scenario["n_osds"]))
+                - backfill_base[name]
+                for name in ("backfill_started", "backfill_completed")
+            }
+            backfill_obs["interrupt_scripted"] = bool(
+                scenario.get("soak_interrupt", "target"))
+            if events_obs is not None:
+                backfill_obs["progress_events"] = len(
+                    events_obs.get("progress_events") or {})
+            violations["backfill"] = inv.check_backfill(backfill_obs)
+            result["backfill_obs"] = dict(backfill_obs)
         violations["cold_launches"] = inv.check_cold_launches(
             cold_before, _cold_launch_snapshot())
 

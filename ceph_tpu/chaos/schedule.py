@@ -80,6 +80,23 @@ EVENT_KINDS = (
     # ratio is observed — but the TRACE stays pure in (seed, scenario))
     "fill",           # write ballast until every up osd >= args[ratio]
     "drain",          # delete ballast until usage falls below nearfull
+    # rack-scale correlated-failure verbs (CRUSH failure domains under
+    # live fire: the trace kills a WHOLE rack or host at once — args
+    # carry the member osd list so replay needs no topology lookup,
+    # and the budget guard below guarantees surviving domains always
+    # retain >= k shards / >= 1 replica)
+    "rack_kill",      # kill every osd of one rack (correlated loss)
+    "host_kill",      # kill every osd of one host
+    "rack_revive",    # revive every osd of a killed rack
+    # control-plane netem verbs: the mon/mgr/mds links join the blast
+    # radius (mode: delay / partition / drop toward the osd plane) —
+    # the data-plane ack oracle must come through untouched.  mds
+    # rules have armed-rule semantics today: chaos clusters run no
+    # MDS, so the rule verifiably arms + heals without a data-path
+    # bite (the verb exists so traces cover the whole control plane)
+    "mon_netem",      # degrade one monitor's links
+    "mgr_netem",      # degrade one manager's links
+    "mds_netem",      # degrade one mds's links (armed-rule semantics)
 )
 
 
@@ -240,6 +257,112 @@ def generate_schedule(seed: int, scenario: dict) -> list[ChaosEvent]:
         t_f = round(t_f + 0.4 + rng.uniform(0.0, 0.4), 3)
         emit(t_f, "drain")
         # the generic trace-end wholeness below emits the osd_in
+
+    # rack-scale correlated-failure skeleton: kill ONE whole failure
+    # domain — every osd of a seed-chosen rack — dwell, revive, and
+    # optionally follow with a single-host kill in a DIFFERENT rack.
+    # Budget: the surviving racks must retain >= k shards (EC) or
+    # >= 1 replica, which one-shard-per-rack placement guarantees
+    # exactly when racks - 1 >= max(k, 1); the guard refuses to emit
+    # an unsurvivable trace rather than emit one that loses data by
+    # construction.  Rack scenarios keep osd_kill/osd_out OUT of
+    # their mix so a mix draw can never double-kill a scripted victim.
+    if scenario.get("rack_script"):
+        topo = scenario["topology"]
+        per_host = int(topo.get("osds_per_host", 1))
+        hosts_per_rack = int(topo.get("hosts_per_rack", 1))
+        per_rack = per_host * hosts_per_rack
+        n_racks = int(topo["racks"])
+        need = max(
+            (p.get("k", p.get("size", 2))
+             for p in scenario.get("pools", [])), default=1)
+        if n_racks - 1 >= need:
+            rack = rng.randrange(n_racks)
+            osds = list(range(rack * per_rack, (rack + 1) * per_rack))
+            t_k = round(0.4 + rng.uniform(0.0, 0.4), 3)
+            st.alive.difference_update(osds)
+            emit(t_k, "rack_kill", rack=rack, osds=osds)
+            dwell = float(scenario.get(
+                "rack_dwell", max(0.8, duration * 0.3)))
+            t_r = round(t_k + dwell + rng.uniform(0.0, 0.3), 3)
+            st.alive.update(osds)
+            emit(t_r, "rack_revive", rack=rack, osds=osds)
+            if scenario.get("host_kill_after"):
+                # a second, smaller correlated loss after the rack
+                # revives: one whole host in a different rack (its
+                # members stay dead until trace-end wholeness)
+                other = rng.choice(
+                    [r for r in range(n_racks) if r != rack])
+                host = (other * hosts_per_rack
+                        + rng.randrange(hosts_per_rack))
+                hosds = list(range(
+                    host * per_host, (host + 1) * per_host))
+                t_h = round(t_r + 0.3 + rng.uniform(0.0, 0.3), 3)
+                st.alive.difference_update(hosds)
+                emit(t_h, "host_kill", host=host, osds=hosds)
+
+    # long-soak skeleton: ONE victim goes down early and stays down
+    # for most of the trace while the paced workload churns every pg
+    # log past the trim horizon (the scenario's conf pins tiny
+    # osd_min/max_pg_log_entries), so the revived member PREDATES
+    # every surviving log tail and recovery MUST take the backfill
+    # path — the runner's check_backfill invariant demands the
+    # backfill_started/backfill_completed counters prove it.  A
+    # second, shorter kill lands while that backfill runs (the
+    # backfill TARGET itself, or a seed-chosen live source member) to
+    # prove the persisted cursor resumes an interrupted pass.
+    if scenario.get("soak_script"):
+        victim = rng.randrange(n_osds)
+        t_k = round(0.3 + rng.uniform(0.0, 0.2), 3)
+        st.alive.discard(victim)
+        emit(t_k, "osd_kill", osd=victim)
+        dwell = float(scenario.get("soak_outage", duration * 0.55))
+        t_r = round(t_k + dwell + rng.uniform(0.0, 0.2), 3)
+        st.alive.add(victim)
+        emit(t_r, "osd_revive", osd=victim)
+        mode = scenario.get("soak_interrupt", "target")
+        if mode:
+            if mode == "target":
+                v2 = victim
+            else:
+                v2 = rng.choice(sorted(st.alive - {victim}))
+            # fire just after the revive: the runner holds THIS kill
+            # (await_backfill) until a backfill pass is verifiably in
+            # flight, so the interrupt lands mid-transfer instead of
+            # racing the revived member's boot — arming the gate
+            # BEFORE the first pass can start is what makes the
+            # mid-transfer hit deterministic (the trace itself stays
+            # pure — the gate shifts delivery, not the event)
+            t_k2 = round(t_r + 0.1 + rng.uniform(0.0, 0.1), 3)
+            st.alive.discard(v2)
+            emit(t_k2, "osd_kill", osd=v2, await_backfill=True)
+            t_r2 = round(t_k2 + 0.4 + rng.uniform(0.0, 0.3), 3)
+            st.alive.add(v2)
+            emit(t_r2, "osd_revive", osd=v2)
+
+    # control-plane blast-radius skeleton: one guaranteed beat per
+    # plane — a mon link degradation (delay when the quorum cannot
+    # spare a member, else partition), a mgr link fault, and an mds
+    # rule (armed-rule semantics) — so every trace provably put the
+    # control plane in the blast radius while the data-plane oracle
+    # earned its acks.
+    if scenario.get("control_netem"):
+        t_c = round(0.3 + rng.uniform(0.0, 0.3), 3)
+        emit(t_c, "mon_netem", rank=rng.randrange(n_mons),
+             mode="partition" if n_mons >= 3 else "delay",
+             seconds=round(rng.uniform(0.01, 0.04), 4),
+             ttl=round(rng.uniform(0.5, 1.2), 3))
+        if scenario.get("n_mgrs", 0) > 0:
+            t_c = round(t_c + 0.3 + rng.uniform(0.0, 0.3), 3)
+            emit(t_c, "mgr_netem",
+                 mgr=rng.randrange(scenario["n_mgrs"]),
+                 mode=rng.choice(["partition", "drop", "delay"]),
+                 seconds=round(rng.uniform(0.01, 0.04), 4),
+                 ttl=round(rng.uniform(0.5, 1.2), 3))
+        t_c = round(t_c + 0.3 + rng.uniform(0.0, 0.3), 3)
+        emit(t_c, "mds_netem", mds=0, mode="delay",
+             seconds=round(rng.uniform(0.01, 0.04), 4),
+             ttl=round(rng.uniform(0.5, 1.0), 3))
 
     for t in times:
         kind = rng.choices(kinds, weights=weights)[0]
@@ -405,6 +528,28 @@ def generate_schedule(seed: int, scenario: dict) -> list[ChaosEvent]:
             emit(t, "client_delay", peer=list(peer),
                  seconds=round(rng.uniform(0.005, 0.05), 4),
                  ttl=round(rng.uniform(0.3, 1.5), 3))
+        elif kind in ("mon_netem", "mgr_netem", "mds_netem"):
+            # control-plane link faults self-heal by ttl (plus the
+            # trace-end netem_clear), so they carry no trace state
+            if kind == "mon_netem":
+                who = {"rank": rng.randrange(n_mons)}
+                mode = rng.choice(["delay", "partition", "drop"])
+                if n_mons < 3 and mode == "partition":
+                    # a quorum that cannot spare a member only gets
+                    # its links SLOWED, never cut
+                    mode = "delay"
+            elif kind == "mgr_netem":
+                n_mgrs = scenario.get("n_mgrs", 0)
+                if n_mgrs < 1:
+                    continue
+                who = {"mgr": rng.randrange(n_mgrs)}
+                mode = rng.choice(["delay", "partition", "drop"])
+            else:
+                who = {"mds": 0}
+                mode = "delay"
+            emit(t, kind, mode=mode,
+                 seconds=round(rng.uniform(0.005, 0.04), 4),
+                 ttl=round(rng.uniform(0.3, 1.0), 3), **who)
         elif kind == "netem_clear":
             st.partitions.clear()
             st.oneways.clear()
@@ -446,6 +591,8 @@ def generate_schedule(seed: int, scenario: dict) -> list[ChaosEvent]:
     # scenarios' committed trace hashes encode their emission order
     # (e.g. the degraded-disk slow_disk lead precedes earlier-t mix
     # draws) and must replay bit-identically forever.
-    if scenario.get("fullness_script"):
+    if (scenario.get("fullness_script") or scenario.get("rack_script")
+            or scenario.get("soak_script")
+            or scenario.get("control_netem")):
         events.sort(key=lambda e: e.t)
     return events

@@ -96,8 +96,14 @@ declare(
     Option("osd_op_history_size", int, 20, LEVEL_ADVANCED,
            "completed ops kept for dump_historic_ops", min=0),
     Option("osd_min_pg_log_entries", int, 128, LEVEL_ADVANCED,
-           "pg log entries kept per shard", min=1,
+           "pg log entries kept per shard after a trim (the trim-to "
+           "floor; reference osd_min_pg_log_entries)", min=1,
            see_also=("osd_max_pg_log_entries",)),
+    Option("osd_max_pg_log_entries", int, 512, LEVEL_ADVANCED,
+           "pg log length that triggers a trim back down to "
+           "osd_min_pg_log_entries (reference osd_max_pg_log_entries; "
+           "low values force the backfill path on any lagging peer)",
+           min=1, see_also=("osd_min_pg_log_entries",)),
     Option("osd_recovery_max_active", int, 4, LEVEL_ADVANCED,
            "concurrent recovery reconciliations per osd", min=1),
     Option("ms_connection_ready_timeout", float, 10.0, LEVEL_ADVANCED,
@@ -137,6 +143,14 @@ declare(
            "reservation was rejected (reference "
            "osd_backfill_retry_interval, default 30s there — shorter "
            "here to match mini-cluster timescales)", min=0.0),
+    Option("osd_backfill_grant_timeout", float, 60.0, LEVEL_ADVANCED,
+           "seconds a remote backfill GRANT may sit unreleased before "
+           "the reserver-death sweep reclaims the slot (0 disables the "
+           "age check; grants whose requester the map says is down are "
+           "always swept) — a primary that dies mid-backfill can never "
+           "send its RELEASE", min=0.0,
+           see_also=("osd_backfill_retry_interval",
+                     "osd_max_backfills")),
     Option("osd_op_queue_max_inflight", int, 128, LEVEL_ADVANCED,
            "top-level ops admitted concurrently through the mClock "
            "gate; 0 disables admission control (every op runs "

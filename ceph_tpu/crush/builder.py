@@ -102,6 +102,42 @@ def build_hierarchy(
     return root
 
 
+def build_rack_hierarchy(
+    map_: CrushMap,
+    osds_per_host: int,
+    hosts_per_rack: int,
+    n_racks: int,
+    osd_weight: int = 0x10000,
+    alg: BucketAlg = BucketAlg.STRAW2,
+    host_type: int = 1,
+    rack_type: int = 3,
+    root_type: int = 10,
+) -> Bucket:
+    """root -> rack -> host -> osd tree (the rack-scale failure-domain
+    shape); registers ``rack{r}``/``host{h}``/``default`` bucket names.
+    OSD ids are dense: host h holds osds [h*per_host, (h+1)*per_host)."""
+    rack_ids = []
+    rack_weights = []
+    for r in range(n_racks):
+        host_ids = []
+        host_weights = []
+        for hh in range(hosts_per_rack):
+            h = r * hosts_per_rack + hh
+            osds = list(range(h * osds_per_host, (h + 1) * osds_per_host))
+            hb = make_bucket(
+                map_, alg, host_type, osds, [osd_weight] * osds_per_host)
+            map_.bucket_names.setdefault(f"host{h}", hb.id)
+            host_ids.append(hb.id)
+            host_weights.append(hb.weight)
+        rb = make_bucket(map_, alg, rack_type, host_ids, host_weights)
+        map_.bucket_names.setdefault(f"rack{r}", rb.id)
+        rack_ids.append(rb.id)
+        rack_weights.append(rb.weight)
+    root = make_bucket(map_, alg, root_type, rack_ids, rack_weights)
+    map_.bucket_names.setdefault("default", root.id)
+    return root
+
+
 def add_simple_rule(
     map_: CrushMap,
     root_id: int,

@@ -131,7 +131,8 @@ class ChooseArg:
 class CrushMap:
     buckets: dict[int, Bucket] = field(default_factory=dict)  # by id (negative)
     rules: dict[int, Rule] = field(default_factory=dict)
-    types: dict[int, str] = field(default_factory=lambda: {0: "osd", 1: "host", 10: "root"})
+    types: dict[int, str] = field(
+        default_factory=lambda: {0: "osd", 1: "host", 3: "rack", 10: "root"})
     max_devices: int = 0
     tunables: Tunables = field(default_factory=Tunables)
     choose_args: dict[int, ChooseArg] = field(default_factory=dict)

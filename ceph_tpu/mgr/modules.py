@@ -323,10 +323,20 @@ class ProgressModule(MgrModule):
         self._n = 0
 
     def _cluster_count(self, metric: str) -> int:
+        # a session that stopped reporting (daemon killed, link cut)
+        # keeps its LAST gauges forever; summing those would pin the
+        # cluster count at its peak and the event could never complete
+        # — count only sessions fresh within a few report periods
+        stale_after = 4.0 * self.mgr.conf["mgr_report_interval"]
+        now = time.monotonic()
         total = 0
         for daemon, sess in self.mgr.sessions.items():
-            if daemon.startswith("osd."):
-                total += int(sess.get("gauges", {}).get(metric, 0))
+            if not daemon.startswith("osd."):
+                continue
+            last = sess.get("last_report")
+            if last is None or now - last > stale_after:
+                continue
+            total += int(sess.get("gauges", {}).get(metric, 0))
         return total
 
     def _ewma_count(self, metric: str) -> float | None:

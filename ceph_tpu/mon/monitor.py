@@ -563,6 +563,23 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, MgrServiceMixin,
                     self._ingest_pg_stats(msg.osd, msg.epoch, msg.pg_stats)
                 if msg.statfs:
                     await self._ingest_statfs(msg.osd, msg.statfs)
+                om = self.osdmap
+                if (0 <= msg.osd < om.max_osd and om.exists(msg.osd)
+                        and not om.is_up(msg.osd)):
+                    # a beacon from an OSD the map says is DOWN: it is
+                    # alive but never saw the epoch that marked it down
+                    # (publish raced its reboot, or a false failure
+                    # report landed while its subscription was being
+                    # re-established).  Hand it the map so its
+                    # "map says I'm down; re-booting" defense can fire —
+                    # without this the daemon beacons into the void
+                    # forever and its PGs wedge in peering
+                    # (soak-chaos-found).
+                    try:
+                        await msg.conn.send_message(
+                            self._maps_since(msg.epoch))
+                    except (ConnectionError, OSError):
+                        pass
             else:
                 await self._forward_to_leader(msg)
         elif isinstance(msg, MOSDFailure):

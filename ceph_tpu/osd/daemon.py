@@ -274,7 +274,6 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             f"osd.{osd_id}", self.conf, send=self._send_mon_log)
         self.dlog = DoutLogger("osd", self.conf, name_suffix=str(osd_id))
         self._admin: object | None = None
-        self._log_keep = self.conf["osd_min_pg_log_entries"]
         self.osdmap: OSDMap | None = None
         self.beacon_interval = (
             beacon_interval
@@ -360,8 +359,9 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             self.conf["osd_recovery_max_active"])
         self.recovery_stats = {
             "reservation_rejects": 0, "pgs_recovered": 0,
-            "peak_local": 0, "peak_remote": 0,
+            "peak_local": 0, "peak_remote": 0, "grants_swept": 0,
         }
+        self._grant_sweep_task: asyncio.Task | None = None
         self.conf.add_observer(
             ("osd_max_backfills",),
             lambda ch: (
@@ -433,6 +433,7 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             self._scrub_task = asyncio.ensure_future(self._scrub_scheduler())
         if self.conf["osd_tier_agent_interval"] > 0:
             self._tier_task = asyncio.ensure_future(self._tier_agent())
+        self._grant_sweep_task = asyncio.ensure_future(self._grant_sweep())
         # wait for the first map so ops can be served
         await asyncio.wait_for(self._map_event.wait(), 10)
 
@@ -548,6 +549,7 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             self._beacon_task, self._hb_task, self._recovery_task,
             self._scrub_task, getattr(self, "_rehome_task", None),
             getattr(self, "_tier_task", None),
+            getattr(self, "_grant_sweep_task", None),
             *getattr(self, "_repair_tasks", ()),
         ):
             if t:
@@ -749,12 +751,26 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
         dead) — the reference's front/back heartbeat role."""
         interval = self.conf["osd_heartbeat_interval"]
         grace = self.conf["osd_heartbeat_grace"]
+        last_iter = time.monotonic()
         while not self.stopping:
             await asyncio.sleep(interval)
             om = self.osdmap
             if om is None:
                 continue
             now = time.monotonic()
+            starved = now - last_iter > grace
+            last_iter = now
+            if starved:
+                # the shared event loop stalled (big computation, GC):
+                # every peer's replies are "late" by exactly our own
+                # stall, not dead — re-seed the reply clocks instead of
+                # reporting the whole cluster failed at once (the mon's
+                # beacon tick has the same guard; the OSD<->OSD plane
+                # needs it too or one stall sprays N^2 failure reports
+                # and mass-downs live daemons — soak-chaos-found)
+                for peer in list(self._hb_first_ping):
+                    self._hb_first_ping[peer] = now
+                continue
             peers = [
                 o for o in range(om.max_osd)
                 if o != self.id and om.is_up(o) and o in om.osd_addrs
@@ -1078,6 +1094,16 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
             self._pg_logs[c] = lg
         return lg
 
+    def _pg_log_trim(self, t: Transaction, lg: PGLog) -> None:
+        """Hysteresis trim driven by the LIVE registered options (the
+        reference's PeeringState::calc_trim_to): once a shard's log
+        exceeds osd_max_pg_log_entries, cut it back down to
+        osd_min_pg_log_entries.  Reading conf here (not a cached ctor
+        snapshot) means `config set` takes effect on the next commit —
+        the soak scenarios lean on low values to force backfill."""
+        if len(lg.entries) > self.conf["osd_max_pg_log_entries"]:
+            lg.trim(t, self.conf["osd_min_pg_log_entries"])
+
     async def _prime_interval(self, pool, pg, acting) -> bool:
         """Adopt the acting peers' pg-log state before this primary
         serves its first op of a NEW interval (the reference's
@@ -1133,7 +1159,7 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                         e = pg_log_entry_t.decode(raw)
                         if e.version > lg.info.last_update:
                             lg.append(t, e)
-                    lg.trim(t, self._log_keep)
+                    self._pg_log_trim(t, lg)
                     if not t.empty():
                         if getattr(self.store, "blocking_commit", False):
                             await asyncio.to_thread(
@@ -2611,7 +2637,7 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
                     DELETE if delete_final else MODIFY, oid, version, prior,
                     reqid,
                 ))
-                lg.trim(t, self._log_keep)
+                self._pg_log_trim(t, lg)
         return t
 
     async def _rep_replicated_at(

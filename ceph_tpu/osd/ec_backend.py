@@ -673,7 +673,7 @@ class ECBackendMixin:
                 # replica that missed exactly such a write could never
                 # be scoped for it).
                 lg.fill(t, entry)
-            lg.trim(t, self._log_keep)
+            self._pg_log_trim(t, lg)
         return t
 
     async def _ec_head_state(self, pool, pg, acting, oid):

@@ -291,6 +291,44 @@ class PGLog:
             )
         t.omap_setkeys(self.cid, self.meta, {INFO_KEY: self.info.encode()})
 
+    def adopt_tail(
+        self,
+        t: Transaction,
+        tail: eversion_t,
+        entries: "list[pg_log_entry_t] | tuple[pg_log_entry_t, ...]" = (),
+        verified: bool = False,
+    ) -> None:
+        """Adopt an authoritative peer's (log_tail, entries-above-tail)
+        after backfill — set_tail + fill as ONE step that keeps the
+        log's evidence consistent:
+
+        - dup detection: every adopted entry's reqid enters the window
+          (via fill -> _track_reqid), so a client resend of an op this
+          member ADOPTED rather than executed still dedups exactly-once;
+        - contiguity: when adoption RAISES last_update past state this
+          member never held (tail > pre-adoption last_update) and the
+          transfer is not yet object-verified (``verified=False``), the
+          contiguity floor pins at the pre-adoption effective
+          last_update — otherwise an INTERRUPTED backfill leaves a log
+          whose last_update silently vouches for the adopted window and
+          the restart would wrongly take the cheap log-delta path.
+          ``verified=True`` (the sender reconciled every object through
+          the window) clears the floor instead."""
+        pre_eff = self.effective_last_update()
+        gapped = tail > self.info.last_update
+        self.set_tail(t, tail)
+        for e in entries:
+            if e.version > tail:
+                self.fill(t, e)
+        if verified:
+            self.clear_contig_floor(t)
+        elif gapped and self.contig_floor is None:
+            self.contig_floor = pre_eff
+            t.touch(self.cid, self.meta)
+            t.omap_setkeys(
+                self.cid, self.meta, {FLOOR_KEY: pre_eff.key().encode()}
+            )
+
     def split_into(self, t: Transaction, child: "PGLog", belongs) -> None:
         """PGLog::split_into twin (reference src/osd/PGLog.h split_into,
         called from PG::split_into on pg_num growth): entries whose

@@ -85,10 +85,7 @@ class RecoveryMixin:
             done_epoch = self.epoch
             # GC remote grants whose requesting primary is gone — a
             # primary that died after GRANT can never send RELEASE
-            for key in list(self._remote_grants):
-                if not self.osdmap.is_up(key[2]):
-                    res = self._remote_grants.pop(key)
-                    res.release()
+            self._sweep_remote_grants()
             try:
                 om = self.osdmap
                 work: list[tuple[PgPool, pg_t, list[int]]] = []
@@ -193,7 +190,17 @@ class RecoveryMixin:
                 try:
                     ok = await self._recover_pg(pool, pg, acting)
                     if ok:
-                        self._clean_epoch[key] = pass_epoch
+                        # MONOTONE: a pass verified under an older map
+                        # must never rewind a newer verdict.  A queued
+                        # background pass (_queue_pg_pass) can run for
+                        # tens of seconds (sub-op timeouts) while the
+                        # map-driven task completes a newer pass and
+                        # EXITS believing everything clean; the stale
+                        # completion landing afterwards knocked the pg
+                        # back to active+peering with nothing left to
+                        # re-run recovery — the silent soak-sweep wedge
+                        self._clean_epoch[key] = max(
+                            pass_epoch, self._clean_epoch.get(key, -1))
                         self.recovery_stats["pgs_recovered"] += 1
                 finally:
                     self._recovering_pgs.discard(key)
@@ -244,6 +251,49 @@ class RecoveryMixin:
             except (OSError, asyncio.TimeoutError, ConnectionError):
                 continue
 
+    def _sweep_remote_grants(self) -> None:
+        """Release remote backfill GRANTs whose requesting primary can
+        never send the RELEASE: the map says it is down, or the grant
+        aged past osd_backfill_grant_timeout (a primary that died and
+        was never reported, or whose RELEASE was lost).  Without the
+        sweep a GRANT held for a dead reserver leaks the remote slot
+        forever — with osd_max_backfills=1 that parks every other PG's
+        backfill onto this osd behind a ghost."""
+        timeout = self.conf["osd_backfill_grant_timeout"]
+        now = time.monotonic()
+        for key in list(self._remote_grants):
+            held = self._remote_grants.get(key)
+            if held is None:
+                continue
+            res, granted_at = held
+            down = self.osdmap is not None and not self.osdmap.is_up(key[2])
+            aged = timeout > 0 and (now - granted_at) > timeout
+            if down or aged:
+                self._remote_grants.pop(key, None)
+                res.release()
+                self.recovery_stats["grants_swept"] += 1
+                log.info(
+                    "osd.%d: swept backfill grant pg=%d.%d from osd.%d "
+                    "(%s)", self.id, key[0], key[1], key[2],
+                    "requester down" if down else "grant timed out")
+
+    async def _grant_sweep(self) -> None:
+        """Periodic reserver-death sweep — independent of this osd's
+        own recovery passes (an IDLE replica must still reclaim slots
+        leaked by a dead foreign primary)."""
+        while not self.stopping:
+            timeout = self.conf["osd_backfill_grant_timeout"]
+            period = max(0.25, min(timeout / 4 if timeout > 0 else 15.0,
+                                   15.0))
+            try:
+                await asyncio.sleep(period)
+            except asyncio.CancelledError:
+                return
+            try:
+                self._sweep_remote_grants()
+            except Exception:
+                log.exception("osd.%d: grant sweep failed", self.id)
+
     async def _handle_backfill_reserve(self, msg: MBackfillReserve) -> None:
         if msg.op == MBackfillReserve.REQUEST:
             key = (msg.pool, msg.ps, msg.from_osd)
@@ -262,28 +312,94 @@ class RecoveryMixin:
                     pool=msg.pool, ps=msg.ps, from_osd=self.id,
                 ))
                 return
-            res = self.remote_reserver.try_request(key, msg.priority)
-            if res is not None:
-                self._remote_grants[key] = res
-                self.recovery_stats["peak_remote"] = max(
-                    self.recovery_stats["peak_remote"],
-                    self.remote_reserver.in_use)
+            held = self._remote_grants.get(key)
+            if held is not None:
+                # the same primary asking AGAIN means it restarted (or
+                # timed out our reply) after we GRANTed: the old hold
+                # IS its slot.  Re-GRANT it with a fresh clock instead
+                # of rejecting against our own stale hold — the
+                # kill-backfiller-mid-transfer deadlock (a revived
+                # primary could never re-reserve its own leaked slot).
+                self._remote_grants[key] = (held[0], time.monotonic())
                 op = MBackfillReserve.GRANT
             else:
-                op = MBackfillReserve.REJECT_TOOFULL
+                res = self.remote_reserver.try_request(key, msg.priority)
+                if res is not None:
+                    self._remote_grants[key] = (res, time.monotonic())
+                    self.recovery_stats["peak_remote"] = max(
+                        self.recovery_stats["peak_remote"],
+                        self.remote_reserver.in_use)
+                    op = MBackfillReserve.GRANT
+                else:
+                    op = MBackfillReserve.REJECT_TOOFULL
             await msg.conn.send_message(MBackfillReserve(
                 tid=msg.tid, op=op, pool=msg.pool, ps=msg.ps,
                 from_osd=self.id,
             ))
         elif msg.op == MBackfillReserve.RELEASE:
-            res = self._remote_grants.pop(
+            held = self._remote_grants.pop(
                 (msg.pool, msg.ps, msg.from_osd), None)
-            if res is not None:
-                res.release()
+            if held is not None:
+                held[0].release()
         else:  # GRANT / REJECT_TOOFULL reply to our REQUEST
             fut = self._waiters.get(msg.tid)
             if fut and not fut.done():
                 fut.set_result(msg)
+
+    def _load_backfill_cursor(self, myc, acting) -> str | None:
+        """Last-backfill cursor persisted by an interrupted pass —
+        valid only for the SAME interval (epoch + acting set); any map
+        change voids it, because a member that blinked in between may
+        have missed writes to objects below the cursor."""
+        import json as _json
+
+        lg = self._pg_log(myc)
+        try:
+            vals = self.store.omap_get_values(
+                myc, lg.meta, ["backfill_cursor"])
+        except (FileNotFoundError, OSError):
+            return None
+        raw = vals.get("backfill_cursor")
+        if not raw:
+            return None
+        try:
+            doc = _json.loads(raw)
+        except ValueError:
+            return None
+        if (doc.get("acting") != list(acting)
+                or doc.get("epoch") != self.epoch):
+            return None
+        return doc.get("oid")
+
+    def _save_backfill_cursor(
+        self, myc, acting, ordered_all, done, all_ok,
+    ) -> None:
+        """Persist the longest contiguous prefix of the sorted backfill
+        worklist that is verified-done, so a retry of an INTERRUPTED
+        pass (same interval) resumes past it instead of re-pushing
+        every object from scratch; a COMPLETE pass clears it."""
+        import json as _json
+
+        lg = self._pg_log(myc)
+        t = Transaction()
+        self._ensure_coll(t, myc)
+        t.touch(myc, lg.meta)
+        cursor = None
+        if not all_ok:
+            for oid in ordered_all:
+                if oid not in done:
+                    break
+                cursor = oid
+        if cursor is None:
+            t.omap_rmkeys(myc, lg.meta, ["backfill_cursor"])
+        else:
+            t.omap_setkeys(myc, lg.meta, {
+                "backfill_cursor": _json.dumps({
+                    "oid": cursor, "acting": list(acting),
+                    "epoch": self.epoch,
+                }).encode(),
+            })
+        self.store.queue_transaction(t)
 
     def _local_objects(self, pool, pg, shard) -> list[str]:
         c = self._shard_coll(pool, pg, shard)
@@ -350,6 +466,7 @@ class RecoveryMixin:
            MOSDPGPush / replayed delete);
         5. bring lagging members' logs current (MOSDPGLog).
         """
+        pass_epoch = self.epoch
         pairs = self._pg_members(pool, acting)
         if self.id not in [o for _, o in pairs]:
             return True
@@ -421,13 +538,20 @@ class RecoveryMixin:
             gapped = best.log_tail > pre_adopt_lu
             t = Transaction()
             self._ensure_coll(t, myc)
+            ents = [pg_log_entry_t.decode(raw) for raw in best.entries]
             if gapped:
-                lg.set_tail(t, best.log_tail)
-            for raw in best.entries:
-                e = pg_log_entry_t.decode(raw)
-                if e.version > lg.info.last_update:
-                    lg.append(t, e)
-            lg.trim(t, self._log_keep)
+                # adopt_tail (not set_tail+append) pins the contiguity
+                # floor at pre_adopt_lu: if this backfill is
+                # INTERRUPTED, the restart must re-take the backfill
+                # path instead of trusting the adopted last_update —
+                # set_tail+append made the adopted window look
+                # contiguous and a restart silently lost the gap
+                lg.adopt_tail(t, best.log_tail, ents)
+            else:
+                for e in ents:
+                    if e.version > lg.info.last_update:
+                        lg.append(t, e)
+            self._pg_log_trim(t, lg)
             if not t.empty():
                 self.store.queue_transaction(t)
 
@@ -448,6 +572,15 @@ class RecoveryMixin:
                     scope = None
                     break
                 scope |= set(miss.items)
+        log.debug(
+            "osd.%d: pg %s scope=%s gapped=%s prior=%s floor=%s "
+            "tail=%s lu=%s peers=%s",
+            self.id, pg,
+            "backfill" if scope is None else sorted(scope),
+            gapped, prior, lg.contig_floor, lg.info.log_tail,
+            lg.info.last_update,
+            {o: (str(i.last_update), str(self._peer_effective_lu(i)))
+             for (s, o), i in peer_infos.items()})
         if scope is not None:
             # members' self-audited missing sets, plus our own: a
             # log-current member can still be OBJECT-stale (entries
@@ -463,7 +596,13 @@ class RecoveryMixin:
                 e = pg_log_entry_t.decode(raw)
                 scope.add(e.oid)
         strays: set[str] = set()
+        skip_done: set[str] = set()
         if scope is None:
+            # the perf-counter pair is the soak runner's live proof
+            # that recovery took the BACKFILL path (full enumeration),
+            # not a log delta — started here, completed only after a
+            # fully verified pass
+            self.perf.inc("backfill_started")
             # backfill: reconcile the union of object lists, but the
             # member with the newest pre-recovery state is authoritative
             # for WHICH objects exist — an object only held by stale
@@ -528,14 +667,20 @@ class RecoveryMixin:
                     # instead of the old state resurrecting
                     t2 = Transaction()
                     self._ensure_coll(t2, myc)
+                    ents2 = [
+                        pg_log_entry_t.decode(raw) for raw in full.entries
+                    ]
                     if full.log_tail > lg.info.last_update:
-                        lg.set_tail(t2, full.log_tail)
-                    for raw in full.entries:
-                        e = pg_log_entry_t.decode(raw)
-                        if e.version > lg.info.last_update:
-                            lg.append(t2, e)
-                            objs.add(e.oid)
-                    lg.trim(t2, self._log_keep)
+                        lg.adopt_tail(t2, full.log_tail, ents2)
+                        for e in ents2:
+                            if e.version > full.log_tail:
+                                objs.add(e.oid)
+                    else:
+                        for e in ents2:
+                            if e.version > lg.info.last_update:
+                                lg.append(t2, e)
+                                objs.add(e.oid)
+                    self._pg_log_trim(t2, lg)
                     if not t2.empty():
                         self.store.queue_transaction(t2)
             if chain_grew:
@@ -574,6 +719,23 @@ class RecoveryMixin:
                     "osd.%d: pg %s merge reconcile: %d would-be strays "
                     "kept", self.id, pg, len(strays))
                 strays = set()
+            cursor = self._load_backfill_cursor(myc, acting)
+            if cursor is not None:
+                # resume an INTERRUPTED backfill from the persisted
+                # cursor: everything at or below it was verified this
+                # same interval (same epoch + acting set) and writes
+                # since replicate to every acting member normally, so
+                # re-pushing the prefix is pure waste.  Strays are
+                # never skipped — their removal is this pass's job.
+                skip_done = {
+                    oid for oid in objs
+                    if oid <= cursor and oid not in strays
+                }
+                if skip_done:
+                    log.info(
+                        "osd.%d: pg %s backfill resumes past %r: %d of "
+                        "%d objects already verified this interval",
+                        self.id, pg, cursor, len(skip_done), len(objs))
         else:
             objs = scope
         all_ok = True
@@ -596,19 +758,53 @@ class RecoveryMixin:
                     await asyncio.sleep(rsleep)
                 return bool(ok)
 
+        ordered = sorted(objs - skip_done)
         results = await asyncio.gather(
-            *[_one(oid) for oid in sorted(objs)], return_exceptions=True,
+            *[_one(oid) for oid in ordered], return_exceptions=True,
         )
-        for oid, r in zip(sorted(objs), results):
+        interrupted = False
+        for oid, r in zip(ordered, results):
             if isinstance(r, (OSError, asyncio.TimeoutError, ConnectionError)):
                 log.warning(
                     "osd.%d: reconcile %s/%s interrupted: %r",
                     self.id, pg, oid, r,
                 )
-                return False
+                interrupted = True
+                all_ok = False
+                continue
             if isinstance(r, BaseException):
                 raise r
-            all_ok &= r
+            all_ok &= bool(r)
+        if scope is None:
+            done = skip_done | {
+                oid for oid, r in zip(ordered, results) if r is True
+            }
+            self._save_backfill_cursor(myc, acting, sorted(objs), done,
+                                       all_ok)
+            if all_ok:
+                self.perf.inc("backfill_completed")
+        if interrupted:
+            return False
+        if self.epoch != pass_epoch:
+            # interval guard: everything below vouches for state this
+            # pass VERIFIED — but its peer snapshots and pushes are
+            # evidence about the map it started under.  A pass that
+            # straddles map changes (member died, log churned past
+            # trim, member revived — all inside one pass, with the
+            # final acting set equal to the starting one, so an
+            # acting-set compare can't see it) would log-sync a
+            # joiner to clear_floor state it never checked there:
+            # the joiner's last_update then silently vouches for a
+            # trimmed-away window it does not hold, the next pass's
+            # missing-set scoping finds nothing, and the shard's
+            # objects are unreadable until scrub — a clean-looking
+            # data loss.  Report not-ok instead; the pass running
+            # under the new map redoes the work with fresh evidence.
+            log.info(
+                "osd.%d: pg %s map moved mid-pass (%d -> %d); "
+                "withholding verified log-sync",
+                self.id, pg, pass_epoch, self.epoch)
+            return False
         # log sync — ONLY after a fully verified pass.  A lagging
         # peer's last_update IS the next pass's missing-set evidence:
         # syncing the log while an object push failed (member still
@@ -1259,12 +1455,18 @@ class RecoveryMixin:
         prior_pairs: list | None = None,
     ) -> bool:
         """Replicated pools: ensure every acting member holds every
-        clone the authoritative head's SnapSet lists.  Clones are
-        immutable once COW'd, so presence is sufficiency — a member
-        that has the clone object is done, one that lacks it gets the
-        source's copy pushed (reference recovery ships clones as
-        ordinary objects because its missing-sets are ghobject-keyed;
-        our name-keyed reconcile needs this explicit pass)."""
+        clone the authoritative head's SnapSet lists — at the RIGHT
+        frozen content.  Presence alone is NOT sufficiency: a member
+        whose head was still stale when the first post-snap write
+        landed COWs its OLD head into the clone slot (right name,
+        wrong content — long-soak chaos found snap reads serving
+        pre-outage versions this way).  Every current member freezes
+        the same head at COW time and a stale member can only freeze
+        an OLDER one, so the newest clone version attr among holders
+        IS the true frozen content; older copies are overwritten
+        (reference recovery ships clones as ordinary objects because
+        its missing-sets are ghobject-keyed; our name-keyed reconcile
+        needs this explicit pass)."""
         import errno
 
         from ceph_tpu.osd.snaps import SS_ATTR, SnapSet
@@ -1275,7 +1477,6 @@ class RecoveryMixin:
         ss = SnapSet.from_bytes(raw)
         if not ss.clones:
             return True
-        s_src, o_src = src_pair
         ok = True
         for cl in ss.clones:
             if cl.id in pool.removed_snaps:
@@ -1285,68 +1486,88 @@ class RecoveryMixin:
                 # either resurrect it or wedge the pass retrying a
                 # source nobody has
                 continue
-            payload = attrs = None
-            if o_src == self.id:
-                c = self._shard_coll(pool, pg, s_src)
-                co = ghobject_t(oid, snap=cl.id, shard=s_src)
-                if self.store.exists(c, co):
-                    payload = bytes(self.store.read(c, co))
-                    attrs = dict(self.store.getattrs(c, co))
-            else:
-                payload, attrs, _e = await self._read_shard_quiet(
-                    pool, pg, s_src, o_src, oid, snap=cl.id)
-            if payload is None:
-                # the chosen source lost this clone: any CURRENT or
-                # PRIOR-interval member still holding it serves
-                # instead (a remap may have left the only copy on the
-                # old acting set — the same fallback head recovery
-                # gets via prior_pairs)
-                for s2, o2 in list(pairs) + list(prior_pairs or ()):
-                    if o2 in (CRUSH_ITEM_NONE, self.id):
-                        continue
-                    try:
-                        payload, attrs, _e = await self._read_shard_quiet(
-                            pool, pg, s2, o2, oid, snap=cl.id)
-                    except (OSError, asyncio.TimeoutError,
-                            ConnectionError):
-                        continue
-                    if payload is not None:
-                        break
-                else:
-                    payload = None
-                if payload is None and o_src != self.id:
-                    c2 = self._shard_coll(pool, pg, s_src)
-                    co2 = ghobject_t(oid, snap=cl.id, shard=s_src)
-                    if self.store.exists(c2, co2):
-                        payload = bytes(self.store.read(c2, co2))
-                        attrs = dict(self.store.getattrs(c2, co2))
-            if payload is None:
-                # nowhere to sync from yet: retry on a later pass
-                ok = False
-                continue
+            # probe EVERY acting member (version attr included): the
+            # authoritative copy is the newest one anywhere, not
+            # whichever member happened to be chosen as head source
+            vers: dict[tuple[int, int], eversion_t | None] = {}
+            best: tuple[eversion_t, int, int] | None = None
             for s, o in pairs:
                 if o == CRUSH_ITEM_NONE:
                     continue
                 if o == self.id:
                     c = self._shard_coll(pool, pg, s)
                     co = ghobject_t(oid, snap=cl.id, shard=s)
-                    if not self.store.exists(c, co):
-                        t = Transaction()
-                        self._ensure_coll(t, c)
-                        t.touch(c, co)
-                        t.truncate(c, co, len(payload))
-                        if payload:
-                            t.write(c, co, 0, payload)
-                        if attrs:
-                            t.setattrs(c, co, dict(attrs))
-                        self.store.queue_transaction(t)
+                    if self.store.exists(c, co):
+                        v = _v_parse(self.store.getattrs(c, co).get(
+                            VERSION_ATTR))
+                        vers[(s, o)] = v
+                        if best is None or v > best[0]:
+                            best = (v, s, o)
+                    else:
+                        vers[(s, o)] = None
                     continue
-                probe, _a, perr = await self._read_shard_quiet(
+                probe, a, perr = await self._read_shard_quiet(
                     pool, pg, s, o, oid, length=1, snap=cl.id)
                 if probe is not None:
-                    continue  # clone present (immutable: done)
-                if perr not in (errno.ENOENT,):
+                    v = _v_parse((a or {}).get(VERSION_ATTR))
+                    vers[(s, o)] = v
+                    if best is None or v > best[0]:
+                        best = (v, s, o)
+                elif perr in (errno.ENOENT,):
+                    vers[(s, o)] = None
+                else:
                     ok = False  # unreachable member: retry next pass
+            # prior-interval members: extra SOURCES (never targets) —
+            # a remap may have left the only (or only current) copy on
+            # the old acting set
+            for s, o in prior_pairs or ():
+                if o in (CRUSH_ITEM_NONE, self.id):
+                    continue
+                try:
+                    probe, a, _e = await self._read_shard_quiet(
+                        pool, pg, s, o, oid, length=1, snap=cl.id)
+                except (OSError, asyncio.TimeoutError, ConnectionError):
+                    continue
+                if probe is not None:
+                    v = _v_parse((a or {}).get(VERSION_ATTR))
+                    if best is None or v > best[0]:
+                        best = (v, s, o)
+            if best is None:
+                # nowhere to sync from yet: retry on a later pass
+                ok = False
+                continue
+            v_auth, s_b, o_b = best
+            payload = attrs = None
+            if o_b == self.id:
+                c = self._shard_coll(pool, pg, s_b)
+                co = ghobject_t(oid, snap=cl.id, shard=s_b)
+                if self.store.exists(c, co):
+                    payload = bytes(self.store.read(c, co))
+                    attrs = dict(self.store.getattrs(c, co))
+            else:
+                try:
+                    payload, attrs, _e = await self._read_shard_quiet(
+                        pool, pg, s_b, o_b, oid, snap=cl.id)
+                except (OSError, asyncio.TimeoutError, ConnectionError):
+                    payload = None
+            if payload is None:
+                ok = False  # source vanished between probe and read
+                continue
+            for (s, o), v in vers.items():
+                if v is not None and v >= v_auth:
+                    continue  # holds the true frozen content
+                if o == self.id:
+                    c = self._shard_coll(pool, pg, s)
+                    co = ghobject_t(oid, snap=cl.id, shard=s)
+                    t = Transaction()
+                    self._ensure_coll(t, c)
+                    t.touch(c, co)
+                    t.truncate(c, co, len(payload))
+                    if payload:
+                        t.write(c, co, 0, payload)
+                    if attrs:
+                        t.setattrs(c, co, dict(attrs))
+                    self.store.queue_transaction(t)
                     continue
                 try:
                     await self._push(
@@ -1397,30 +1618,35 @@ class RecoveryMixin:
         for cl in ss.clones:
             if cl.id in pool.removed_snaps:
                 continue  # reaped by the trimmer (see _sync_clones)
-            have: dict[int, "np.ndarray"] = {}
-            have_attrs: dict | None = None
-            frozen_v = None
+            # collect every member's clone shard WITH its version
+            # attr: a member whose head was stale at COW time froze
+            # old shard content under the right name (see
+            # _sync_clones) — letting such a shard into the decode
+            # set would rebuild garbage clones, so only shards at the
+            # newest frozen version count as holders; staler ones are
+            # re-push targets
+            shards: dict[tuple[int, int],
+                         tuple["np.ndarray", dict, eversion_t]] = {}
             miss: list[tuple[int, int]] = []
             for s, o in pairs:
                 payload, attrs, perr = await self._read_shard_quiet(
                     pool, pg, s, o, oid, snap=cl.id)
                 if payload is not None:
-                    have[s] = np.frombuffer(payload, np.uint8)
-                    if have_attrs is None:
-                        have_attrs = dict(attrs or {})
-                        frozen_v = _v_parse(
-                            (attrs or {}).get(VERSION_ATTR))
+                    shards[(s, o)] = (
+                        np.frombuffer(payload, np.uint8), dict(attrs or {}),
+                        _v_parse((attrs or {}).get(VERSION_ATTR)))
                 elif perr in (errno.ENOENT,):
                     miss.append((s, o))
                 else:
                     ok = False  # unreachable member: retry next pass
-            if not miss:
-                continue
+            vset = {v for _p, _a, v in shards.values()}
+            if not miss and len(vset) <= 1:
+                continue  # every member holds the same frozen content
             # prior-interval members as clone SOURCES (never targets):
             # a freshly-backfilled member got the HEAD pushed but its
             # clone shard only ever existed on the old acting set
             for s, o in prior_pairs or ():
-                if s in have:
+                if any(s == s2 for s2, _o2 in shards):
                     continue
                 try:
                     payload, attrs, _e = await self._read_shard_quiet(
@@ -1428,11 +1654,23 @@ class RecoveryMixin:
                 except (OSError, asyncio.TimeoutError, ConnectionError):
                     continue
                 if payload is not None:
-                    have[s] = np.frombuffer(payload, np.uint8)
-                    if have_attrs is None:
-                        have_attrs = dict(attrs or {})
-                        frozen_v = _v_parse(
-                            (attrs or {}).get(VERSION_ATTR))
+                    shards[(s, o)] = (
+                        np.frombuffer(payload, np.uint8), dict(attrs or {}),
+                        _v_parse((attrs or {}).get(VERSION_ATTR)))
+            frozen_v = max(
+                (v for _p, _a, v in shards.values()), default=None)
+            have: dict[int, "np.ndarray"] = {}
+            have_attrs: dict | None = None
+            for (s, o), (p, a, v) in shards.items():
+                if v == frozen_v:
+                    if s not in have:
+                        have[s] = p
+                        if have_attrs is None:
+                            have_attrs = a
+                elif (s, o) in pairs:
+                    miss.append((s, o))  # stale COW: re-push
+            if not miss:
+                continue
             filed: set[tuple[int, int]] = set()
             if frozen_v is not None:
                 for s, o in miss:
@@ -1648,19 +1886,19 @@ class RecoveryMixin:
         lg = self._pg_log(c)
         t = Transaction()
         self._ensure_coll(t, c)
-        lg.set_tail(t, msg.tail)
-        for raw in msg.entries:
-            e = pg_log_entry_t.decode(raw)
-            if e.version > msg.tail:
-                # fill, not append: a gapped log heals by receiving
-                # the entries it MISSED (at or below last_update) as
-                # well as the new tail — see PGLog.fill
-                lg.fill(t, e)
-        if msg.clear_floor:
-            # the primary verified every object through our gap and
-            # shipped the entries above: last_update is truthful again
-            lg.clear_contig_floor(t)
-        lg.trim(t, self._log_keep)
+        # adopt_tail = set_tail + fill + floor bookkeeping in ONE step:
+        # every adopted entry's reqid enters the dup window (fill, not
+        # append — a gapped log heals by receiving the entries it
+        # MISSED as well as the new tail), and the contiguity floor
+        # stays honest: clear_floor from the primary means every
+        # object through our gap was just verified (floor clears),
+        # while an UNVERIFIED adoption that raises last_update pins it
+        lg.adopt_tail(
+            t, msg.tail,
+            [pg_log_entry_t.decode(raw) for raw in msg.entries],
+            verified=bool(msg.clear_floor),
+        )
+        self._pg_log_trim(t, lg)
         if not t.empty():
             self.store.queue_transaction(t)
         await msg.conn.send_message(MOSDPGLogAck(

@@ -237,7 +237,7 @@ class TestTrimmedLogBackfill:
     def test_backfill_past_trim_window(self):
         from ceph_tpu.common import ConfigProxy
 
-        conf = {"osd_min_pg_log_entries": 4}
+        conf = {"osd_min_pg_log_entries": 4, "osd_max_pg_log_entries": 4}
 
         async def go():
             async with Cluster(n_osds=8, osd_conf=conf) as c:
@@ -304,6 +304,124 @@ class TestTrimmedLogBackfill:
             )
             epoch = c.client.osdmap.epoch
             await c.osds[victim].start()
+            await c.wait_epoch(epoch + 1)
+
+        run(go())
+
+
+class TestKillBackfillerMidTransfer:
+    """Kill the PRIMARY while its backfill pass is mid-transfer: the
+    remote reservation slots it held on the acting peers must be swept
+    when the map marks it down (reserver-death release), and after the
+    primary revives the interrupted backfill must converge — no slot
+    may stay parked behind the dead reserver (the
+    kill-backfiller-mid-transfer deadlock)."""
+
+    def test_primary_killed_mid_backfill_converges(self):
+        from ceph_tpu.common import ConfigProxy
+        from ceph_tpu.common.metrics import get_perf_counters
+
+        conf = {
+            # tiny log window: the revived member's delta is gapped,
+            # forcing the backfill path rather than log replay
+            "osd_min_pg_log_entries": 4, "osd_max_pg_log_entries": 4,
+            # serialize + pace pushes so the pass is long enough to
+            # kill mid-transfer deterministically
+            "osd_recovery_max_active": 1, "osd_recovery_sleep": 0.25,
+        }
+
+        async def go():
+            async with Cluster(n_osds=5, osd_conf=conf) as c:
+                await c.client.ec_profile_set(
+                    "p", {"plugin": "jax", "k": "2", "m": "1"}
+                )
+                await c.client.pool_create(
+                    "ec", pg_num=1, pool_type="erasure",
+                    erasure_code_profile="p",
+                )
+                io = c.client.ioctx("ec")
+                await io.write_full("seed-obj", b"\x01" * 4000)
+                pool, pg, acting, primary = (
+                    TestStaleShardConsistency._placement(c, io, "seed-obj")
+                )
+                folded = pool.raw_pg_to_pg(pg)
+                victim = next(o for o in acting if o != primary)
+                vshard = acting.index(victim)
+                vstore = c.osds[victim].store
+                epoch = c.client.osdmap.epoch
+                await c.osds[victim].stop()
+                await c.client.command(
+                    {"prefix": "osd down", "id": str(victim)})
+                await c.wait_epoch(epoch + 1)
+                # churn past the 4-entry window while the member is down
+                for i in range(12):
+                    await io.write_full(f"churn{i}", bytes([i + 1]) * 3000)
+                # per-run counter baseline: the registry is
+                # process-global and survives daemon restarts
+                pcs = get_perf_counters(f"osd.{primary}")
+                base_s = pcs.dump().get("backfill_started", 0.0)
+                base_c = pcs.dump().get("backfill_completed", 0.0)
+                await revive(c, victim, vstore)
+                # wait for the primary's backfill pass to be IN FLIGHT
+                inflight = False
+                for _ in range(300):
+                    d = pcs.dump()
+                    if (d.get("backfill_started", 0.0) - base_s
+                            > d.get("backfill_completed", 0.0) - base_c):
+                        inflight = True
+                        break
+                    await asyncio.sleep(0.02)
+                assert inflight, "backfill pass never started"
+                # kill the backfilling PRIMARY mid-transfer
+                pstore = c.osds[primary].store
+                epoch = c.client.osdmap.epoch
+                await c.osds[primary].stop()
+                await c.client.command(
+                    {"prefix": "osd down", "id": str(primary)})
+                await c.wait_epoch(epoch + 1)
+                # the dead reserver's remote GRANTs must be swept once
+                # the down-map lands (peers re-pass and sweep on entry)
+                key = (pool.id, folded.ps, primary)
+                swept = False
+                for _ in range(200):
+                    holders = [
+                        o for o in acting
+                        if o != primary and c.osds[o] is not None
+                        and not c.osds[o].stopping
+                        and key in c.osds[o]._remote_grants
+                    ]
+                    if not holders:
+                        swept = True
+                        break
+                    await asyncio.sleep(0.05)
+                assert swept, "grant for dead primary never swept"
+                # revive the primary: the interrupted backfill resumes
+                # (re-reserving releases/re-grants idempotently) and
+                # the once-down member converges to full content
+                await revive(c, primary, pstore)
+                cl = coll_t(pool.id, folded.ps, vshard)
+                ok = False
+                for _ in range(300):
+                    if all(
+                        vstore.exists(
+                            cl, ghobject_t(f"churn{i}", shard=vshard))
+                        for i in range(12)
+                    ) and vstore.exists(
+                            cl, ghobject_t("seed-obj", shard=vshard)):
+                        ok = True
+                        break
+                    await asyncio.sleep(0.1)
+                assert ok, "interrupted backfill never converged"
+                for i in range(12):
+                    assert await io.read(f"churn{i}") == bytes([i + 1]) * 3000
+                assert await io.read("seed-obj") == b"\x01" * 4000
+
+        async def revive(c, osd_id, store):
+            c.osds[osd_id] = OSDDaemon(
+                osd_id, c.mon.addr, store=store, conf=ConfigProxy(conf)
+            )
+            epoch = c.client.osdmap.epoch
+            await c.osds[osd_id].start()
             await c.wait_epoch(epoch + 1)
 
         run(go())

@@ -349,3 +349,58 @@ def test_chaos_artifact_traces_replay():
             assert run["trace_hash"] == trace_hash(
                 generate_schedule(run["seed"], sc)
             ), (name, run["scenario"], run["seed"])
+
+
+def test_chaos_rack_soak_artifact():
+    """The rack-scale + long-soak matrix (r14): >= 12 scenarios x
+    >= 8 seeds all green, including the three new fronts —
+
+    - **rack-loss**: CRUSH topologies with rack failure-domain rules,
+      judged by ``check_domains`` on snapshots taken at the instant
+      the correlated kill fired — separation (<= 1 shard of any PG
+      per rack) AND survivability (every PG keeps >= need shards
+      through the whole-rack loss);
+    - **soak-trim-backfill**: perf-counter PROOF recovery took the
+      backfill path (``backfill_started > 0``), was interrupted
+      mid-transfer (``started > completed`` while the scripted kill
+      was in flight is judged inside ``check_backfill``), and still
+      converged (``backfill_completed > 0``);
+    - **control-net**: mon/mgr/mds control-plane netem with the full
+      convergence + read-oracle gate set.
+
+    Every run additionally holds the accelerator steady-state:
+    the cold-launch invariant (per-batcher cold_launches AND the
+    transfer guard's host_transfers both flat across the run)."""
+    cited = _chaos_artifacts()
+    assert any("r14" in n for n in cited), (
+        "CHAOS_r14 (rack-scale + long-soak matrix) must stay cited")
+    name = next(n for n in sorted(cited) if "r14" in n)
+    with open(os.path.join(REPO, name)) as f:
+        doc = json.load(f)
+    assert len(doc["scenarios"]) >= 12, doc["scenarios"]
+    for required in ("rack-loss", "control-net", "soak-trim-backfill"):
+        assert required in doc["scenarios"], required
+    assert len(doc["seeds"]) >= 8
+    assert doc["summary"]["all_green"], doc["summary"]
+    judged = {"rack-loss": 0, "control-net": 0, "soak-trim-backfill": 0}
+    for r in doc["runs"]:
+        assert r["ok"], r
+        assert r["invariants"]["cold_launches"]["ok"], r
+        if r["scenario"] == "rack-loss":
+            judged["rack-loss"] += 1
+            assert r["invariants"]["domains"]["ok"], r
+            # a correlated kill verifiably fired (an armed rule
+            # nothing hit proves nothing)
+            assert r.get("domains_obs"), r
+        elif r["scenario"] == "soak-trim-backfill":
+            judged["soak-trim-backfill"] += 1
+            assert r["invariants"]["backfill"]["ok"], r
+            obs = r.get("backfill_obs", {})
+            assert obs.get("backfill_started", 0) > 0, r
+            assert obs.get("backfill_completed", 0) > 0, r
+        elif r["scenario"] == "control-net":
+            judged["control-net"] += 1
+            assert r["invariants"]["converged"]["ok"], r
+            assert r["events_applied"] > 0, r
+    for scenario, n in judged.items():
+        assert n >= 8, (scenario, n)

@@ -198,6 +198,142 @@ class TestMergeFrom:
         assert newest.op == DELETE
 
 
+class TestTrimDup:
+    """Aggressive trim (the soak scenario's osd_min/max_pg_log_entries
+    pressure) must not reopen the exactly-once window: a client resend
+    of an op whose log entry was TRIMMED still dedups."""
+
+    def _log(self, store, n):
+        log = PGLog(C)
+        for i in range(1, n + 1):
+            applied(log, store, pg_log_entry_t(
+                MODIFY, f"o{i}", ev(1, i), reqid=f"c:{i}"))
+        return log
+
+    def test_reqid_survives_trim_in_ram(self, store):
+        log = self._log(store, 10)
+        t = Transaction()
+        log.trim(t, keep=2)
+        store.queue_transaction(t)
+        # entries 1..8 are gone from the log...
+        assert sorted(log.entries) == [ev(1, 9), ev(1, 10)]
+        # ...but their reqids still answer dup detection: the resend
+        # of c:3 must be recognized, not re-applied
+        for i in range(1, 11):
+            assert f"c:{i}" in log.reqids
+        assert log.reqids["c:3"] == ev(1, 3)
+
+    def test_reload_window_shrinks_to_log(self, store):
+        """Across a restart the dup window is rebuilt from surviving
+        entries — the same bounded contract the reference's dups list
+        provides (trimmed reqids are forgotten only on restart)."""
+        log = self._log(store, 10)
+        t = Transaction()
+        log.trim(t, keep=2)
+        store.queue_transaction(t)
+        fresh = PGLog(C)
+        fresh.load(store)
+        assert sorted(fresh.reqids) == ["c:10", "c:9"]
+        assert fresh.reqids["c:9"] == ev(1, 9)
+
+    def test_trim_then_divergent_rollback_reopens_reqid(self, store):
+        """rollback_divergent drops the entry AND its reqid so the
+        client retry re-applies; trim must not have broken that."""
+        log = self._log(store, 6)
+        t = Transaction()
+        log.trim(t, keep=3)
+        log.rollback_divergent(t, "o6", ev(1, 5))
+        store.queue_transaction(t)
+        assert "c:6" not in log.reqids
+        assert "c:5" in log.reqids
+
+
+class TestAdoptTail:
+    """adopt_tail = set_tail + fill + floor bookkeeping in one step
+    (interrupted-backfill log adoption)."""
+
+    def _entry(self, oid, e, v, reqid=""):
+        return pg_log_entry_t(MODIFY, oid, ev(e, v), reqid=reqid)
+
+    def test_unverified_adoption_pins_floor(self, store):
+        """An interrupted backfill adopts the sender's tail without
+        object verification: last_update rises past state this member
+        never held, so the floor must pin at the pre-adoption
+        effective last_update — the restart then takes the backfill
+        path, not the cheap log-delta path."""
+        log = PGLog(C)
+        applied(log, store, self._entry("a", 1, 1))
+        applied(log, store, self._entry("a", 1, 2))
+        t = Transaction()
+        log.adopt_tail(t, ev(2, 7), [self._entry("b", 2, 8)],
+                       verified=False)
+        store.queue_transaction(t)
+        assert log.info.last_update == ev(2, 8)
+        assert log.contig_floor == ev(1, 2)
+        assert log.effective_last_update() == ev(1, 2)
+        # persisted: a restart sees the same evidence
+        fresh = PGLog(C)
+        fresh.load(store)
+        assert fresh.contig_floor == ev(1, 2)
+
+    def test_verified_adoption_clears_floor(self, store):
+        log = PGLog(C)
+        applied(log, store, self._entry("a", 1, 1))
+        # earlier gap already pinned a floor
+        applied(log, store, self._entry("b", 1, 5))
+        assert log.contig_floor == ev(1, 1)
+        t = Transaction()
+        log.adopt_tail(t, ev(1, 6), [self._entry("c", 1, 7)],
+                       verified=True)
+        store.queue_transaction(t)
+        assert log.contig_floor is None
+        assert log.effective_last_update() == ev(1, 7)
+
+    def test_adopted_reqids_answer_dup_detection(self, store):
+        """An op this member ADOPTED rather than executed still dedups
+        exactly-once on client resend."""
+        log = PGLog(C)
+        applied(log, store, self._entry("a", 1, 1))
+        t = Transaction()
+        log.adopt_tail(t, ev(1, 4), [
+            self._entry("b", 1, 5, reqid="cl:5"),
+            self._entry("c", 1, 6, reqid="cl:6"),
+        ], verified=True)
+        store.queue_transaction(t)
+        assert log.reqids.get("cl:5") == ev(1, 5)
+        assert log.reqids.get("cl:6") == ev(1, 6)
+
+    def test_adoption_yields_missing_evidence(self, store):
+        """After adoption the log can scope a behind peer: the adopted
+        window is real history for missing_from, and a peer below the
+        adopted tail is forced to backfill."""
+        log = PGLog(C)
+        applied(log, store, self._entry("a", 1, 1))
+        t = Transaction()
+        log.adopt_tail(t, ev(1, 4), [
+            self._entry("b", 1, 5),
+            self._entry("c", 1, 6),
+        ], verified=True)
+        store.queue_transaction(t)
+        miss = log.missing_from(ev(1, 5))
+        assert sorted(miss.items) == ["c"]
+        # below the adopted tail: history is gone there -> backfill
+        assert log.missing_from(ev(1, 2)) is None
+
+    def test_entries_at_or_below_tail_are_dropped(self, store):
+        log = PGLog(C)
+        applied(log, store, self._entry("a", 1, 1))
+        applied(log, store, self._entry("b", 1, 2))
+        t = Transaction()
+        log.adopt_tail(t, ev(1, 2), [self._entry("c", 1, 3)],
+                       verified=True)
+        store.queue_transaction(t)
+        assert sorted(log.entries) == [ev(1, 3)]
+        assert log.info.log_tail == ev(1, 2)
+        # no gap was introduced past held state: no floor
+        assert log.contig_floor is None
+
+
 class TestContigFloor:
     """The log-contiguity floor: pg version counters are dense, so an
     append that skips counters means ops this member never saw — its

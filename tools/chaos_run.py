@@ -14,6 +14,12 @@ guards (tests/test_bench_artifacts.py).
 Replay a single failing seed with full logging:
 
     python tools/chaos_run.py --scenarios netem_storm --seed 5 -v
+
+Long-soak mode — stretch the soak scenarios into minutes-long paced
+traces (trim pressure + long outage force the backfill path):
+
+    python tools/chaos_run.py --soak --seeds 4
+    python tools/chaos_run.py --soak 12 --scenarios soak-trim-backfill --seed 0
 """
 
 from __future__ import annotations
@@ -45,6 +51,15 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--time-scale", type=float, default=1.0,
         help="stretch/compress the virtual event timeline")
+    ap.add_argument(
+        "--soak", nargs="?", type=float, const=6.0, default=None,
+        metavar="SCALE",
+        help="long-soak mode: select the soak_script scenarios (when "
+        "--scenarios is 'all') and stretch BOTH the event timeline and "
+        "the paced workload by SCALE (default 6x -> minutes-long runs) "
+        "so revived members provably fall behind the trim horizon and "
+        "recovery must take the backfill path; trace hashes are "
+        "unchanged (replay pacing only)")
     ap.add_argument(
         "--profile", default=None,
         help="chaos x load COMPOSITION: replay these loadgen "
@@ -101,6 +116,28 @@ def main(argv=None) -> int:
         ap.error(f"unknown scenarios {unknown}; known: {sorted(SCENARIOS)}")
     seeds = [args.seed] if args.seed is not None else list(range(args.seeds))
 
+    overrides = None
+    if args.soak is not None:
+        scale = max(1.0, args.soak)
+        if args.scenarios == "all":
+            names = [n for n in names if SCENARIOS[n].get("soak_script")]
+        if not any(SCENARIOS[n].get("soak_script") for n in names):
+            ap.error("--soak needs at least one soak_script scenario "
+                     "(e.g. soak-trim-backfill)")
+        args.time_scale *= scale
+        # stretch the paced writers to keep spanning the (now longer)
+        # outage — rounds scale, write_gap stays, so the trim horizon
+        # still provably overtakes the down member's log tail; the
+        # workload is not part of the trace, so hashes are unchanged
+        overrides = {}
+        for n in names:
+            sc = dict(SCENARIOS[n])
+            if sc.get("soak_script") and sc.get("workload"):
+                wl = dict(sc["workload"])
+                wl["rounds"] = int(wl.get("rounds", 3) * scale)
+                sc["workload"] = wl
+            overrides[n] = sc
+
     if args.trace_only:
         for name in names:
             for seed in seeds:
@@ -112,7 +149,8 @@ def main(argv=None) -> int:
                         print(f"  t={e.t:<7} {e.kind} {e.args}")
         return 0
 
-    artifact = run_sweep(names, seeds, time_scale=args.time_scale)
+    artifact = run_sweep(names, seeds, time_scale=args.time_scale,
+                         scenarios=overrides)
     for run in artifact["runs"]:
         status = "green" if run.get("ok") else "RED"
         print(f"{run['scenario']:<16} seed={run['seed']:<3} {status:<6} "
