@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint test chaos bench
+.PHONY: lint test chaos fuzz bench
 
 # ctlint: zero unbaselined findings, no stale/dead baseline entries
 # (exit 1 = new findings, 2 = stale/rotten baseline)
@@ -20,6 +20,13 @@ test:
 # any cluster boots)
 chaos:
 	$(PY) tools/chaos_run.py --lint --scenarios all --seeds 8
+
+# coverage-guided trace-fuzz smoke: seed one fast scenario, spend a
+# tiny mutant budget (the committed FUZZ artifact comes from the full
+# campaign: tools/chaos_fuzz.py --seed 0 --budget 16 --out FUZZ_rNN.json)
+fuzz:
+	$(PY) tools/chaos_fuzz.py --scenarios osd_thrash --budget 2 \
+		--settle-timeout 45
 
 bench:
 	$(PY) tools/bench_all.py

@@ -30,6 +30,13 @@ from ceph_tpu.analysis.rules.common import attr_chain, call_name, last_name
 PURE_TRACE_PATHS = (
     "ceph_tpu/chaos/schedule.py",
     "ceph_tpu/loadgen/schedule.py",
+    # the fuzz plane's pure half: mutants, fingerprints, corpus
+    # bookkeeping and ddmin all carry the committed-hash contract
+    # (FUZZ_*.json lineages re-derive bit-identically forever)
+    "ceph_tpu/fuzz/mutate.py",
+    "ceph_tpu/fuzz/coverage.py",
+    "ceph_tpu/fuzz/corpus.py",
+    "ceph_tpu/fuzz/minimize.py",
 )
 
 _WALLCLOCK = {

@@ -672,3 +672,67 @@ ALL_INVARIANTS = (
     "disk_faults", "cold_launches", "mgr", "slow_osd", "events",
     "client_netem", "fullness", "load", "domains", "backfill",
 )
+
+
+def touched_checkers(result: dict) -> list[str]:
+    """Which checkers a finished run gave NONZERO WORK — the fuzz
+    plane's coverage signal (a checker that merely ran against an
+    empty observation record proves nothing was exercised).  Judged
+    from the run's result record alone so committed artifacts replay
+    the same answer; a checker that was judged at all counts only
+    when its domain shows evidence: writes for the history oracles,
+    injected deltas for disk faults, observed sends for netem, raised
+    rungs for fullness, and so on."""
+    judged = set(result.get("invariants") or ())
+    wl = result.get("workload") or {}
+    cov = result.get("coverage") or {}
+    deltas = cov.get("perf_deltas") or {}
+    out: set[str] = set()
+    if wl.get("writes", 0) or wl.get("load_ops", 0):
+        out |= {"history", "final_reads"} & judged
+    if result.get("events_applied", 0):
+        out |= {"converged", "quorum", "scrub"} & judged
+    if result.get("disk_faults"):
+        out.add("disk_faults")
+    if "cold_launches" in judged:
+        out.add("cold_launches")
+    if "mgr" in judged and (
+            any(k.startswith("mgr.")
+                for k in (cov.get("deaths") or {}))
+            or any(k.startswith("mgr_analytics.") and v
+                   for k, v in deltas.items())):
+        # a failover the report plane absorbed, or analytics that
+        # verifiably digested this run's reports
+        out.add("mgr")
+    slow = result.get("slow_osd_obs") or {}
+    if slow.get("slow_ops_raised"):
+        out.add("slow_osd")
+    ev = result.get("events_obs") or {}
+    if ev.get("events") or ev.get("deaths") or ev.get(
+            "crash_entities"):
+        out.add("events")
+    cn = result.get("client_netem_obs") or {}
+    if (cn.get("client_partitioned_sends")
+            or cn.get("client_dropped_sends")
+            or cn.get("client_delayed_sends")):
+        out.add("client_netem")
+    fl = result.get("fullness_obs") or {}
+    if (fl.get("nearfull_raised") or fl.get("backfillfull_raised")
+            or fl.get("full_raised")):
+        out.add("fullness")
+    if result.get("load"):
+        out.add("load")
+    if result.get("domains_obs"):
+        out.add("domains")
+    bf = result.get("backfill_obs") or {}
+    if bf.get("backfill_started", 0) > 0:
+        out.add("backfill")
+    elif "backfill" not in judged and (
+            deltas.get("backfill_started", 0) > 0):
+        # cross-bred traces run backfill in scenarios that never
+        # judged check_backfill: the counter movement IS the touch
+        out.add("backfill")
+    if any(k.startswith("tier_") and v for k, v in deltas.items()):
+        # tier machinery moved: the history oracles judged redirects
+        out.add("tier")
+    return sorted(out)

@@ -401,6 +401,39 @@ SCENARIOS: dict[str, dict] = {
         "workload": {"objects": 8, "rounds": 24, "object_size": 4096,
                      "write_gap": 0.33},
     },
+    # cache-tier chaos: a replicated writeback tier over an EC base
+    # pool (osd tier add / cache-mode / set-overlay), with the trace
+    # driving the PrimaryLogPG tier machinery live — CACHE_FLUSH and
+    # CACHE_EVICT against the hot pool, promote-on-miss reads via the
+    # base — while paced writers keep minting new dirty versions
+    # through the overlay.  The oracle is the interleave-fuzz one
+    # (tests/test_interleave_fuzz.py): last-write-wins must hold
+    # through every redirect/flush/evict/promote interleaving, so the
+    # versioned history/final-read checks judge it with no new
+    # invariant.  Evicting a dirty object is EBUSY and a flush racing
+    # a promote may bounce — refused events are chaos, recorded in
+    # event_errors, never violations.
+    "cache-tier": {
+        "name": "cache-tier",
+        "n_osds": 5, "n_mons": 1,
+        "duration": 4.0, "n_events": 10,
+        "tier": {"base": "base", "hot": "hot", "mode": "writeback"},
+        "mix": {"tier_flush": 2.0, "tier_evict": 2.0,
+                "tier_promote": 2.0, "osd_kill": 1.0, "scrub": 0.5,
+                "delay": 0.5},
+        "pools": [
+            {"name": "base", "type": "erasure", "pg_num": 4,
+             "k": 2, "m": 1},
+            # the hot pool is the tier, not a workload target: the
+            # workload reaches it THROUGH the base pool's overlay
+            {"name": "hot", "type": "replicated", "pg_num": 4,
+             "size": 2, "workload": False},
+        ],
+        # paced writers so flush/evict/promote events interleave a
+        # LIVE dirty stream, not a settled corpus
+        "workload": {"objects": 3, "rounds": 4, "object_size": 8192,
+                     "write_gap": 0.3},
+    },
 }
 
 
@@ -618,6 +651,30 @@ class ChaosCluster:
                 await self.client.pool_create(
                     pool["name"], pg_num=pool.get("pg_num", 4),
                     size=pool.get("size", 2))
+        tier = sc.get("tier")
+        if tier:
+            # writeback cache tier: hot over base, overlay on — the
+            # same mon verbs operators run (OSDMonitor tier commands)
+            for cmd in (
+                {"prefix": "osd tier add", "pool": tier["base"],
+                 "tierpool": tier["hot"]},
+                {"prefix": "osd tier cache-mode", "pool": tier["hot"],
+                 "mode": tier.get("mode", "writeback")},
+                {"prefix": "osd tier set-overlay",
+                 "pool": tier["base"], "tierpool": tier["hot"]},
+            ):
+                code, rs, _ = await self.client.command(cmd)
+                if code != 0:
+                    raise RuntimeError(f"tier setup {cmd} -> {rs}")
+            if tier.get("target_max_bytes"):
+                await self.client.command({
+                    "prefix": "osd pool set", "pool": tier["hot"],
+                    "var": "target_max_bytes",
+                    "val": str(tier["target_max_bytes"])})
+            # the overlay must be IN the client's map before the
+            # workload writes, or early writes skip the tier
+            await self.client._wait_new_map(
+                self.client.osdmap.epoch - 1, timeout=10)
         await self._await_warmup()
 
     async def _await_warmup(self, timeout: float = 30.0) -> None:
@@ -899,6 +956,22 @@ class ChaosCluster:
                 self._note_death(f"mgr.{mgr.name}")
                 await mgr.stop()
                 self.mgrs[a["mgr"]] = None
+        elif kind in ("tier_flush", "tier_evict", "tier_promote"):
+            from ceph_tpu.client.rados import ObjectOperation
+
+            if kind == "tier_promote":
+                # a read via the BASE pool: overlay redirect, and if
+                # the object was evicted, the promote-on-miss path
+                await self.client.ioctx(a["base"]).read(a["oid"])
+            else:
+                op = ObjectOperation()
+                if kind == "tier_flush":
+                    op.cache_flush()
+                else:
+                    # evicting a dirty object is EBUSY by design —
+                    # apply_event records the refusal as chaos
+                    op.cache_evict()
+                await self.client.ioctx(a["hot"]).operate(a["oid"], op)
         elif kind == "mgr_revive":
             if self.mgrs[a["mgr"]] is None:
                 from ceph_tpu.mgr.daemon import MgrDaemon
@@ -1659,6 +1732,25 @@ async def _settle_events(cluster, obs, time_scale: float) -> None:
     obs["unmuted_checks"] = checks
 
 
+def _perf_totals(n_osds: int) -> dict:
+    """Cluster-wide perf-counter sums (osd.* + mgr_analytics.*) for
+    the per-run coverage export.  Counters are process-global and
+    restart-proof (a revived daemon re-attaches), so before/after
+    deltas attribute movement to THIS run."""
+    from ceph_tpu.common.metrics import get_perf_counters
+
+    tot: dict[str, float] = {}
+    for i in range(n_osds):
+        for k, v in get_perf_counters(f"osd.{i}").dump().items():
+            if isinstance(v, (int, float)):
+                tot[k] = tot.get(k, 0.0) + v
+    for k, v in get_perf_counters("mgr_analytics").dump().items():
+        if isinstance(v, (int, float)):
+            key = f"mgr_analytics.{k}"
+            tot[key] = tot.get(key, 0.0) + v
+    return tot
+
+
 async def run_scenario(
     scenario: dict | str, seed: int, *, time_scale: float = 1.0,
     settle_timeout: float = 90.0,
@@ -1668,6 +1760,23 @@ async def run_scenario(
     if isinstance(scenario, str):
         scenario = SCENARIOS[scenario]
     events = generate_schedule(seed, scenario)
+    return await run_trace(
+        scenario, events, seed=seed, time_scale=time_scale,
+        settle_timeout=settle_timeout)
+
+
+async def run_trace(
+    scenario: dict, events: list, *, seed: int = 0,
+    time_scale: float = 1.0, settle_timeout: float = 90.0,
+) -> dict:
+    """Replay a RAW event trace against a fresh cluster — the fuzz
+    plane's entry point: :func:`run_scenario` is the (seed, scenario)
+    special case, mutant traces come straight from the corpus.  The
+    trace must pass ``schedule.validate_trace`` (mutants are repaired
+    before they get here); the result record carries the same
+    invariant verdicts as a scenario run plus a ``coverage`` block
+    (which counter families moved, which event kinds fired, which
+    daemons died) for the fingerprint."""
     th = trace_hash(events)
     counters = chaos_counters()
     counters.inc("runs")
@@ -1683,6 +1792,7 @@ async def run_scenario(
     try:
         await cluster.start()
         cold_before = _cold_launch_snapshot()
+        perf_before = _perf_totals(scenario["n_osds"])
         from ceph_tpu.common.fault_injector import disk_fault_counters
 
         df_before = dict(disk_fault_counters().dump())
@@ -1726,8 +1836,12 @@ async def run_scenario(
             await load_harness.prefill_done.wait()
         else:
             wl_conf = scenario.get("workload", {})
+            # tiered scenarios exclude the hot pool from direct I/O:
+            # the workload reaches it through the base pool's overlay
             workload = Workload(
-                cluster.client, scenario.get("pools", []),
+                cluster.client,
+                [p for p in scenario.get("pools", [])
+                 if p.get("workload", True)],
                 objects=wl_conf.get("objects", 3),
                 rounds=wl_conf.get("rounds", 3),
                 object_size=wl_conf.get("object_size", 8192),
@@ -1976,6 +2090,18 @@ async def run_scenario(
             if vs:
                 counters.inc("violations", invariant=name, by=len(vs))
         df_after = disk_fault_counters().dump()
+        perf_after = _perf_totals(scenario["n_osds"])
+        result["coverage"] = {
+            "event_kinds": sorted({e.kind for e in events}),
+            "perf_deltas": {
+                k: round(perf_after[k] - perf_before.get(k, 0.0), 6)
+                for k in sorted(perf_after)
+                if perf_after[k] - perf_before.get(k, 0.0)
+            },
+            "netem_moved": sorted(
+                k for k, v in cluster.netem.stats.items() if v),
+            "deaths": dict(sorted(cluster.deaths.items())),
+        }
         result.update({
             "ok": ok,
             "events_applied": cluster.events_applied,

@@ -97,6 +97,14 @@ EVENT_KINDS = (
     "mon_netem",      # degrade one monitor's links
     "mgr_netem",      # degrade one manager's links
     "mds_netem",      # degrade one mds's links (armed-rule semantics)
+    # cache-tier verbs (writeback tier over a base pool: the chaos
+    # plane drives the PrimaryLogPG tier machinery — flush dirty
+    # objects to the base, evict clean copies, promote-on-miss reads
+    # — while the workload's versioned oracle judges last-write-wins
+    # through every redirect)
+    "tier_flush",     # CACHE_FLUSH one object from the hot pool
+    "tier_evict",     # CACHE_EVICT one object from the hot pool
+    "tier_promote",   # read via the base pool (promote-on-miss path)
 )
 
 
@@ -183,11 +191,7 @@ def generate_schedule(seed: int, scenario: dict) -> list[ChaosEvent]:
     # at most this many osds simultaneously dead+out: keeps a k+m EC
     # pool writable while the thrash runs (the OSDThrasher's
     # min_in/max_dead budget)
-    max_dead = scenario.get("max_dead", max(1, n_osds - 1 - max(
-        p.get("k", p.get("size", 2)) + p.get("m", 0)
-        for p in scenario.get("pools", [{"size": 2}])
-    )))
-    max_dead = max(1, min(max_dead, n_osds - 2))
+    max_dead = scenario_max_dead(scenario)
     max_cuts = scenario.get("max_partitions", 1)
     pg_pools = [p["name"] for p in scenario.get("pools", [])] or ["rep"]
 
@@ -550,6 +554,13 @@ def generate_schedule(seed: int, scenario: dict) -> list[ChaosEvent]:
             emit(t, kind, mode=mode,
                  seconds=round(rng.uniform(0.005, 0.04), 4),
                  ttl=round(rng.uniform(0.3, 1.0), 3), **who)
+        elif kind in ("tier_flush", "tier_evict", "tier_promote"):
+            tier = scenario.get("tier")
+            if not tier:
+                continue
+            n_obj = int(scenario.get("workload", {}).get("objects", 3))
+            emit(t, kind, base=tier["base"], hot=tier["hot"],
+                 oid=f"{tier['base']}-obj{rng.randrange(n_obj)}")
         elif kind == "netem_clear":
             st.partitions.clear()
             st.oneways.clear()
@@ -596,3 +607,453 @@ def generate_schedule(seed: int, scenario: dict) -> list[ChaosEvent]:
             or scenario.get("control_netem")):
         events.sort(key=lambda e: e.t)
     return events
+
+
+# -- trace schema + applicability (the fuzz plane's contract) ---------------
+#
+# The mutation engine (ceph_tpu/fuzz/mutate.py) edits raw event lists;
+# everything below is what keeps its output runnable: a per-kind arg
+# schema, per-scenario verb applicability, a validator that refuses a
+# trace the runner could not replay, and a deterministic repair pass
+# that normalizes an arbitrary edit back into a legal trace.  All of
+# it is pure — no clock, no shared RNG — because mutant traces carry
+# the same committed-hash contract as generated ones.
+
+_INT = (int,)
+_NUM = (int, float)
+
+#: required args per event kind (optional args — ttl, await_backfill —
+#: are not listed; extra keys are allowed)
+EVENT_ARG_SCHEMA: dict[str, dict[str, tuple | type]] = {
+    "osd_kill": {"osd": _INT}, "osd_revive": {"osd": _INT},
+    "osd_out": {"osd": _INT}, "osd_in": {"osd": _INT},
+    "reweight": {"osd": _INT, "weight": _NUM},
+    "mon_restart": {"rank": _INT},
+    "pg_split": {"pool": str},
+    "scrub": {"pool": str}, "deep_scrub": {"pool": str},
+    "repair": {"pool": str},
+    "balance": {},
+    "partition": {"a": list, "b": list},
+    "heal_partition": {"a": list, "b": list},
+    "drop_oneway": {"src": list, "dst": list},
+    "heal_oneway": {"src": list, "dst": list},
+    "delay": {"src": list, "dst": list, "seconds": _NUM},
+    "reorder": {"src": list, "dst": list, "every": _INT, "hold": _NUM},
+    "netem_clear": {},
+    "eio": {"osd": _INT}, "bitflip": {"osd": _INT},
+    "torn_write": {"osd": _INT}, "disk_dead": {"osd": _INT},
+    "slow_disk": {"osd": _INT, "delay": _NUM},
+    "disk_heal": {"osd": _INT},
+    "mgr_kill": {"mgr": _INT}, "mgr_revive": {"mgr": _INT},
+    "client_partition": {"peer": list},
+    "heal_client_partition": {"peer": list},
+    "client_drop": {"peer": list, "to_client": bool},
+    "heal_client_drop": {"peer": list, "to_client": bool},
+    "client_delay": {"peer": list, "seconds": _NUM},
+    "fill": {"level": str, "ratio": _NUM}, "drain": {},
+    "rack_kill": {"rack": _INT, "osds": list},
+    "host_kill": {"host": _INT, "osds": list},
+    "rack_revive": {"rack": _INT, "osds": list},
+    "mon_netem": {"rank": _INT, "mode": str, "seconds": _NUM},
+    "mgr_netem": {"mgr": _INT, "mode": str, "seconds": _NUM},
+    "mds_netem": {"mds": _INT, "mode": str, "seconds": _NUM},
+    "tier_flush": {"base": str, "hot": str, "oid": str},
+    "tier_evict": {"base": str, "hot": str, "oid": str},
+    "tier_promote": {"base": str, "hot": str, "oid": str},
+}
+
+
+def scenario_max_dead(scenario: dict) -> int:
+    """The scenario's simultaneous dead+out budget: keeps a k+m EC
+    pool writable while the thrash runs (the OSDThrasher's
+    min_in/max_dead budget)."""
+    n_osds = scenario["n_osds"]
+    max_dead = scenario.get("max_dead", max(1, n_osds - 1 - max(
+        p.get("k", p.get("size", 2)) + p.get("m", 0)
+        for p in scenario.get("pools", [{"size": 2}])
+    )))
+    return max(1, min(max_dead, n_osds - 2))
+
+
+def scenario_verbs(scenario: dict) -> tuple[str, ...]:
+    """Every verb a LEGAL trace for this scenario may contain — the
+    validator's vocabulary.  Scenario-dependent gates mirror the
+    generator's own refusals (a verb the generator would never draw
+    here is a verb the runner cannot meaningfully replay here)."""
+    out = set(EVENT_KINDS)
+    if scenario.get("n_mons", 1) < 2:
+        out.discard("mon_restart")
+    if not scenario.get("n_mgrs"):
+        out -= {"mgr_kill", "mgr_revive", "mgr_netem"}
+    if not scenario.get("client_netem"):
+        out -= {"client_partition", "heal_client_partition",
+                "client_drop", "heal_client_drop", "client_delay"}
+    if not scenario.get("topology"):
+        out -= {"rack_kill", "host_kill", "rack_revive"}
+    if not scenario.get("tier"):
+        out -= {"tier_flush", "tier_evict", "tier_promote"}
+    if scenario.get("store") != "blockstore":
+        # at-rest disk faults need a store whose lies surface like
+        # real media errors (MemStore has no at-rest bytes to rot);
+        # slow_disk/disk_heal stay — injected commit latency works on
+        # any store and every fault-touched disk heals at trace end
+        out -= {"eio", "bitflip", "torn_write", "disk_dead"}
+    if not scenario.get("capacity_bytes"):
+        # the fullness ladder needs small-capacity stores the
+        # closed-loop ballast writer can actually push over a ratio
+        out -= {"fill", "drain"}
+    return tuple(sorted(out))
+
+
+def applicable_verbs(scenario: dict) -> tuple[str, ...]:
+    """The CROSS-BREEDING pool: verbs a mutant may inject into this
+    scenario's traces and still be expected to run green.  Stricter
+    than :func:`scenario_verbs` — the fuzzer's job is to find bugs,
+    not to manufacture reds out of oracle preconditions:
+
+    - fill/drain stay out everywhere (the application is closed-loop
+      against store capacity; injected mid-trace they starve or stall
+      foreign workloads);
+    - rack verbs stay out (args carry topology member lists; only the
+      scripted skeleton knows a survivable one);
+    - kills/outs stay out of topology and fullness scenarios (their
+      scripted ladders budget the failure pattern themselves — the
+      same reason their mixes exclude them);
+    - at-rest damage (bitflip/disk_dead) stays out — the generator
+      meters damage with a redundancy budget (damage_gap, one dying
+      disk); a mutant splicing a second hit is operator data loss,
+      not a found bug.  Transient eio/torn_write join only self_heal
+      scenarios (the repair sweep is the heal path for their debris);
+    - slow_disk stays out of watch_events scenarios (a late SLOW_OPS
+      clear reads as settle debris to check_events).
+    """
+    out = {
+        "reweight", "scrub", "deep_scrub", "repair", "balance",
+        "partition", "drop_oneway", "delay", "reorder", "netem_clear",
+        "pg_split", "mon_netem", "mds_netem", "osd_kill", "osd_out",
+    }
+    if scenario.get("n_mons", 1) >= 2:
+        out.add("mon_restart")
+    if scenario.get("n_mgrs"):
+        out |= {"mgr_kill", "mgr_netem"}
+    if scenario.get("client_netem"):
+        out |= {"client_partition", "client_drop", "client_delay"}
+    if scenario.get("tier"):
+        out |= {"tier_flush", "tier_evict", "tier_promote"}
+    if scenario.get("store") == "blockstore" and scenario.get(
+            "self_heal"):
+        out |= {"eio", "torn_write"}
+    if scenario.get("watch_events"):
+        out.discard("slow_disk")
+    if scenario.get("topology") or scenario.get("fullness_script"):
+        out -= {"osd_kill", "osd_out"}
+    return tuple(sorted(out))
+
+
+def events_to_json(events: list[ChaosEvent]) -> list[dict]:
+    return [e.to_json() for e in events]
+
+
+def events_from_json(recs: list[dict]) -> list[ChaosEvent]:
+    return [
+        ChaosEvent(t=float(r["t"]), kind=r["kind"],
+                   args=dict(r.get("args") or {}))
+        for r in recs
+    ]
+
+
+class _ReplayState:
+    """The validator/repairer's legality simulation — the same state
+    discipline the generator keeps internally, replayed over an
+    arbitrary event list."""
+
+    def __init__(self, scenario: dict):
+        n = scenario["n_osds"]
+        self.n_osds = n
+        self.n_mons = scenario.get("n_mons", 1)
+        self.n_mgrs = scenario.get("n_mgrs", 0)
+        self.alive = set(range(n))
+        self.in_set = set(range(n))
+        self.mgr_alive = set(range(self.n_mgrs))
+        self.partitions: list[tuple] = []
+        self.oneways: list[tuple] = []
+        self.client_cuts: list[tuple] = []
+        self.client_drops: list[tuple] = []
+        self.faulted: set[int] = set()
+        self.rack_dead: set[int] = set()  # dead via rack/host kills
+        self.splits = 0
+        self.max_dead = scenario_max_dead(scenario)
+        self.max_cuts = scenario.get("max_partitions", 1)
+        # the generator's pinned client cut (client_partition_at)
+        # lives OUTSIDE the mix budget — its own slot
+        self.max_client = scenario.get("max_client_cuts", 1) + (
+            1 if scenario.get("client_partition_at") is not None
+            else 0)
+        self.max_splits = scenario.get("max_splits", 1)
+
+    def down_budget_used(self) -> int:
+        """Mix-killed/outed osds counted against max_dead (rack-script
+        correlated kills run their own survivability budget)."""
+        dead = (set(range(self.n_osds)) - self.alive) - self.rack_dead
+        outed = set(range(self.n_osds)) - self.in_set
+        return len(dead) + len(outed - dead)
+
+    def whole(self) -> bool:
+        return (self.alive == set(range(self.n_osds))
+                and self.in_set == set(range(self.n_osds))
+                and self.mgr_alive == set(range(self.n_mgrs))
+                and not self.partitions and not self.oneways
+                and not self.client_cuts and not self.client_drops
+                and not self.faulted)
+
+
+def _check_args(e: ChaosEvent) -> str | None:
+    """Schema check one event; returns a violation string or None."""
+    schema = EVENT_ARG_SCHEMA.get(e.kind)
+    if schema is None:
+        return f"unknown event kind {e.kind!r}"
+    if not isinstance(e.args, dict):
+        return f"{e.kind}: args is not a dict"
+    for key, typ in sorted(schema.items()):
+        if key not in e.args:
+            return f"{e.kind}: missing arg {key!r}"
+        if not isinstance(e.args[key], typ):
+            return (f"{e.kind}: arg {key!r}={e.args[key]!r} is not "
+                    f"{typ!r}")
+    if not isinstance(e.t, _NUM):
+        return f"{e.kind}: t={e.t!r} is not a number"
+    return None
+
+
+def _step(st: _ReplayState, e: ChaosEvent,
+          scenario: dict) -> str | None:
+    """Advance the legality simulation by one event; returns a
+    violation string (state unchanged) or None (state advanced).
+    Shared by validate_trace (reject) and repair_trace (drop)."""
+    a = e.args
+    k = e.kind
+
+    def _osd_ok(o) -> bool:
+        return 0 <= o < st.n_osds
+
+    if k == "osd_kill":
+        if not _osd_ok(a["osd"]) or a["osd"] not in st.alive:
+            return f"osd_kill {a['osd']}: not alive"
+        if (a["osd"] not in (set(range(st.n_osds)) - st.in_set)
+                and st.down_budget_used() >= st.max_dead):
+            return f"osd_kill {a['osd']}: max_dead budget spent"
+        st.alive.discard(a["osd"])
+    elif k == "osd_revive":
+        if not _osd_ok(a["osd"]) or a["osd"] in st.alive:
+            return f"osd_revive {a['osd']}: already alive"
+        st.alive.add(a["osd"])
+        st.rack_dead.discard(a["osd"])
+    elif k == "osd_out":
+        if not _osd_ok(a["osd"]) or a["osd"] not in st.in_set:
+            return f"osd_out {a['osd']}: already out"
+        if len(st.in_set) <= 2:
+            return f"osd_out {a['osd']}: would leave < 2 in"
+        if (a["osd"] in st.alive
+                and st.down_budget_used() >= st.max_dead):
+            return f"osd_out {a['osd']}: max_dead budget spent"
+        st.in_set.discard(a["osd"])
+    elif k == "osd_in":
+        if not _osd_ok(a["osd"]) or a["osd"] in st.in_set:
+            return f"osd_in {a['osd']}: already in"
+        st.in_set.add(a["osd"])
+    elif k in ("reweight", "eio", "bitflip", "torn_write",
+               "slow_disk", "disk_dead"):
+        if not _osd_ok(a["osd"]):
+            return f"{k}: osd {a['osd']} out of range"
+        if k != "reweight":
+            if a["osd"] not in st.alive:
+                return f"{k} {a['osd']}: arming a dead osd's store"
+            st.faulted.add(a["osd"])
+            if k == "disk_dead":
+                if st.down_budget_used() >= st.max_dead:
+                    return f"disk_dead {a['osd']}: max_dead budget"
+                st.alive.discard(a["osd"])
+    elif k == "disk_heal":
+        if not _osd_ok(a["osd"]):
+            return f"disk_heal: osd {a['osd']} out of range"
+        st.faulted.discard(a["osd"])
+    elif k == "mon_restart":
+        if st.n_mons < 2:
+            return "mon_restart: single-mon cluster"
+        if not 0 <= a["rank"] < st.n_mons:
+            return f"mon_restart: rank {a['rank']} out of range"
+    elif k == "pg_split":
+        if st.splits >= st.max_splits:
+            return "pg_split: max_splits budget spent"
+        st.splits += 1
+    elif k in ("mgr_kill", "mgr_revive"):
+        if not 0 <= a["mgr"] < st.n_mgrs:
+            return f"{k}: mgr {a['mgr']} out of range"
+        if k == "mgr_kill":
+            if a["mgr"] not in st.mgr_alive:
+                return f"mgr_kill {a['mgr']}: already dead"
+            st.mgr_alive.discard(a["mgr"])
+        else:
+            if a["mgr"] in st.mgr_alive:
+                return f"mgr_revive {a['mgr']}: already alive"
+            st.mgr_alive.add(a["mgr"])
+    elif k == "partition":
+        if len(st.partitions) >= st.max_cuts:
+            return "partition: max_partitions budget spent"
+        st.partitions.append((tuple(a["a"]), tuple(a["b"])))
+    elif k == "heal_partition":
+        cut = (tuple(a["a"]), tuple(a["b"]))
+        rcut = (cut[1], cut[0])
+        if cut in st.partitions:
+            st.partitions.remove(cut)
+        elif rcut in st.partitions:
+            st.partitions.remove(rcut)
+    elif k == "drop_oneway":
+        if len(st.oneways) >= st.max_cuts:
+            return "drop_oneway: max_partitions budget spent"
+        st.oneways.append((tuple(a["src"]), tuple(a["dst"])))
+    elif k == "heal_oneway":
+        link = (tuple(a["src"]), tuple(a["dst"]))
+        if link in st.oneways:
+            st.oneways.remove(link)
+    elif k == "client_partition":
+        if len(st.client_cuts) >= st.max_client:
+            return "client_partition: max_client_cuts budget spent"
+        st.client_cuts.append(tuple(a["peer"]))
+    elif k == "heal_client_partition":
+        peer = tuple(a["peer"])
+        if peer in st.client_cuts:
+            st.client_cuts.remove(peer)
+    elif k == "client_drop":
+        if len(st.client_drops) >= st.max_client:
+            return "client_drop: max_client_cuts budget spent"
+        st.client_drops.append((tuple(a["peer"]), a["to_client"]))
+    elif k == "heal_client_drop":
+        link = (tuple(a["peer"]), a["to_client"])
+        if link in st.client_drops:
+            st.client_drops.remove(link)
+    elif k == "netem_clear":
+        st.partitions.clear()
+        st.oneways.clear()
+        st.client_cuts.clear()
+        st.client_drops.clear()
+    elif k in ("rack_kill", "host_kill"):
+        osds = set(a["osds"])
+        if not osds <= st.alive:
+            return f"{k}: members {sorted(osds - st.alive)} not alive"
+        st.alive -= osds
+        st.rack_dead |= osds
+    elif k == "rack_revive":
+        osds = set(a["osds"])
+        if osds & st.alive:
+            return (f"rack_revive: members "
+                    f"{sorted(osds & st.alive)} already alive")
+        st.alive |= osds
+        st.rack_dead -= osds
+    elif k == "mon_netem":
+        if not 0 <= a["rank"] < st.n_mons:
+            return f"mon_netem: rank {a['rank']} out of range"
+        if a["mode"] == "partition" and st.n_mons < 3:
+            return ("mon_netem: a quorum that cannot spare a member "
+                    "only gets its links slowed, never cut")
+    elif k == "mgr_netem":
+        if not 0 <= a["mgr"] < st.n_mgrs:
+            return f"mgr_netem: mgr {a['mgr']} out of range"
+    # delay/reorder/scrub/deep_scrub/repair/balance/mds_netem/
+    # client_delay/fill/drain/tier_*: stateless (or closed-loop in the
+    # runner); schema + scenario_verbs gating is the whole contract
+    return None
+
+
+def validate_trace(events: list[ChaosEvent],
+                   scenario: dict) -> list[str]:
+    """Refuse a trace the runner could not replay: schema violations,
+    out-of-vocabulary verbs, unsorted times, legality/budget breaks,
+    or a trace that does not end whole.  Returns violation strings
+    (empty = valid).  Every generated trace validates; every repaired
+    mutant must too."""
+    out: list[str] = []
+    vocab = set(scenario_verbs(scenario))
+    duration = float(scenario.get("duration", 5.0))
+    st = _ReplayState(scenario)
+    for i, e in enumerate(events):
+        err = _check_args(e)
+        if err is not None:
+            out.append(f"event[{i}]: {err}")
+            continue
+        if e.kind not in vocab:
+            out.append(f"event[{i}]: {e.kind} not applicable to "
+                       f"scenario {scenario.get('name')!r}")
+            continue
+        if e.t < 0 or e.t > duration + 1.0:
+            out.append(f"event[{i}]: t={e.t} outside "
+                       f"[0, {duration + 1.0}]")
+        # NOTE: list order IS replay order (the runner fires each
+        # event after max(0, t - now)) — an out-of-order t is legal
+        # and some legacy scenarios' committed traces rely on it, so
+        # the legality simulation walks the list, not sorted times
+        err = _step(st, e, scenario)
+        if err is not None:
+            out.append(f"event[{i}]: {err}")
+    if not st.whole():
+        out.append(
+            "trace does not end whole: "
+            f"dead={sorted(set(range(st.n_osds)) - st.alive)} "
+            f"out={sorted(set(range(st.n_osds)) - st.in_set)} "
+            f"dead_mgrs={sorted(set(range(st.n_mgrs)) - st.mgr_alive)} "
+            f"cuts={len(st.partitions) + len(st.oneways)} "
+            f"client_cuts={len(st.client_cuts) + len(st.client_drops)} "
+            f"faulted={sorted(st.faulted)}")
+    return out
+
+
+def repair_trace(events: list[ChaosEvent],
+                 scenario: dict) -> list[ChaosEvent]:
+    """Deterministically normalize an arbitrary event-list edit into a
+    legal trace: clamp times into the scenario window, stable-sort,
+    drop events that are out of schema/vocabulary or that the legality
+    simulation refuses, then append the canonical trace-end wholeness
+    block (heal every cut, clear every fault, revive every body).  The
+    output always passes :func:`validate_trace` — mutants never crash
+    the runner on malformed input."""
+    duration = float(scenario.get("duration", 5.0))
+    vocab = set(scenario_verbs(scenario))
+    clamped = [
+        ChaosEvent(t=round(min(max(float(e.t), 0.05), duration), 3),
+                   kind=e.kind, args=dict(e.args))
+        for e in events
+        if isinstance(e.t, _NUM)
+    ]
+    clamped.sort(key=lambda e: e.t)  # stable: equal-t order preserved
+    st = _ReplayState(scenario)
+    kept: list[ChaosEvent] = []
+    for e in clamped:
+        if _check_args(e) is not None or e.kind not in vocab:
+            continue
+        if _step(st, e, scenario) is None:
+            kept.append(e)
+    t_end = round(duration + 0.05, 3)
+    for cut in st.partitions:
+        kept.append(ChaosEvent(t_end, "heal_partition",
+                               {"a": list(cut[0]), "b": list(cut[1])}))
+    for link in st.oneways:
+        kept.append(ChaosEvent(
+            t_end, "heal_oneway",
+            {"src": list(link[0]), "dst": list(link[1])}))
+    for peer in st.client_cuts:
+        kept.append(ChaosEvent(t_end, "heal_client_partition",
+                               {"peer": list(peer)}))
+    for peer, to_client in st.client_drops:
+        kept.append(ChaosEvent(
+            t_end, "heal_client_drop",
+            {"peer": list(peer), "to_client": to_client}))
+    kept.append(ChaosEvent(t_end, "netem_clear", {}))
+    for osd in sorted(st.faulted):
+        kept.append(ChaosEvent(t_end, "disk_heal", {"osd": osd}))
+    for osd in sorted(set(range(st.n_osds)) - st.alive):
+        kept.append(ChaosEvent(t_end, "osd_revive", {"osd": osd}))
+    for osd in sorted(set(range(st.n_osds)) - st.in_set):
+        kept.append(ChaosEvent(t_end, "osd_in", {"osd": osd}))
+    for mgr in sorted(set(range(st.n_mgrs)) - st.mgr_alive):
+        kept.append(ChaosEvent(t_end, "mgr_revive", {"mgr": mgr}))
+    return kept

@@ -1,0 +1,34 @@
+"""Coverage-guided fuzzing over chaos event traces.
+
+The chaos matrix is hand-authored scenarios x seeds; every recent
+consistency bug was found by COMPOSING scenarios in ways no author
+anticipated.  This package stops hand-writing traces and searches the
+trace space instead, steered by what each trace exercises:
+
+- :mod:`ceph_tpu.fuzz.mutate` — deterministic trace mutations, pure
+  in ``(parent_trace_hash, mutation_seed)``; every mutant is repaired
+  back to schema validity so it can never crash the runner;
+- :mod:`ceph_tpu.fuzz.coverage` — the feedback signal: a fingerprint
+  of which invariant checkers produced nonzero work, which
+  perf-counter families moved, and which lifecycle edges fired;
+- :mod:`ceph_tpu.fuzz.corpus` — AFL-style admission: a trace earns a
+  corpus slot by surfacing a feature no prior entry produced;
+- :mod:`ceph_tpu.fuzz.runner` — the live campaign loop (bounded,
+  deterministic given ``--seed``), emitting the FUZZ_rNN artifact;
+- :mod:`ceph_tpu.fuzz.minimize` — ddmin + field shrinking, so any
+  red reduces to a minimal deterministic regression trace.
+
+Drive it with ``tools/chaos_fuzz.py`` (or ``make fuzz``).
+"""
+
+from ceph_tpu.fuzz.corpus import Corpus, CorpusEntry
+from ceph_tpu.fuzz.coverage import features, fingerprint, fingerprint_key
+from ceph_tpu.fuzz.minimize import ddmin, minimize_trace, shrink_fields
+from ceph_tpu.fuzz.mutate import MUTATION_KINDS, mutate
+from ceph_tpu.fuzz.runner import run_campaign
+
+__all__ = [
+    "Corpus", "CorpusEntry", "MUTATION_KINDS", "ddmin", "features",
+    "fingerprint", "fingerprint_key", "minimize_trace", "mutate",
+    "run_campaign", "shrink_fields",
+]

@@ -565,16 +565,27 @@ class Monitor(OSDMonitorMixin, StatsServiceMixin, MgrServiceMixin,
                     await self._ingest_statfs(msg.osd, msg.statfs)
                 om = self.osdmap
                 if (0 <= msg.osd < om.max_osd and om.exists(msg.osd)
-                        and not om.is_up(msg.osd)):
-                    # a beacon from an OSD the map says is DOWN: it is
-                    # alive but never saw the epoch that marked it down
-                    # (publish raced its reboot, or a false failure
-                    # report landed while its subscription was being
-                    # re-established).  Hand it the map so its
-                    # "map says I'm down; re-booting" defense can fire —
-                    # without this the daemon beacons into the void
-                    # forever and its PGs wedge in peering
-                    # (soak-chaos-found).
+                        and (not om.is_up(msg.osd)
+                             or msg.epoch < om.epoch)):
+                    # a beacon from an OSD the map says is DOWN, or one
+                    # whose epoch lags the current map: it is alive but
+                    # never saw the newer epochs (publish raced its
+                    # reboot, a false failure report landed while its
+                    # subscription was being re-established, or a netem
+                    # fault on the mon link made a publish fail and
+                    # popped it from _subscribers).  Hand it the
+                    # catch-up payload so the "map says I'm down;
+                    # re-booting" defense can fire / the stale daemon
+                    # converges — without this the daemon beacons into
+                    # the void forever and its PGs wedge in peering or
+                    # report clean at a dead epoch (soak-chaos-found;
+                    # stale-epoch arm chaos-fuzz-found, control-net).
+                    if msg.src == ("osd", msg.osd):
+                        # the beacon proves this path is healthy again:
+                        # re-register the subscription a failed publish
+                        # dropped (peon-forwarded beacons carry the
+                        # peon's conn — don't register those)
+                        self._subscribers[msg.src] = msg.conn
                     try:
                         await msg.conn.send_message(
                             self._maps_since(msg.epoch))

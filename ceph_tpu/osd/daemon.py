@@ -1793,20 +1793,34 @@ class OSDDaemon(ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin):
     def _prior_pairs(
         self, pool, pg: pg_t, pairs: list[tuple[int, int]]
     ) -> list[tuple[int, int]]:
-        """(shard, osd) candidates from past intervals: still-up
-        members not in the current acting set — potential data sources
-        (the prior_set role of PastIntervals)."""
+        """(shard, osd) candidates from past intervals: members not in
+        the current acting set — potential data sources (the prior_set
+        role of PastIntervals).  DOWN members stay listed while the map
+        still counts them in (not out, not removed): their store
+        survives the kill and may hold the newest ACKED shard, so the
+        reconcile pass must know they exist to defer destructive
+        verdicts until they answer (the reference blocks peering on
+        down_osds_we_would_probe the same way; chaos-fuzz-found:
+        a write acked degraded on exactly k shards, one holder killed,
+        and the rollback fired in the 400ms before it rebooted)."""
         if not self._past_acting_loaded:
             self._load_past_acting()
         key = (pg.pool, pg.ps)
         current = {(s, o) for s, o in pairs}
+        om = self.osdmap
         out: list[tuple[int, int]] = []
         seen = set()
         for past in reversed(self._past_acting.get(key, [])):
             for s, o in self._pg_members(pool, past):
                 if (s, o) in current or (s, o) in seen:
                     continue
-                if o == CRUSH_ITEM_NONE or not self.osdmap.is_up(o):
+                if o == CRUSH_ITEM_NONE:
+                    continue
+                if not om.is_up(o) and (
+                        not (0 <= o < om.max_osd) or not om.exists(o)
+                        or om.is_out(o)):
+                    # written off: out (data forfeited to the remap)
+                    # or removed — no veto, no probe
                     continue
                 seen.add((s, o))
                 out.append((s, o))

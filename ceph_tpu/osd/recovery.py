@@ -79,10 +79,24 @@ class RecoveryMixin:
         pass-worth of CPU each second starved client I/O outright
         (bench config 5, 64 OSDs on few cores)."""
         retry_pgs: set[tuple[int, int]] | None = None  # None = all
+        retry_epoch = -1  # epoch retry_pgs was scoped under
         backoff = max(self.conf["osd_backfill_retry_interval"], 0.05)
         max_backoff = backoff * 32
         while not self.stopping:
             done_epoch = self.epoch
+            if retry_pgs is not None and done_epoch != retry_epoch:
+                # a map landed during the BACKOFF SLEEP (the mid-pass
+                # check below never sees it): the retry set was scoped
+                # to the old epoch's unclean pgs, and running only
+                # those would stamp them clean at the NEW epoch while
+                # every other pg keeps its stale clean_epoch — since
+                # map arrival spawns no task while this one runs, they
+                # report active+peering forever (chaos-fuzz-found:
+                # a deferred rollback made incomplete passes, and with
+                # them this wedge, routine)
+                retry_pgs = None
+                backoff = max(
+                    self.conf["osd_backfill_retry_interval"], 0.05)
             # GC remote grants whose requesting primary is gone — a
             # primary that died after GRANT can never send RELEASE
             self._sweep_remote_grants()
@@ -144,6 +158,7 @@ class RecoveryMixin:
                     "%.2fs", self.id, len(incomplete), backoff)
                 await asyncio.sleep(backoff)
                 retry_pgs = {(pg.pool, pg.ps) for pg in incomplete}
+                retry_epoch = done_epoch
                 backoff = min(backoff * 2, max_backoff)
             except asyncio.CancelledError:
                 raise
@@ -947,10 +962,17 @@ class RecoveryMixin:
         # prior-interval members: extra SOURCES (never targets) — data
         # a full remap left on the old acting set
         prior_state: dict[tuple[int, int], tuple[bool, eversion_t, dict]] = {}
+        prior_unprobed: list[tuple[int, int]] = []
         for s, o in prior_pairs or ():
             try:
                 payload, attrs = await self._probe_shard(pool, pg, s, o, oid)
             except (OSError, asyncio.TimeoutError, ConnectionError):
+                # unreachable (typically DOWN-but-in, kept by
+                # _prior_pairs): useless as a source now, but its
+                # unseen store may hold the newest ACKED version —
+                # it vetoes the partial-write rollback below exactly
+                # as an unprobed CURRENT member does
+                prior_unprobed.append((s, o))
                 continue
             if payload is not None:
                 prior_state[(s, o)] = (
@@ -1044,7 +1066,7 @@ class RecoveryMixin:
         k = ec.get_data_chunk_count()
         force_push = False
         rb_srcs: set[int] = set()
-        if len(sources) < k and unprobed:
+        if len(sources) < k and (unprobed or prior_unprobed):
             # rollback is DESTRUCTIVE (strips log entries, force-pushes
             # old data) and must never be decided on a partial view: an
             # unreachable member may hold the very shards that make
@@ -1052,10 +1074,16 @@ class RecoveryMixin:
             # divergence (chaos-engine-found: mid-partition reconciles
             # rolled logs back to the reachable minority's version,
             # after which stale dup-resends re-applied old payloads as
-            # fresh low versions).  Retry when every member answers.
+            # fresh low versions).  A down-but-in PRIOR member vetoes
+            # too: a write acked degraded on exactly k shards leaves
+            # one holder outside the current acting set when that
+            # member is killed, and rolling back before it reboots
+            # loses the ack (chaos-fuzz-found; the veto lifts when the
+            # map outs it or the trace-end revive lets it answer).
+            # Retry when every member answers.
             log.info(
                 "osd.%d: %s/%s rollback deferred: %s unprobed",
-                self.id, pg, oid, unprobed,
+                self.id, pg, oid, unprobed + prior_unprobed,
             )
             return False
         if len(sources) < k:

@@ -14,7 +14,7 @@ def _readme_artifacts() -> set[str]:
     with open(os.path.join(REPO, "README.md")) as f:
         text = f.read()
     return set(re.findall(
-        r"\b((?:BENCH|MULTICHIP|CHAOS|LOAD)_[A-Za-z0-9_.]*\.json)\b",
+        r"\b((?:BENCH|MULTICHIP|CHAOS|LOAD|FUZZ)_[A-Za-z0-9_.]*\.json)\b",
         text))
 
 
@@ -84,7 +84,8 @@ def test_committed_artifacts_parse():
     """Every artifact in the tree is (line-delimited or plain) JSON."""
     for name in sorted(os.listdir(REPO)):
         if not re.fullmatch(
-            r"(?:BENCH|MULTICHIP|CHAOS|LOAD)_[A-Za-z0-9_.]*\.json", name
+            r"(?:BENCH|MULTICHIP|CHAOS|LOAD|FUZZ)_[A-Za-z0-9_.]*\.json",
+            name
         ):
             continue
         with open(os.path.join(REPO, name)) as f:
@@ -404,3 +405,89 @@ def test_chaos_rack_soak_artifact():
             assert r["events_applied"] > 0, r
     for scenario, n in judged.items():
         assert n >= 8, (scenario, n)
+
+
+def test_fuzz_artifact_corpus_and_lineage():
+    """The coverage-guided trace-fuzz campaign (FUZZ_r01): the corpus
+    seeds from every scenario and GROWS beyond them via >= 3 distinct
+    mutation kinds; every trace re-derives bit-identically from its
+    recorded lineage (seeds via ``generate_schedule(0, scenario)``,
+    mutants via ``mutate(parent_events, scenario, parent_hash,
+    mutation_seed)``); the coverage map is present and carries
+    cross-bred fingerprints no single hand-authored seed produces;
+    and every run holds the accelerator steady-state (cold-launch +
+    transfer-guard invariants)."""
+    from ceph_tpu.chaos.runner import SCENARIOS
+    from ceph_tpu.chaos.schedule import (
+        events_from_json,
+        generate_schedule,
+        trace_hash,
+    )
+    from ceph_tpu.fuzz.coverage import features
+    from ceph_tpu.fuzz.mutate import MUTATION_KINDS, mutate
+
+    cited = sorted(
+        n for n in _readme_artifacts() if n.startswith("FUZZ_"))
+    assert cited, "README must cite the committed FUZZ artifact"
+    name = cited[0]
+    with open(os.path.join(REPO, name)) as f:
+        doc = json.load(f)
+    assert doc["schema"] == "ceph_tpu.fuzz/v1"
+
+    corpus = doc["corpus"]
+    by_hash = {e["trace_hash"]: e for e in corpus}
+    seeds = [e for e in corpus if e["mutation_kind"] == "seed"]
+    mutants = [e for e in corpus if e["mutation_kind"] != "seed"]
+    assert len(seeds) >= 12, len(seeds)
+    assert mutants, "the corpus must grow beyond the scenario seeds"
+    kinds = {e["mutation_kind"] for e in mutants}
+    assert kinds <= set(MUTATION_KINDS), kinds
+    assert len(kinds) >= 3, kinds
+
+    # lineage: every corpus trace re-derives bit-identically
+    for e in corpus:
+        sc = SCENARIOS[e["scenario"]]
+        if e["mutation_kind"] == "seed":
+            ev = generate_schedule(0, sc)
+        else:
+            parent = by_hash[e["parent"]]
+            ev, kind = mutate(events_from_json(parent["events"]), sc,
+                              parent["trace_hash"], e["mutation_seed"])
+            assert kind == e["mutation_kind"], e["trace_hash"]
+        assert trace_hash(ev) == e["trace_hash"], e["trace_hash"]
+
+    # the coverage map holds every entry's features, and a mutant
+    # produced a fingerprint no single seed covers while touching
+    # >= 2 checkers' domains (the cross-breeding payoff)
+    cov_map = set(doc["coverage_map"])
+    assert cov_map
+    seed_feats = {
+        s["trace_hash"]: features(s["fingerprint"], s["scenario"])
+        for s in seeds
+    }
+    for feats in seed_feats.values():
+        assert feats <= cov_map
+    crossbred = [
+        e for e in mutants
+        if e["new_features"]
+        and len(e["fingerprint"].get("checkers", [])) >= 2
+        and not any(
+            features(e["fingerprint"], e["scenario"]) <= sf
+            for sf in seed_feats.values())
+    ]
+    assert crossbred, "no mutant escaped every seed's feature set"
+
+    # every run green and accelerator-steady; reds must be empty and
+    # say so (a red campaign ships its finding as a regression test
+    # under tests/integration/ instead)
+    assert doc["summary"]["all_green"], doc["summary"]
+    assert doc["summary"]["red"] == 0
+    assert not doc["reds"]
+    for r in doc["runs"]:
+        assert r["ok"], r.get("trace_hash")
+        assert r["invariants"]["cold_launches"]["ok"], r.get("trace_hash")
+
+    # the minimizer demonstrated end to end inside the artifact
+    demo = doc["minimize_demo"]
+    assert demo["found_exact_kernel"], demo
+    assert demo["minimized_events"] < demo["input_events"]
